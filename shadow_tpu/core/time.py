@@ -1,0 +1,74 @@
+"""Simulation time primitives.
+
+All simulation time is integer nanoseconds. There are two clocks, mirroring
+the reference's ``SimulationTime`` / ``EmulatedTime`` split
+(shadow-shim-helper-rs/src/simulation_time.rs:22,
+shadow-shim-helper-rs/src/emulated_time.rs:18-46):
+
+- ``SimTime``: nanoseconds since the start of the simulation (t=0).
+- ``EmuTime``: the wall-clock time the managed world observes; the epoch is
+  2000-01-01T00:00:00Z, so programs see plausible dates that never collide
+  with real time.
+
+Times are plain ``int`` on the host and ``int64`` lanes on the device; the
+sentinel ``NEVER`` (max int64) means "no event pending".  Integer-only time is
+a hard design rule: it is what makes the CPU reference backend and the TPU
+lane backend bit-identical (no float rounding anywhere in event ordering).
+"""
+
+from __future__ import annotations
+
+NANOS_PER_MICRO = 1_000
+NANOS_PER_MILLI = 1_000_000
+NANOS_PER_SEC = 1_000_000_000
+NANOS_PER_MIN = 60 * NANOS_PER_SEC
+NANOS_PER_HOUR = 3600 * NANOS_PER_SEC
+
+#: max int64; "no pending event" sentinel, compares greater than any real time.
+NEVER: int = (1 << 63) - 1
+
+#: EmuTime of simulation start: seconds between the Unix epoch and
+#: 2000-01-01T00:00:00Z (the reference's ``EMUTIME_SIMULATION_START``).
+SIM_START_EMU: int = 946_684_800 * NANOS_PER_SEC
+
+
+def sim_to_emu(sim_ns: int) -> int:
+    """Convert simulation-relative time to the emulated wall clock."""
+    if sim_ns == NEVER:
+        return NEVER
+    return SIM_START_EMU + sim_ns
+
+
+def emu_to_sim(emu_ns: int) -> int:
+    """Convert an emulated wall-clock time to simulation-relative time."""
+    if emu_ns == NEVER:
+        return NEVER
+    return emu_ns - SIM_START_EMU
+
+
+def from_secs(s: float | int) -> int:
+    """Seconds -> integer ns.  Accepts ints exactly; floats are rounded."""
+    if isinstance(s, int):
+        return s * NANOS_PER_SEC
+    return round(s * NANOS_PER_SEC)
+
+
+def from_millis(ms: float | int) -> int:
+    if isinstance(ms, int):
+        return ms * NANOS_PER_MILLI
+    return round(ms * NANOS_PER_MILLI)
+
+
+def from_micros(us: float | int) -> int:
+    if isinstance(us, int):
+        return us * NANOS_PER_MICRO
+    return round(us * NANOS_PER_MICRO)
+
+
+def fmt(ns: int) -> str:
+    """Human-readable time for logs: ``12.345678901s`` style."""
+    if ns == NEVER:
+        return "never"
+    sign = "-" if ns < 0 else ""
+    ns = abs(ns)
+    return f"{sign}{ns // NANOS_PER_SEC}.{ns % NANOS_PER_SEC:09d}s"

@@ -1,0 +1,22 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
+available in CI).  The container's sitecustomize imports jax and pins
+``jax_platforms`` to the remote-TPU plugin at interpreter start, so plain env
+vars are too late — we override through ``jax.config`` before the first
+backend initialization instead.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import shadow_tpu  # noqa: E402,F401  (enables jax x64 mode)
