@@ -1,0 +1,331 @@
+"""Simulation configuration: YAML + programmatic, with typed units.
+
+User-facing parity with the reference's three-layer config system
+(src/main/core/configuration.rs): the same YAML document shape —
+
+    general:    { stop_time, seed, parallelism, bootstrap_end_time, ... }
+    network:    { graph: { type: gml|1_gbit_switch, file|inline }, ... }
+    experimental: { runahead, use_dynamic_runahead, ... }
+    host_option_defaults: { ... }
+    hosts:
+      <hostname>:
+        network_node_id: 0
+        processes: [ { path, args, start_time, ... } ]
+
+— parsed into plain dataclasses.  CLI overrides merge on top of the YAML
+values (the reference uses the `merge` crate for this; here
+:func:`ConfigOptions.apply_overrides` takes dotted keys).
+
+TPU-specific addition: ``experimental.network_backend`` selects ``cpu``
+(host reference implementation) or ``tpu`` (batched JAX lane backend), the
+analog of the reference's ``use_new_tcp``-style backend switches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Optional
+
+import yaml
+
+from ..core import time as stime
+from . import units
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class GeneralOptions:
+    stop_time: int = 0  # ns; required > 0
+    seed: int = 1
+    parallelism: int = 0  # 0 = all cores
+    bootstrap_end_time: int = 0  # ns; loss-free warm-up window (worker.rs:335)
+    data_directory: str = "shadow.data"
+    template_directory: Optional[str] = None
+    log_level: str = "info"
+    heartbeat_interval: Optional[int] = stime.NANOS_PER_SEC
+    progress: bool = False
+    model_unblocked_syscall_latency: bool = False
+
+
+@dataclasses.dataclass
+class GraphOptions:
+    type: str = "1_gbit_switch"  # "gml" | "1_gbit_switch"
+    file_path: Optional[str] = None
+    inline: Optional[str] = None
+
+
+@dataclasses.dataclass
+class NetworkOptions:
+    graph: GraphOptions = dataclasses.field(default_factory=GraphOptions)
+    use_shortest_path: bool = True
+
+
+@dataclasses.dataclass
+class ExperimentalOptions:
+    # PDES window control
+    runahead: Optional[int] = stime.NANOS_PER_MILLI  # lower bound, ns
+    use_dynamic_runahead: bool = False
+    # scheduling (cpu backend)
+    scheduler: str = "thread-per-core"  # | "thread-per-host"
+    use_cpu_pinning: bool = True
+    use_worker_spinning: bool = True
+    # transport knobs
+    use_new_tcp: bool = False
+    socket_send_buffer: int = 131072  # bytes
+    socket_recv_buffer: int = 174760
+    interface_qdisc: str = "fifo"  # | "round-robin"
+    # strace-style logging
+    strace_logging_mode: str = "off"  # off | standard | deterministic
+    # --- TPU-native extensions -------------------------------------------
+    network_backend: str = "cpu"  # "cpu" | "tpu"
+    tpu_lane_queue_capacity: int = 64  # per-host in-flight packet slots
+    tpu_events_per_round: int = 8  # max pops per lane per inner step
+    tpu_mesh_shape: Optional[tuple[int, ...]] = None  # None = all devices
+
+
+@dataclasses.dataclass
+class ProcessOptions:
+    path: str = ""
+    args: list[str] = dataclasses.field(default_factory=list)
+    environment: dict[str, str] = dataclasses.field(default_factory=dict)
+    start_time: int = 0  # ns
+    shutdown_time: Optional[int] = None
+    shutdown_signal: str = "SIGTERM"
+    expected_final_state: Any = "exited"  # {"exited": code}|"running"|{"signaled": sig}
+
+
+@dataclasses.dataclass
+class HostOptions:
+    hostname: str = ""
+    network_node_id: int = 0
+    ip_addr: Optional[str] = None
+    bandwidth_down: Optional[int] = None  # bits/sec; falls back to graph node
+    bandwidth_up: Optional[int] = None
+    processes: list[ProcessOptions] = dataclasses.field(default_factory=list)
+    log_level: Optional[str] = None
+    pcap_enabled: bool = False
+    pcap_capture_size: int = 65535
+    count: int = 1  # convenience host multiplier (hostname gets a suffix)
+
+
+@dataclasses.dataclass
+class ConfigOptions:
+    general: GeneralOptions = dataclasses.field(default_factory=GeneralOptions)
+    network: NetworkOptions = dataclasses.field(default_factory=NetworkOptions)
+    experimental: ExperimentalOptions = dataclasses.field(
+        default_factory=ExperimentalOptions
+    )
+    hosts: list[HostOptions] = dataclasses.field(default_factory=list)
+
+    # -- parsing ----------------------------------------------------------
+
+    @classmethod
+    def from_yaml_file(cls, path: str | Path) -> "ConfigOptions":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "ConfigOptions":
+        return cls.from_dict(yaml.safe_load(text))
+
+    @classmethod
+    def from_dict(cls, doc: dict[str, Any]) -> "ConfigOptions":
+        if not isinstance(doc, dict):
+            raise ConfigError("config must be a mapping")
+        unknown = set(doc) - {
+            "general",
+            "network",
+            "experimental",
+            "host_option_defaults",
+            "hosts",
+        }
+        if unknown:
+            raise ConfigError(f"unknown top-level config keys: {sorted(unknown)}")
+
+        gen_doc = dict(doc.get("general", {}))
+        general = GeneralOptions(
+            stop_time=units.parse_time(_require(gen_doc, "stop_time", "general")),
+            seed=int(gen_doc.pop("seed", 1)),
+            parallelism=int(gen_doc.pop("parallelism", 0)),
+            bootstrap_end_time=units.parse_time(gen_doc.pop("bootstrap_end_time", 0)),
+            data_directory=str(gen_doc.pop("data_directory", "shadow.data")),
+            template_directory=gen_doc.pop("template_directory", None),
+            log_level=str(gen_doc.pop("log_level", "info")),
+            heartbeat_interval=_opt_time(gen_doc.pop("heartbeat_interval", "1s")),
+            progress=bool(gen_doc.pop("progress", False)),
+            model_unblocked_syscall_latency=bool(
+                gen_doc.pop("model_unblocked_syscall_latency", False)
+            ),
+        )
+        gen_doc.pop("stop_time", None)
+        if gen_doc:
+            raise ConfigError(f"unknown general options: {sorted(gen_doc)}")
+
+        net_doc = dict(doc.get("network", {}))
+        graph_doc = dict(net_doc.pop("graph", {"type": "1_gbit_switch"}))
+        gtype = graph_doc.pop("type", "gml")
+        graph = GraphOptions(type=gtype)
+        if gtype == "gml":
+            sources = [k for k in ("file", "inline", "path") if k in graph_doc]
+            if len(sources) > 1:
+                raise ConfigError(
+                    f"gml graph has conflicting sources: {sources}; give one"
+                )
+            if "file" in graph_doc:
+                fd = graph_doc.pop("file")
+                graph.file_path = fd["path"] if isinstance(fd, dict) else str(fd)
+            elif "inline" in graph_doc:
+                graph.inline = str(graph_doc.pop("inline"))
+            elif "path" in graph_doc:
+                graph.file_path = str(graph_doc.pop("path"))
+            else:
+                raise ConfigError("gml graph needs 'file' or 'inline'")
+        elif gtype != "1_gbit_switch":
+            raise ConfigError(f"unknown graph type {gtype!r}")
+        if graph_doc:
+            raise ConfigError(f"unknown network.graph options: {sorted(graph_doc)}")
+        network = NetworkOptions(
+            graph=graph,
+            use_shortest_path=bool(net_doc.pop("use_shortest_path", True)),
+        )
+        if net_doc:
+            raise ConfigError(f"unknown network options: {sorted(net_doc)}")
+
+        exp_doc = dict(doc.get("experimental", {}))
+        experimental = ExperimentalOptions()
+        for f in dataclasses.fields(ExperimentalOptions):
+            if f.name in exp_doc:
+                v = exp_doc.pop(f.name)
+                if f.name == "runahead":
+                    v = _opt_time(v)
+                elif f.name == "tpu_mesh_shape" and v is not None:
+                    v = tuple(int(x) for x in v)
+                elif f.name in ("socket_send_buffer", "socket_recv_buffer"):
+                    v = units.parse_bytes(v)
+                setattr(experimental, f.name, v)
+        if exp_doc:
+            raise ConfigError(f"unknown experimental options: {sorted(exp_doc)}")
+
+        defaults = dict(doc.get("host_option_defaults", {}))
+        hosts: list[HostOptions] = []
+        hosts_doc = doc.get("hosts", {})
+        if not isinstance(hosts_doc, dict) or not hosts_doc:
+            raise ConfigError("config must define at least one host")
+        for name, h in sorted(hosts_doc.items()):
+            merged = {**defaults, **(h or {})}
+            count = int(merged.pop("count", 1))
+            base = _parse_host(name, merged)
+            if count == 1:
+                hosts.append(base)
+            else:
+                for i in range(1, count + 1):
+                    hi = dataclasses.replace(
+                        base,
+                        hostname=f"{name}{i}",
+                        processes=[
+                            dataclasses.replace(
+                                p, args=list(p.args), environment=dict(p.environment)
+                            )
+                            for p in base.processes
+                        ],
+                    )
+                    hosts.append(hi)
+        return cls(general=general, network=network, experimental=experimental, hosts=hosts)
+
+    # -- overrides (CLI layer) -------------------------------------------
+
+    _TIME_FIELDS = {"stop_time", "bootstrap_end_time", "runahead", "heartbeat_interval"}
+    _BYTE_FIELDS = {"socket_send_buffer", "socket_recv_buffer", "pcap_capture_size"}
+
+    def apply_overrides(self, overrides: dict[str, Any]) -> None:
+        """Apply dotted-key overrides, e.g. {'general.seed': 7,
+        'experimental.network_backend': 'tpu'} — the CLI merge layer.
+        Values are coerced to the target field's type (CLI values arrive as
+        strings)."""
+        for key, value in overrides.items():
+            section, _, field = key.partition(".")
+            target = getattr(self, section, None)
+            if target is None or not dataclasses.is_dataclass(target):
+                raise ConfigError(f"unknown config option {key!r}")
+            fields = {f.name: f for f in dataclasses.fields(target)}
+            if field not in fields:
+                raise ConfigError(f"unknown config option {key!r}")
+            if value is not None:
+                if field in self._TIME_FIELDS:
+                    value = units.parse_time(value)
+                elif field in self._BYTE_FIELDS:
+                    value = units.parse_bytes(value)
+                else:
+                    current = getattr(target, field)
+                    if isinstance(current, bool):
+                        value = (
+                            value
+                            if isinstance(value, bool)
+                            else str(value).lower() in ("1", "true", "yes", "on")
+                        )
+                    elif isinstance(current, int):
+                        value = int(value)
+            setattr(target, field, value)
+
+    def validate(self) -> None:
+        if self.general.stop_time <= 0:
+            raise ConfigError("general.stop_time must be > 0")
+        if self.experimental.network_backend not in ("cpu", "tpu"):
+            raise ConfigError("experimental.network_backend must be cpu|tpu")
+        names = [h.hostname for h in self.hosts]
+        if len(set(names)) != len(names):
+            raise ConfigError("duplicate hostnames")
+
+
+def _require(doc: dict[str, Any], key: str, section: str) -> Any:
+    if key not in doc:
+        raise ConfigError(f"{section}.{key} is required")
+    return doc[key]
+
+
+def _opt_time(v: Any) -> Optional[int]:
+    return None if v is None else units.parse_time(v)
+
+
+def _parse_host(name: str, doc: dict[str, Any]) -> HostOptions:
+    doc = dict(doc)
+    procs = []
+    for p in doc.pop("processes", []):
+        p = dict(p)
+        args = p.pop("args", [])
+        if isinstance(args, str):
+            args = args.split()
+        procs.append(
+            ProcessOptions(
+                path=str(p.pop("path")),
+                args=[str(a) for a in args],
+                environment={str(k): str(v) for k, v in p.pop("environment", {}).items()},
+                start_time=units.parse_time(p.pop("start_time", 0)),
+                shutdown_time=_opt_time(p.pop("shutdown_time", None)),
+                shutdown_signal=str(p.pop("shutdown_signal", "SIGTERM")),
+                expected_final_state=p.pop("expected_final_state", {"exited": 0}),
+            )
+        )
+        if p:
+            raise ConfigError(f"unknown process options on host {name!r}: {sorted(p)}")
+    bw_down = doc.pop("bandwidth_down", None)
+    bw_up = doc.pop("bandwidth_up", None)
+    host = HostOptions(
+        hostname=name,
+        network_node_id=int(doc.pop("network_node_id", 0)),
+        ip_addr=doc.pop("ip_addr", None),
+        bandwidth_down=units.parse_bandwidth(bw_down) if bw_down is not None else None,
+        bandwidth_up=units.parse_bandwidth(bw_up) if bw_up is not None else None,
+        processes=procs,
+        log_level=doc.pop("log_level", None),
+        pcap_enabled=bool(doc.pop("pcap_enabled", False)),
+        pcap_capture_size=units.parse_bytes(doc.pop("pcap_capture_size", 65535)),
+        count=1,
+    )
+    if doc:
+        raise ConfigError(f"unknown host options on {name!r}: {sorted(doc)}")
+    return host

@@ -1,0 +1,181 @@
+"""Config parsing: units, YAML document shape, overrides, validation."""
+
+import pytest
+
+from shadow_tpu.config import units
+from shadow_tpu.config.options import ConfigError, ConfigOptions
+from shadow_tpu.core import time as stime
+
+
+def test_time_units():
+    assert units.parse_time("10s") == 10 * stime.NANOS_PER_SEC
+    assert units.parse_time("10 ms") == 10 * stime.NANOS_PER_MILLI
+    assert units.parse_time("1500 us") == 1500 * stime.NANOS_PER_MICRO
+    assert units.parse_time("250 ns") == 250
+    assert units.parse_time("2 min") == 2 * stime.NANOS_PER_MIN
+    assert units.parse_time("1h") == stime.NANOS_PER_HOUR
+    assert units.parse_time(10) == 10 * stime.NANOS_PER_SEC
+    assert units.parse_time("1.5s") == 1_500_000_000
+    with pytest.raises(units.UnitError):
+        units.parse_time("10 parsecs")
+
+
+def test_bandwidth_units():
+    assert units.parse_bandwidth("1 Gbit") == 10**9
+    assert units.parse_bandwidth("100 Mbit") == 100 * 10**6
+    assert units.parse_bandwidth("10 Mbps") == 10 * 10**6
+    assert units.parse_bandwidth("1 Kibit") == 1024
+    assert units.parse_bandwidth(5000) == 5000
+
+
+def test_byte_units():
+    assert units.parse_bytes("16 MiB") == 16 * 2**20
+    assert units.parse_bytes("1500 B") == 1500
+    assert units.parse_bytes("2 KB") == 2000
+    assert units.parse_bytes(42) == 42
+
+
+BASIC_YAML = """
+general:
+  stop_time: 10s
+  seed: 7
+
+network:
+  graph:
+    type: 1_gbit_switch
+
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - path: tgen-server
+      args: --port 80
+      start_time: 1s
+      expected_final_state: running
+  client: &client
+    network_node_id: 0
+    processes:
+    - path: tgen-client
+      args: [--connect, server]
+      start_time: 2s
+"""
+
+
+def test_basic_yaml_roundtrip():
+    cfg = ConfigOptions.from_yaml(BASIC_YAML)
+    cfg.validate()
+    assert cfg.general.stop_time == 10 * stime.NANOS_PER_SEC
+    assert cfg.general.seed == 7
+    assert [h.hostname for h in cfg.hosts] == ["client", "server"]
+    server = cfg.hosts[1]
+    assert server.processes[0].path == "tgen-server"
+    assert server.processes[0].args == ["--port", "80"]
+    assert server.processes[0].start_time == stime.NANOS_PER_SEC
+    assert server.processes[0].expected_final_state == "running"
+    assert cfg.hosts[0].processes[0].args == ["--connect", "server"]
+
+
+def test_host_count_expansion():
+    cfg = ConfigOptions.from_yaml(
+        """
+general: {stop_time: 1s}
+hosts:
+  peer:
+    count: 3
+    processes: [{path: phold}]
+"""
+    )
+    assert [h.hostname for h in cfg.hosts] == ["peer1", "peer2", "peer3"]
+
+
+def test_unknown_keys_rejected():
+    with pytest.raises(ConfigError, match="unknown general"):
+        ConfigOptions.from_yaml(
+            "general: {stop_time: 1s, bogus: 1}\nhosts: {a: {processes: []}}"
+        )
+    with pytest.raises(ConfigError, match="unknown top-level"):
+        ConfigOptions.from_yaml("general: {stop_time: 1s}\nhoss: {}")
+    with pytest.raises(ConfigError, match="at least one host"):
+        ConfigOptions.from_yaml("general: {stop_time: 1s}")
+
+
+def test_overrides_and_validation():
+    cfg = ConfigOptions.from_yaml(BASIC_YAML)
+    cfg.apply_overrides(
+        {"experimental.network_backend": "tpu", "general.stop_time": "30s"}
+    )
+    assert cfg.experimental.network_backend == "tpu"
+    assert cfg.general.stop_time == 30 * stime.NANOS_PER_SEC
+    with pytest.raises(ConfigError):
+        cfg.apply_overrides({"general.nope": 1})
+    cfg.experimental.network_backend = "gpu"
+    with pytest.raises(ConfigError):
+        cfg.validate()
+
+
+def test_host_defaults_inheritance():
+    cfg = ConfigOptions.from_yaml(
+        """
+general: {stop_time: 1s}
+host_option_defaults: {pcap_enabled: true, bandwidth_up: "10 Mbit"}
+hosts:
+  a: {processes: [{path: phold}]}
+  b: {pcap_enabled: false, processes: [{path: phold}]}
+"""
+    )
+    a, b = cfg.hosts
+    assert a.pcap_enabled and not b.pcap_enabled
+    assert a.bandwidth_up == 10**7 and b.bandwidth_up == 10**7
+
+
+def test_override_type_coercion():
+    cfg = ConfigOptions.from_yaml(BASIC_YAML)
+    cfg.apply_overrides(
+        {
+            "general.heartbeat_interval": "2s",
+            "general.seed": "9",
+            "experimental.socket_send_buffer": "16 KiB",
+            "experimental.use_worker_spinning": "false",
+            "experimental.runahead": None,
+        }
+    )
+    assert cfg.general.heartbeat_interval == 2 * stime.NANOS_PER_SEC
+    assert cfg.general.seed == 9
+    assert cfg.experimental.socket_send_buffer == 16 * 1024
+    assert cfg.experimental.use_worker_spinning is False
+    assert cfg.experimental.runahead is None
+
+
+def test_graph_doc_unknown_and_conflicting_keys():
+    import pytest as _pytest
+
+    with _pytest.raises(ConfigError, match="unknown network.graph"):
+        ConfigOptions.from_yaml(
+            """
+general: {stop_time: 1s}
+network: {graph: {type: 1_gbit_switch, bogus: 1}}
+hosts: {a: {processes: [{path: x}]}}
+"""
+        )
+    with _pytest.raises(ConfigError, match="conflicting sources"):
+        ConfigOptions.from_yaml(
+            """
+general: {stop_time: 1s}
+network: {graph: {type: gml, file: a.gml, inline: "graph []"}}
+hosts: {a: {processes: [{path: x}]}}
+"""
+        )
+
+
+def test_count_expansion_no_shared_mutables():
+    cfg = ConfigOptions.from_yaml(
+        """
+general: {stop_time: 1s}
+hosts:
+  peer:
+    count: 2
+    processes: [{path: phold, args: [--x], environment: {A: "1"}}]
+"""
+    )
+    p0, p1 = cfg.hosts[0].processes[0], cfg.hosts[1].processes[0]
+    assert p0.args is not p1.args and p0.environment is not p1.environment

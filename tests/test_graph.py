@@ -1,0 +1,233 @@
+"""GML parsing, shortest-path routing tables, IP assignment."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import time as stime
+from shadow_tpu.net import gml
+from shadow_tpu.net.graph import (
+    GraphError,
+    IpAssignment,
+    NetworkGraph,
+    RoutingInfo,
+)
+
+
+def test_gml_parse_basic():
+    g = gml.parse_gml(
+        """
+# a comment
+graph [
+  directed 0
+  node [ id 0 host_bandwidth_up "1 Gbit" ]
+  node [ id 5 label "n5" ]
+  edge [ source 0 target 5 latency "1 ms" packet_loss 0.01 ]
+]
+"""
+    )
+    assert len(g["nodes"]) == 2
+    assert g["nodes"][1]["id"] == 5
+    assert g["nodes"][1]["label"] == "n5"
+    assert g["edges"][0]["latency"] == "1 ms"
+    assert g["edges"][0]["packet_loss"] == 0.01
+
+
+def test_gml_errors():
+    with pytest.raises(gml.GmlError):
+        gml.parse_gml("nope [ ]")
+    with pytest.raises(gml.GmlError):
+        gml.parse_gml("graph [ node [ id ] ]")  # key with missing value
+
+
+def test_one_gbit_switch():
+    g = NetworkGraph.one_gbit_switch()
+    lat, loss = g.path(0, 0)
+    assert lat == stime.NANOS_PER_MILLI
+    assert loss == 0.0
+    assert g.min_latency_ns() == stime.NANOS_PER_MILLI
+    assert g.node_bandwidth(0) == (10**9, 10**9)
+
+
+TRIANGLE = """
+graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  node [ id 2 ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 1 target 2 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 0 target 2 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def test_shortest_path_prefers_low_latency():
+    g = NetworkGraph.from_gml(TRIANGLE)
+    # 0->2 direct is 50ms; via 1 it's 20ms with compounded loss
+    lat, loss = g.path(0, 2)
+    assert lat == 20 * stime.NANOS_PER_MILLI
+    assert abs(loss - (1 - 0.9 * 0.9)) < 1e-12
+    # direct routing mode keeps the direct edge
+    gd = NetworkGraph.from_gml(TRIANGLE, use_shortest_path=False)
+    lat_d, loss_d = gd.path(0, 2)
+    assert lat_d == 50 * stime.NANOS_PER_MILLI and loss_d == 0.0
+    assert g.min_latency_ns() == 10 * stime.NANOS_PER_MILLI
+
+
+def test_latency_tie_breaks_on_loss():
+    g = NetworkGraph.from_gml(
+        """
+graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  node [ id 2 ]
+  node [ id 3 ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.5 ]
+  edge [ source 1 target 3 latency "10 ms" packet_loss 0.5 ]
+  edge [ source 0 target 2 latency "10 ms" packet_loss 0.0 ]
+  edge [ source 2 target 3 latency "10 ms" packet_loss 0.0 ]
+]
+"""
+    )
+    lat, loss = g.path(0, 3)
+    assert lat == 20 * stime.NANOS_PER_MILLI
+    assert loss == 0.0  # lossless route wins the tie
+
+
+def test_same_node_needs_self_loop():
+    g = NetworkGraph.from_gml(
+        """
+graph [
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 1 latency "5 ms" ]
+]
+"""
+    )
+    with pytest.raises(GraphError, match="self-loop"):
+        g.path(0, 0)
+
+
+def test_directed_graph_one_way():
+    g = NetworkGraph.from_gml(
+        """
+graph [
+  directed 1
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 1 latency "5 ms" ]
+]
+"""
+    )
+    assert g.path(0, 1)[0] == 5 * stime.NANOS_PER_MILLI
+    with pytest.raises(GraphError, match="no path"):
+        g.path(1, 0)
+
+
+def test_edge_validation():
+    with pytest.raises(GraphError, match="latency"):
+        NetworkGraph.from_gml(
+            'graph [ node [ id 0 ] edge [ source 0 target 0 latency "0 ms" ] ]'
+        )
+    with pytest.raises(GraphError, match="packet_loss"):
+        NetworkGraph.from_gml(
+            'graph [ node [ id 0 ] edge [ source 0 target 0 latency "1 ms" packet_loss 1.5 ] ]'
+        )
+    with pytest.raises(GraphError, match="More than one edge|more than one edge"):
+        NetworkGraph.from_gml(
+            """graph [ node [ id 0 ] node [ id 1 ]
+            edge [ source 0 target 1 latency "1 ms" ]
+            edge [ source 0 target 1 latency "2 ms" ] ]"""
+        )
+
+
+def test_ip_assignment():
+    ips = IpAssignment()
+    a = ips.assign(0)
+    b = ips.assign(1)
+    assert a == "11.0.0.1" and b == "11.0.0.2"
+    c = ips.assign(2, requested_ip="192.168.1.5")
+    assert c == "192.168.1.5"
+    assert ips.host_for_ip("11.0.0.2") == 1
+    with pytest.raises(GraphError):
+        ips.assign(3, requested_ip="11.0.0.1")
+    # .0/.255 skipped
+    ips2 = IpAssignment()
+    seen = {ips2.assign(i) for i in range(600)}
+    assert not any(ip.endswith(".0") or ip.endswith(".255") for ip in seen)
+
+
+def test_routing_info_and_device_tables():
+    g = NetworkGraph.from_gml(TRIANGLE)
+    ri = RoutingInfo(g, {0: 0, 1: 1, 2: 2})
+    lat, thr = ri.path(0, 2)
+    assert lat == 20 * stime.NANOS_PER_MILLI
+    assert thr == int((1 - 0.81) * 2**32)
+    assert ri.packet_counts[(0, 2)] == 1
+    idx, latm, thrm = ri.device_tables()
+    assert idx.tolist() == [0, 1, 2]
+    assert latm.shape == (3, 3) and thrm.dtype == np.int64
+    assert ri.min_used_latency_ns() == 10 * stime.NANOS_PER_MILLI
+
+
+def test_routing_info_validates_reachability():
+    g = NetworkGraph.from_gml(
+        """
+graph [
+  directed 1
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 1 latency "5 ms" ]
+]
+"""
+    )
+    with pytest.raises(GraphError, match="without a route"):
+        RoutingInfo(g, {0: 0, 1: 1})
+
+
+def test_xz_graph_file(tmp_path):
+    import lzma
+
+    p = tmp_path / "g.gml.xz"
+    p.write_bytes(lzma.compress(TRIANGLE.encode()))
+    g = NetworkGraph.from_file(p)
+    assert g.path(0, 2)[0] == 20 * stime.NANOS_PER_MILLI
+
+
+def test_tie_break_regression_reversed_indices():
+    # regression: the lossless route on *higher* node indices must still win
+    # the latency tie (a float-epsilon composite weight gets this wrong)
+    g = NetworkGraph.from_gml(
+        """
+graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  node [ id 2 ]
+  node [ id 3 ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.5 ]
+  edge [ source 1 target 3 latency "10 ms" packet_loss 0.5 ]
+  edge [ source 0 target 2 latency "10 ms" packet_loss 0.0 ]
+  edge [ source 2 target 3 latency "10 ms" packet_loss 0.0 ]
+]
+"""
+    )
+    lat, loss = g.path(0, 3)
+    assert lat == 20 * stime.NANOS_PER_MILLI and loss == 0.0
+
+
+def test_min_used_latency_raises_cleanly():
+    g = NetworkGraph.from_gml(
+        'graph [ node [ id 0 ] node [ id 1 ] edge [ source 0 target 1 latency "5 ms" ] ]'
+    )
+    ri = RoutingInfo(g, {0: 0})  # single host, no self-loop needed
+    with pytest.raises(GraphError, match="no routable"):
+        ri.min_used_latency_ns()
+
+
+def test_bare_numeric_latency_rejected():
+    with pytest.raises(GraphError, match="unit string"):
+        NetworkGraph.from_gml(
+            "graph [ node [ id 0 ] edge [ source 0 target 0 latency 1.5 ] ]"
+        )
