@@ -1,0 +1,336 @@
+"""CPU reference backend: the scalar implementation of docs/SEMANTICS.md.
+
+Structural analog of the reference's Controller/Manager/Host round loop
+(controller.rs:81-113, manager.rs:541-770, host.rs:762-830), collapsed into
+one process: rounds advance all hosts over a conservative lookahead window;
+cross-host packets land in the destination's event queue for later windows.
+This backend is the determinism oracle the TPU lane backend is diffed
+against, and the fallback for configs the lane vocabulary can't express yet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as wall_time
+from typing import Optional
+
+from ..config.options import ConfigOptions
+from ..core import rng as rng_mod
+from ..core import time as stime
+from ..core.event import Event, EventKind, Task
+from ..core.event_queue import EventQueue
+from ..models import phold as _phold  # noqa: F401  (register built-ins)
+from ..models import tgen as _tgen  # noqa: F401
+from ..models.base import create_model
+from ..net.codel import CoDel
+from ..net.graph import IpAssignment, NetworkGraph, RoutingInfo
+from ..net.token_bucket import (
+    FRAME_OVERHEAD_BYTES,
+    TokenBucket,
+    bucket_params,
+)
+
+# event-log outcome codes (SEMANTICS.md)
+DELIVERED = 0
+DROP_LOSS = 1
+DROP_CODEL = 2
+DROP_QUEUE = 3
+
+OUTCOME_NAMES = {0: "delivered", 1: "loss", 2: "codel", 3: "queue"}
+
+
+@dataclasses.dataclass
+class LogRecord:
+    time: int
+    src: int
+    dst: int
+    seq: int
+    size: int
+    outcome: int
+
+    def as_tuple(self) -> tuple[int, int, int, int, int, int]:
+        return (self.time, self.src, self.dst, self.seq, self.size, self.outcome)
+
+
+@dataclasses.dataclass
+class Delivery:
+    """Payload of a LOCAL delivery event (step 6 of the lifecycle)."""
+
+    src: int
+    seq: int
+    size: int
+
+
+class Host:
+    """Per-host state: queue, buckets, CoDel, RNG counters, app models."""
+
+    def __init__(
+        self,
+        host_id: int,
+        hostname: str,
+        engine: "CpuEngine",
+        bw_up_bps: int,
+        bw_down_bps: int,
+    ) -> None:
+        self.host_id = host_id
+        self.hostname = hostname
+        self.engine = engine
+        self.queue = EventQueue()
+        up_rate, up_burst = bucket_params(bw_up_bps)
+        dn_rate, dn_burst = bucket_params(bw_down_bps)
+        self.up_bucket = TokenBucket(rate=up_rate, burst=up_burst)
+        self.down_bucket = TokenBucket(rate=dn_rate, burst=dn_burst)
+        self.codel = CoDel()
+        self.send_seq = 0  # per-host packet counter (RNG counter + FIFO prio)
+        self.local_seq = 0  # per-host local-event counter
+        self.app_draws = 0  # APP_STREAM counter
+        self.apps: list = []
+        self.counters: dict[str, int] = {}
+        self.now = 0  # current event time while executing
+
+    # -- HostApi ----------------------------------------------------------
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.engine.hosts)
+
+    def send(self, dst: int, size_bytes: int) -> int:
+        return self.engine.send_packet(self, dst, size_bytes)
+
+    def set_timer(self, t_abs_ns: int) -> None:
+        app = self._current_app
+
+        def fire(h: "Host", a=app) -> None:
+            h._current_app = a
+            a.on_timer(h, h.now)
+
+        # strictly future: a timer armed for "now" (or the past) would pop in
+        # the same window at the same instant and can live-lock the round
+        self.push_local(max(t_abs_ns, self.now + 1), Task(fire, label="timer"))
+
+    def set_timer_relative(self, delta_ns: int) -> None:
+        self.set_timer(self.now + delta_ns)
+
+    def resolve(self, hostname: str) -> int:
+        return self.engine.resolve(hostname)
+
+    def rand_u32(self) -> int:
+        v = int(
+            rng_mod.rand_u32(
+                self.engine.seed,
+                self.host_id | rng_mod.APP_STREAM,
+                self.app_draws,
+            )
+        )
+        self.app_draws += 1
+        return v
+
+    def count(self, key: str, n: int = 1) -> None:
+        self.counters[key] = self.counters.get(key, 0) + n
+
+    # -- engine side ------------------------------------------------------
+
+    def push_local(self, t: int, task: Task) -> None:
+        self.queue.push(
+            Event(t, EventKind.LOCAL, src_host=self.host_id, seq=self.local_seq, data=task)
+        )
+        self.local_seq += 1
+
+    def execute(self, until: int) -> None:
+        """Pop and run all events < until (Host::execute, host.rs:762-803)."""
+        while True:
+            ev = self.queue.peek()
+            if ev is None or ev.time >= until:
+                return
+            ev = self.queue.pop()
+            self.now = ev.time
+            if ev.kind == EventKind.PACKET:
+                self.engine.inbound(self, ev)
+            elif ev.kind == EventKind.DELIVERY:
+                data = ev.data
+                for app in self.apps:
+                    self._current_app = app
+                    app.on_delivery(self, ev.time, data.src, data.seq, data.size)
+            else:
+                ev.data.execute(self)
+
+    _current_app = None
+
+
+class CpuEngine:
+    """Build hosts from a config and run the round loop."""
+
+    def __init__(self, cfg: ConfigOptions) -> None:
+        cfg.validate()
+        self.cfg = cfg
+        self.seed = cfg.general.seed
+        self.stop_time = cfg.general.stop_time
+        self.bootstrap_end = cfg.general.bootstrap_end_time
+
+        # topology
+        g = cfg.network.graph
+        if g.type == "1_gbit_switch":
+            self.graph = NetworkGraph.one_gbit_switch()
+        elif g.inline is not None:
+            self.graph = NetworkGraph.from_gml(g.inline, cfg.network.use_shortest_path)
+        else:
+            self.graph = NetworkGraph.from_file(g.file_path, cfg.network.use_shortest_path)
+
+        # hosts (sorted by hostname, ids in that order — deterministic)
+        self.ips = IpAssignment()
+        self.hostname_to_id: dict[str, int] = {}
+        self.hosts: list[Host] = []
+        node_map: dict[int, int] = {}
+        for hid, hopt in enumerate(cfg.hosts):
+            self.hostname_to_id[hopt.hostname] = hid
+            self.ips.assign(hid, hopt.ip_addr)
+            node_map[hid] = hopt.network_node_id
+            nb_up, nb_down = self.graph.node_bandwidth(hopt.network_node_id)
+            bw_up = hopt.bandwidth_up if hopt.bandwidth_up is not None else nb_up
+            bw_down = hopt.bandwidth_down if hopt.bandwidth_down is not None else nb_down
+            if bw_up is None or bw_down is None:
+                raise ValueError(
+                    f"host {hopt.hostname!r}: no bandwidth on host or graph node"
+                )
+            self.hosts.append(Host(hid, hopt.hostname, self, bw_up, bw_down))
+        self.routing = RoutingInfo(self.graph, node_map)
+        self.node_index = self.routing.host_node_index
+
+        # runahead: min latency over used paths, floored by config
+        min_lat = self.routing.min_used_latency_ns()
+        floor = cfg.experimental.runahead or 0
+        self.runahead = max(min_lat, floor, 1)
+
+        # app models scheduled at their start times
+        for hid, hopt in enumerate(cfg.hosts):
+            host = self.hosts[hid]
+            for p in hopt.processes:
+                app = create_model(p.path, list(p.args))
+                host.apps.append(app)
+                host.push_local(
+                    p.start_time, Task(lambda h, a=app: _start_app(h, a), label="start")
+                )
+
+        self.event_log: list[LogRecord] = []
+        self.window_end = 0
+        self.rounds = 0
+
+    # -- DNS --------------------------------------------------------------
+
+    def resolve(self, hostname: str) -> int:
+        if hostname in self.hostname_to_id:
+            return self.hostname_to_id[hostname]
+        hid = self.ips.host_for_ip(hostname)
+        if hid is not None:
+            return hid
+        try:
+            hid = int(hostname)
+        except ValueError:
+            raise ValueError(f"unknown hostname {hostname!r}") from None
+        if not 0 <= hid < len(self.hosts):
+            raise ValueError(
+                f"host id {hid} out of range (have {len(self.hosts)} hosts)"
+            )
+        return hid
+
+    # -- packet path (SEMANTICS.md lifecycle) ------------------------------
+
+    def send_packet(self, src_host: Host, dst: int, size_bytes: int) -> int:
+        t = src_host.now
+        seq = src_host.send_seq
+        src_host.send_seq += 1
+        s, d = src_host.host_id, dst
+
+        bits = (size_bytes + FRAME_OVERHEAD_BYTES) * 8
+        t_dep = src_host.up_bucket.charge(t, bits)
+
+        # loss (skipped during bootstrap)
+        lat_ns, thresh = self.routing.path(s, d)
+        if t >= self.bootstrap_end and thresh > 0:
+            u = int(rng_mod.rand_u32(self.seed, s | rng_mod.LOSS_STREAM, seq))
+            if u < thresh:
+                self.event_log.append(LogRecord(t, s, d, seq, size_bytes, DROP_LOSS))
+                return seq
+
+        arr = max(t_dep + lat_ns, self.window_end)
+        self.hosts[d].queue.push(
+            Event(arr, EventKind.PACKET, src_host=s, seq=seq, data=size_bytes)
+        )
+        return seq
+
+    def inbound(self, dst_host: Host, ev: Event) -> None:
+        """Steps 5a-5c: down bucket, CoDel, schedule delivery."""
+        size_bytes: int = ev.data
+        bits = (size_bytes + FRAME_OVERHEAD_BYTES) * 8
+        t_deliver = dst_host.down_bucket.charge(ev.time, bits)
+        sojourn = t_deliver - ev.time
+        if dst_host.codel.offer(t_deliver, sojourn):
+            self.event_log.append(
+                LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DROP_CODEL)
+            )
+            return
+        self.event_log.append(
+            LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DELIVERED)
+        )
+        dst_host.queue.push(
+            Event(
+                t_deliver,
+                EventKind.DELIVERY,
+                src_host=ev.src_host,
+                seq=ev.seq,
+                data=Delivery(ev.src_host, ev.seq, size_bytes),
+            )
+        )
+
+    # -- round loop (controller.rs:88-113 + manager.rs:541) ----------------
+
+    def next_event_time(self) -> int:
+        return min((h.queue.next_time() for h in self.hosts), default=stime.NEVER)
+
+    def run(self) -> "SimResult":
+        t0 = wall_time.perf_counter()
+        while True:
+            start = self.next_event_time()
+            if start >= self.stop_time or start == stime.NEVER:
+                break
+            self.window_end = min(start + self.runahead, self.stop_time)
+            for host in self.hosts:  # id order; serial == deterministic
+                host.execute(self.window_end)
+            self.rounds += 1
+        wall = wall_time.perf_counter() - t0
+
+        counters: dict[str, int] = {}
+        for h in self.hosts:
+            for k, v in h.counters.items():
+                counters[k] = counters.get(k, 0) + v
+        return SimResult(
+            sim_time_ns=self.stop_time,
+            wall_seconds=wall,
+            rounds=self.rounds,
+            event_log=self.event_log,
+            counters=counters,
+            per_host_counters=[dict(h.counters) for h in self.hosts],
+        )
+
+
+def _start_app(host: Host, app) -> None:
+    host._current_app = app
+    app.on_start(host)
+
+
+@dataclasses.dataclass
+class SimResult:
+    sim_time_ns: int
+    wall_seconds: float
+    rounds: int
+    event_log: list[LogRecord]
+    counters: dict[str, int]
+    per_host_counters: list[dict[str, int]]
+
+    def log_tuples(self) -> list[tuple[int, int, int, int, int, int]]:
+        """Canonical ordered event log for determinism diffs."""
+        return sorted(r.as_tuple() for r in self.event_log)
+
+    @property
+    def sim_seconds_per_wall_second(self) -> float:
+        return (self.sim_time_ns / 1e9) / max(self.wall_seconds, 1e-9)

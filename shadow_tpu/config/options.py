@@ -218,6 +218,11 @@ class ConfigOptions:
         for name, h in sorted(hosts_doc.items()):
             merged = {**defaults, **(h or {})}
             count = int(merged.pop("count", 1))
+            if count > 1 and merged.get("ip_addr") is not None:
+                raise ConfigError(
+                    f"host {name!r}: ip_addr cannot be combined with count > 1 "
+                    "(the replicas would collide on the same IP)"
+                )
             base = _parse_host(name, merged)
             if count == 1:
                 hosts.append(base)
@@ -259,6 +264,11 @@ class ConfigOptions:
                     value = units.parse_time(value)
                 elif field in self._BYTE_FIELDS:
                     value = units.parse_bytes(value)
+                elif field == "tpu_mesh_shape":
+                    if isinstance(value, str):
+                        value = tuple(int(x) for x in value.split(",") if x)
+                    else:
+                        value = tuple(int(x) for x in value)
                 else:
                     current = getattr(target, field)
                     if isinstance(current, bool):

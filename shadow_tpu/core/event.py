@@ -24,10 +24,19 @@ from typing import Any, Callable, Optional
 
 class EventKind(enum.IntEnum):
     """Discriminant part of the event order (packet < local, as in the
-    reference where ``EventData::Packet`` sorts first)."""
+    reference where ``EventData::Packet`` sorts first).
+
+    DELIVERY is a third kind (not in the reference, which uses closures):
+    post-bandwidth datagram deliveries to the app layer.  It has its own
+    discriminant so its keys — ``(time, DELIVERY, packet_src, packet_seq)``
+    — live in a separate space from timer/task keys ``(time, LOCAL,
+    self_host, local_seq)``; on a self-send the two spaces could otherwise
+    collide and make the total order ambiguous, which the TPU backend's
+    ``lax.sort`` replay cannot reproduce."""
 
     PACKET = 0
     LOCAL = 1
+    DELIVERY = 2
 
 
 @dataclasses.dataclass(frozen=True)

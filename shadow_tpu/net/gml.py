@@ -70,7 +70,9 @@ class _Parser:
         out: dict[str, Any] = {}
         while True:
             kind, val = self.next()
-            if kind == "rbrack" or kind is None:
+            if kind is None:
+                raise GmlError("unexpected end of input: unbalanced '['")
+            if kind == "rbrack":
                 return out
             if kind != "key":
                 raise GmlError(f"expected key, got {val!r}")

@@ -179,3 +179,17 @@ hosts:
     )
     p0, p1 = cfg.hosts[0].processes[0], cfg.hosts[1].processes[0]
     assert p0.args is not p1.args and p0.environment is not p1.environment
+
+
+def test_ip_addr_with_count_rejected():
+    with pytest.raises(ConfigError, match="count > 1"):
+        ConfigOptions.from_yaml(
+            "general: {stop_time: 1s}\n"
+            "hosts: {relay: {count: 3, ip_addr: 11.0.0.5, processes: []}}"
+        )
+
+
+def test_mesh_shape_override_coercion():
+    cfg = ConfigOptions.from_yaml(BASIC_YAML)
+    cfg.apply_overrides({"experimental.tpu_mesh_shape": "2,4"})
+    assert cfg.experimental.tpu_mesh_shape == (2, 4)

@@ -1,0 +1,258 @@
+"""CPU reference backend: end-to-end sims, determinism, conservation."""
+
+import pytest
+
+from shadow_tpu.backend.cpu_engine import (
+    DELIVERED,
+    DROP_CODEL,
+    DROP_LOSS,
+    CpuEngine,
+)
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.core import time as stime
+from shadow_tpu.net.codel import CoDel, TARGET_NS
+from shadow_tpu.net.token_bucket import TokenBucket
+
+
+# ---- scalar components -----------------------------------------------------
+
+
+def test_token_bucket_departures():
+    # 1000 bits per 1ms interval, burst 2000
+    tb = TokenBucket(rate=1000, burst=2000, interval=1_000_000)
+    assert tb.charge(0, 1500) == 0  # burst covers it
+    assert tb.charge(0, 1000) == 1_000_000  # 500 left, wait 1 refill
+    # steady state: one 1000-bit packet per interval
+    assert tb.charge(0, 1000) == 2_000_000
+    # large gap refills to burst
+    assert tb.charge(10_000_000, 2000) == 10_000_000
+
+
+def test_token_bucket_unlimited_and_oversize():
+    tb = TokenBucket(rate=0, burst=0)
+    assert tb.charge(5, 10**9) == 5  # rate 0 = unlimited
+    tb2 = TokenBucket(rate=100, burst=150, interval=1_000_000)
+    # oversize packet (300 > burst) waits for enough cumulative refills
+    d = tb2.charge(0, 300)
+    assert d == 2_000_000  # 150 + 2*100 >= 300 at refill #2
+    assert tb2.tokens == 0
+
+
+def test_codel_no_drop_under_target():
+    c = CoDel()
+    for i in range(100):
+        assert not c.offer(i * 1_000_000, TARGET_NS - 1)
+
+
+def test_codel_drops_after_sustained_excess():
+    c = CoDel()
+    t = 0
+    drops = 0
+    for i in range(300):
+        t = i * 1_000_000  # 1ms apart
+        if c.offer(t, TARGET_NS * 2):
+            drops += 1
+    assert drops > 0  # sustained 20ms sojourn must trigger drops
+    # and recovery: once sojourn drops, no more drops
+    c2 = CoDel()
+    for i in range(300):
+        c2.offer(i * 1_000_000, TARGET_NS * 2)
+    assert not c2.offer(301 * 1_000_000, 0)
+    assert not c2.dropping
+
+
+# ---- end-to-end ------------------------------------------------------------
+
+PING_YAML = """
+general: {stop_time: 5s, seed: 42}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  client:
+    network_node_id: 0
+    processes: [{path: ping, args: [--peer, server, --count, "3", --interval, 1s]}]
+  server:
+    network_node_id: 0
+    processes: [{path: ping}]
+"""
+
+
+def test_ping_end_to_end():
+    res = CpuEngine(ConfigOptions.from_yaml(PING_YAML)).run()
+    assert res.counters["ping_sent"] == 3
+    assert res.counters["ping_echoed"] == 3
+    assert res.counters["ping_recv"] == 3
+    # every packet delivered (no loss configured)
+    assert all(r.outcome == DELIVERED for r in res.event_log)
+    assert len(res.event_log) == 6  # 3 requests + 3 echoes
+    # echo arrives one latency (1ms) + processing after request delivery
+    times = sorted(r.time for r in res.event_log)
+    assert times[0] >= stime.NANOS_PER_SEC  # first send at 1s + latency
+
+
+PHOLD_YAML = """
+general: {stop_time: 2s, seed: 7}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 2 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "2 ms" ]
+        edge [ source 0 target 1 latency "5 ms" ]
+        edge [ source 1 target 2 latency "5 ms" ]
+        edge [ source 0 target 2 latency "8 ms" ]
+        edge [ source 1 target 1 latency "2 ms" ]
+        edge [ source 2 target 2 latency "2 ms" ]
+      ]
+hosts:
+  a: {network_node_id: 0, processes: [{path: phold, args: [--messages, "4"]}]}
+  b: {network_node_id: 1, processes: [{path: phold, args: [--messages, "4"]}]}
+  c: {network_node_id: 2, processes: [{path: phold, args: [--messages, "4"]}]}
+"""
+
+
+def test_phold_runs_and_conserves_messages():
+    res = CpuEngine(ConfigOptions.from_yaml(PHOLD_YAML)).run()
+    assert res.counters["phold_hops"] > 50  # 12 messages bouncing for 2s
+    assert all(r.outcome == DELIVERED for r in res.event_log)
+    # conservation: in-flight messages = 12 at all times; the number of
+    # deliveries equals the number of sends that arrived before stop
+    assert res.rounds > 100
+
+
+def test_determinism_same_seed_identical_log():
+    cfg1 = ConfigOptions.from_yaml(PHOLD_YAML)
+    cfg2 = ConfigOptions.from_yaml(PHOLD_YAML)
+    log1 = CpuEngine(cfg1).run().log_tuples()
+    log2 = CpuEngine(cfg2).run().log_tuples()
+    assert log1 == log2
+    assert len(log1) > 100
+
+
+def test_different_seed_different_schedule():
+    cfg1 = ConfigOptions.from_yaml(PHOLD_YAML)
+    cfg2 = ConfigOptions.from_yaml(PHOLD_YAML)
+    cfg2.general.seed = 8
+    log1 = CpuEngine(cfg1).run().log_tuples()
+    log2 = CpuEngine(cfg2).run().log_tuples()
+    assert log1 != log2
+
+
+LOSSY_YAML = """
+general: {stop_time: 2s, seed: 3}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        node [ id 1 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        edge [ source 0 target 1 latency "10 ms" packet_loss 0.4 ]
+      ]
+hosts:
+  tx: {network_node_id: 0, processes: [{path: tgen-client, args: [--server, rx, --interval, 10ms]}]}
+  rx: {network_node_id: 1, processes: [{path: tgen-server}]}
+"""
+
+
+def test_loss_is_applied_and_deterministic():
+    res = CpuEngine(ConfigOptions.from_yaml(LOSSY_YAML)).run()
+    outcomes = [r.outcome for r in res.event_log]
+    n_loss = outcomes.count(DROP_LOSS)
+    n_del = outcomes.count(DELIVERED)
+    total = n_loss + n_del
+    assert total > 150  # ~199 sends in 2s
+    # 40% loss within generous bounds
+    assert 0.25 < n_loss / total < 0.55
+    res2 = CpuEngine(ConfigOptions.from_yaml(LOSSY_YAML)).run()
+    assert res.log_tuples() == res2.log_tuples()
+
+
+def test_bootstrap_period_suppresses_loss():
+    cfg = ConfigOptions.from_yaml(LOSSY_YAML)
+    cfg.general.bootstrap_end_time = cfg.general.stop_time  # whole run
+    res = CpuEngine(cfg).run()
+    assert all(r.outcome == DELIVERED for r in res.event_log)
+
+
+BOTTLENECK_YAML = """
+general: {stop_time: 1s, seed: 5}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Mbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+      ]
+hosts:
+  blaster: {network_node_id: 0, processes: [{path: tgen-client, args: [--server, sink, --interval, 1ms, --size, "1400"]}]}
+  sink: {network_node_id: 0}
+"""
+
+
+def test_bandwidth_bottleneck_triggers_codel():
+    # 1400B/ms ≈ 11.2 Mbit/s into a 1 Mbit/s downlink: sojourn explodes,
+    # CoDel must start shedding
+    res = CpuEngine(ConfigOptions.from_yaml(BOTTLENECK_YAML)).run()
+    outcomes = [r.outcome for r in res.event_log]
+    assert outcomes.count(DROP_CODEL) > 0
+    assert outcomes.count(DELIVERED) > 0
+    # deliveries are spaced by the downlink rate: ~1 Mbit/s = 125 B/ms →
+    # a 1424B frame every ~11.4ms; check the tail spacing
+    times = [r.time for r in res.event_log if r.outcome == DELIVERED]
+    gaps = [b - a for a, b in zip(times[-10:], times[-9:])]
+    assert all(g >= 10 * stime.NANOS_PER_MILLI for g in gaps)
+
+
+def test_hosts_without_processes_allowed():
+    res = CpuEngine(
+        ConfigOptions.from_yaml(
+            "general: {stop_time: 1s}\nhosts:\n  idle1: {}\n  idle2: {}\n"
+        )
+    ).run()
+    assert res.event_log == []
+    assert res.rounds == 0
+
+
+def test_self_send_delivery_vs_timer_no_key_collision():
+    # a host streaming to itself mixes DELIVERY and LOCAL events at the same
+    # times; the run must stay deterministic (distinct kind spaces)
+    yaml = """
+general: {stop_time: 1s, seed: 2}
+hosts:
+  solo:
+    network_node_id: 0
+    processes: [{path: tgen-client, args: [--server, solo, --interval, 1ms]}]
+"""
+    r1 = CpuEngine(ConfigOptions.from_yaml(yaml)).run()
+    r2 = CpuEngine(ConfigOptions.from_yaml(yaml)).run()
+    assert r1.log_tuples() == r2.log_tuples()
+    assert r1.counters["tgen_recv_bytes"] > 0
+
+
+def test_unknown_model_args_rejected():
+    with pytest.raises(ValueError, match="unknown model args"):
+        CpuEngine(
+            ConfigOptions.from_yaml(
+                "general: {stop_time: 1s}\n"
+                "hosts: {a: {processes: [{path: phold, args: [--mesages, '8']}]}}"
+            )
+        )
+
+
+def test_out_of_range_numeric_peer_rejected():
+    with pytest.raises(ValueError, match="out of range"):
+        CpuEngine(
+            ConfigOptions.from_yaml(
+                "general: {stop_time: 1s}\n"
+                "hosts:\n"
+                "  a: {processes: [{path: ping, args: [--peer, '99']}]}\n"
+                "  b: {}\n"
+            )
+        ).run()

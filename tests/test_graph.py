@@ -231,3 +231,8 @@ def test_bare_numeric_latency_rejected():
         NetworkGraph.from_gml(
             "graph [ node [ id 0 ] edge [ source 0 target 0 latency 1.5 ] ]"
         )
+
+
+def test_gml_truncated_input_rejected():
+    with pytest.raises(gml.GmlError, match="unbalanced"):
+        gml.parse_gml("graph [ node [ id 0 ] edge [ source 0 target 1")
