@@ -167,39 +167,22 @@ class CpuEngine:
         self.stop_time = cfg.general.stop_time
         self.bootstrap_end = cfg.general.bootstrap_end_time
 
-        # topology
-        g = cfg.network.graph
-        if g.type == "1_gbit_switch":
-            self.graph = NetworkGraph.one_gbit_switch()
-        elif g.inline is not None:
-            self.graph = NetworkGraph.from_gml(g.inline, cfg.network.use_shortest_path)
-        else:
-            self.graph = NetworkGraph.from_file(g.file_path, cfg.network.use_shortest_path)
+        from .setup import build_world
 
-        # hosts (sorted by hostname, ids in that order — deterministic)
-        self.ips = IpAssignment()
-        self.hostname_to_id: dict[str, int] = {}
-        self.hosts: list[Host] = []
-        node_map: dict[int, int] = {}
-        for hid, hopt in enumerate(cfg.hosts):
-            self.hostname_to_id[hopt.hostname] = hid
-            self.ips.assign(hid, hopt.ip_addr)
-            node_map[hid] = hopt.network_node_id
-            nb_up, nb_down = self.graph.node_bandwidth(hopt.network_node_id)
-            bw_up = hopt.bandwidth_up if hopt.bandwidth_up is not None else nb_up
-            bw_down = hopt.bandwidth_down if hopt.bandwidth_down is not None else nb_down
-            if bw_up is None or bw_down is None:
-                raise ValueError(
-                    f"host {hopt.hostname!r}: no bandwidth on host or graph node"
-                )
-            self.hosts.append(Host(hid, hopt.hostname, self, bw_up, bw_down))
-        self.routing = RoutingInfo(self.graph, node_map)
+        (
+            self.graph,
+            self.ips,
+            self.hostname_to_id,
+            self.routing,
+            bw_up_arr,
+            bw_dn_arr,
+            self.runahead,
+        ) = build_world(cfg)
         self.node_index = self.routing.host_node_index
-
-        # runahead: min latency over used paths, floored by config
-        min_lat = self.routing.min_used_latency_ns()
-        floor = cfg.experimental.runahead or 0
-        self.runahead = max(min_lat, floor, 1)
+        self.hosts = [
+            Host(hid, hopt.hostname, self, int(bw_up_arr[hid]), int(bw_dn_arr[hid]))
+            for hid, hopt in enumerate(cfg.hosts)
+        ]
 
         # app models scheduled at their start times
         for hid, hopt in enumerate(cfg.hosts):
@@ -218,20 +201,9 @@ class CpuEngine:
     # -- DNS --------------------------------------------------------------
 
     def resolve(self, hostname: str) -> int:
-        if hostname in self.hostname_to_id:
-            return self.hostname_to_id[hostname]
-        hid = self.ips.host_for_ip(hostname)
-        if hid is not None:
-            return hid
-        try:
-            hid = int(hostname)
-        except ValueError:
-            raise ValueError(f"unknown hostname {hostname!r}") from None
-        if not 0 <= hid < len(self.hosts):
-            raise ValueError(
-                f"host id {hid} out of range (have {len(self.hosts)} hosts)"
-            )
-        return hid
+        from .setup import resolve_host
+
+        return resolve_host(hostname, self.hostname_to_id, self.ips, len(self.hosts))
 
     # -- packet path (SEMANTICS.md lifecycle) ------------------------------
 

@@ -1,0 +1,67 @@
+"""Shared engine setup: config -> topology/routing/bandwidths/runahead.
+
+Both backends build their world through these helpers so the cross-backend
+bit-parity guarantee can't be broken by one engine's setup drifting from the
+other's (host ordering, IP assignment, bandwidth fallback, runahead formula,
+hostname resolution are all single-sourced here).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config.options import ConfigOptions
+from ..net.graph import IpAssignment, NetworkGraph, RoutingInfo
+
+
+def build_graph(cfg: ConfigOptions) -> NetworkGraph:
+    g = cfg.network.graph
+    if g.type == "1_gbit_switch":
+        return NetworkGraph.one_gbit_switch()
+    if g.inline is not None:
+        return NetworkGraph.from_gml(g.inline, cfg.network.use_shortest_path)
+    return NetworkGraph.from_file(g.file_path, cfg.network.use_shortest_path)
+
+
+def build_world(cfg: ConfigOptions):
+    """(graph, ips, hostname_to_id, routing, bw_up[N], bw_dn[N], runahead)."""
+    graph = build_graph(cfg)
+    ips = IpAssignment()
+    hostname_to_id = {h.hostname: i for i, h in enumerate(cfg.hosts)}
+    node_map: dict[int, int] = {}
+    n = len(cfg.hosts)
+    bw_up = np.zeros(n, dtype=np.int64)
+    bw_dn = np.zeros(n, dtype=np.int64)
+    for hid, hopt in enumerate(cfg.hosts):
+        ips.assign(hid, hopt.ip_addr)
+        node_map[hid] = hopt.network_node_id
+        nb_up, nb_down = graph.node_bandwidth(hopt.network_node_id)
+        up = hopt.bandwidth_up if hopt.bandwidth_up is not None else nb_up
+        dn = hopt.bandwidth_down if hopt.bandwidth_down is not None else nb_down
+        if up is None or dn is None:
+            raise ValueError(
+                f"host {hopt.hostname!r}: no bandwidth on host or graph node"
+            )
+        bw_up[hid], bw_dn[hid] = up, dn
+    routing = RoutingInfo(graph, node_map)
+    floor = cfg.experimental.runahead or 0
+    runahead = max(routing.min_used_latency_ns(), floor, 1)
+    return graph, ips, hostname_to_id, routing, bw_up, bw_dn, runahead
+
+
+def resolve_host(
+    hostname: str, hostname_to_id: dict[str, int], ips: IpAssignment, n: int
+) -> int:
+    """DNS-style resolution: hostname, IP string, or numeric host id."""
+    if hostname in hostname_to_id:
+        return hostname_to_id[hostname]
+    hid = ips.host_for_ip(hostname)
+    if hid is not None:
+        return hid
+    try:
+        hid = int(hostname)
+    except ValueError:
+        raise ValueError(f"unknown hostname {hostname!r}") from None
+    if not 0 <= hid < n:
+        raise ValueError(f"host id {hid} out of range (have {n} hosts)")
+    return hid

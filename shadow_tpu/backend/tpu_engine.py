@@ -1,0 +1,295 @@
+"""TPU backend driver: config -> lane state -> device run -> SimResult.
+
+The host-side counterpart of :mod:`shadow_tpu.backend.lanes`: builds the
+device tables and the initial lane state from a :class:`ConfigOptions`
+(mirroring ``CpuEngine``'s setup exactly — same host ordering, IPs, routing,
+runahead, bucket parameters), runs the simulation on the selected JAX
+backend, and reads the results back into the same :class:`SimResult` shape
+the CPU engine produces, so the two backends are drop-in comparable.
+"""
+
+from __future__ import annotations
+
+import time as wall_time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.options import ConfigOptions
+from ..core import time as stime
+from ..models.base import create_model
+from ..models.phold import Phold
+from ..models.tgen import Ping, TgenClient, TgenMesh, TgenServer
+from ..net import codel as codel_mod
+from ..net.token_bucket import bucket_params
+from . import lanes
+from .cpu_engine import LogRecord, SimResult
+
+NEVER = stime.NEVER
+
+
+class LaneCompatError(ValueError):
+    """Raised when a config can't run on the lane backend (fall back to
+    ``experimental.network_backend: cpu``)."""
+
+
+class TpuEngine:
+    def __init__(
+        self,
+        cfg: ConfigOptions,
+        log_capacity: Optional[int] = None,
+        strict_capacity: bool = True,
+    ) -> None:
+        cfg.validate()
+        self.cfg = cfg
+        self.strict_capacity = strict_capacity
+        n = len(cfg.hosts)
+
+        # topology (single-sourced with CpuEngine via backend.setup)
+        from .setup import build_world
+
+        (
+            self.graph,
+            self.ips,
+            self.hostname_to_id,
+            self.routing,
+            bw_up,
+            bw_dn,
+            runahead,
+        ) = build_world(cfg)
+
+        # --- per-lane model tables and initial events ---------------------
+        model = np.zeros(n, dtype=np.int32)
+        p_size = np.zeros(n, dtype=np.int32)
+        p_interval = np.ones(n, dtype=np.int64)
+        p_peer = np.zeros(n, dtype=np.int32)
+        p_count = np.zeros(n, dtype=np.int64)
+        p_stride = np.ones(n, dtype=np.int64)
+        init_events: list[tuple[int, int, int, int, int, int]] = []  # lane,t,kind,src,seq,size
+        local_seq0 = np.ones(n, dtype=np.int64)
+
+        for hid, hopt in enumerate(cfg.hosts):
+            if len(hopt.processes) > 1:
+                raise LaneCompatError(
+                    f"host {hopt.hostname!r} has {len(hopt.processes)} processes; "
+                    "the lane backend supports at most one per host"
+                )
+            if not hopt.processes:
+                model[hid] = lanes.M_NONE
+                continue
+            proc = hopt.processes[0]
+            app = create_model(proc.path, list(proc.args))
+            t0 = proc.start_time
+            if isinstance(app, Phold):
+                model[hid] = lanes.M_PHOLD
+                p_size[hid] = app.size
+                for i in range(app.messages):
+                    init_events.append((hid, t0, lanes.LOCAL, hid, i, 0))
+                local_seq0[hid] = max(app.messages, 1)
+            elif isinstance(app, TgenMesh):
+                model[hid] = lanes.M_TGEN_MESH
+                p_size[hid] = app.size
+                p_interval[hid] = app.interval
+                p_stride[hid] = app.stride
+                init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
+            elif isinstance(app, TgenClient):
+                model[hid] = lanes.M_TGEN_CLIENT
+                p_size[hid] = app.size
+                p_interval[hid] = app.interval
+                p_peer[hid] = self._resolve(app.server, n)
+                init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
+            elif isinstance(app, TgenServer):
+                model[hid] = lanes.M_TGEN_SERVER
+                init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
+            elif isinstance(app, Ping):
+                if app.peer is None:
+                    model[hid] = lanes.M_PING_SERVER
+                else:
+                    model[hid] = lanes.M_PING_CLIENT
+                    p_peer[hid] = self._resolve(app.peer, n)
+                    p_count[hid] = app.count_target
+                    p_interval[hid] = app.interval
+                p_size[hid] = app.size
+                init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
+            else:  # pragma: no cover - registry and this list must stay in sync
+                raise LaneCompatError(
+                    f"model {proc.path!r} is not lane-compiled yet; use the cpu backend"
+                )
+
+        capacity = cfg.experimental.tpu_lane_queue_capacity
+        max_init = max(
+            (sum(1 for e in init_events if e[0] == hid) for hid in range(n)),
+            default=0,
+        )
+        if capacity < max_init + 8:
+            raise LaneCompatError(
+                f"tpu_lane_queue_capacity={capacity} too small for {max_init} "
+                "initial events per lane (+8 headroom)"
+            )
+
+        if log_capacity is None:
+            log_capacity = 200_000
+        self.params = lanes.LaneParams(
+            n_lanes=n,
+            capacity=capacity,
+            pops_per_iter=cfg.experimental.tpu_events_per_round,
+            log_capacity=log_capacity,
+            seed=cfg.general.seed,
+            stop_time=cfg.general.stop_time,
+            bootstrap_end=cfg.general.bootstrap_end_time,
+            runahead=runahead,
+        )
+
+        node_idx, lat, thresh = self.routing.device_tables()
+        up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)
+        dn = np.array([bucket_params(int(b)) for b in bw_dn], dtype=np.int64)
+        self.tables = lanes.LaneTables(
+            node_of=jnp.asarray(node_idx, dtype=jnp.int32),
+            lat=jnp.asarray(lat),
+            thresh=jnp.asarray(thresh),
+            up_rate=jnp.asarray(up[:, 0]),
+            up_burst=jnp.asarray(up[:, 1]),
+            dn_rate=jnp.asarray(dn[:, 0]),
+            dn_burst=jnp.asarray(dn[:, 1]),
+            model=jnp.asarray(model),
+            p_size=jnp.asarray(p_size),
+            p_interval=jnp.asarray(p_interval),
+            p_peer=jnp.asarray(p_peer),
+            p_count=jnp.asarray(p_count),
+            p_stride=jnp.asarray(p_stride),
+            codel_div=jnp.asarray(np.array(codel_mod.CODEL_DIV, dtype=np.int64)),
+        )
+        self._init_events = init_events
+        self._local_seq0 = local_seq0
+        self._interval = lanes.DEFAULT_INTERVAL_NS
+
+    def _resolve(self, hostname: str, n: int) -> int:
+        from .setup import resolve_host
+
+        return resolve_host(hostname, self.hostname_to_id, self.ips, n)
+
+    # -- state construction ------------------------------------------------
+
+    def initial_state(self) -> lanes.LaneState:
+        p = self.params
+        n, c = p.n_lanes, p.capacity
+        q_time = np.full((n, c), NEVER, dtype=np.int64)
+        q_kind = np.zeros((n, c), dtype=np.int32)
+        q_src = np.zeros((n, c), dtype=np.int32)
+        q_seq = np.zeros((n, c), dtype=np.int64)
+        q_size = np.zeros((n, c), dtype=np.int32)
+        fill = np.zeros(n, dtype=np.int64)
+        for lane, t, kind, src, seq, size in self._init_events:
+            i = fill[lane]
+            q_time[lane, i] = t
+            q_kind[lane, i] = kind
+            q_src[lane, i] = src
+            q_seq[lane, i] = seq
+            q_size[lane, i] = size
+            fill[lane] += 1
+
+        up_burst = np.asarray(self.tables.up_burst)
+        dn_burst = np.asarray(self.tables.dn_burst)
+        z64 = np.zeros(n, dtype=np.int64)
+        return lanes.LaneState(
+            q_time=jnp.asarray(q_time),
+            q_kind=jnp.asarray(q_kind),
+            q_src=jnp.asarray(q_src),
+            q_seq=jnp.asarray(q_seq),
+            q_size=jnp.asarray(q_size),
+            send_seq=jnp.asarray(z64),
+            local_seq=jnp.asarray(self._local_seq0),
+            app_draws=jnp.asarray(z64),
+            up_tokens=jnp.asarray(up_burst),
+            up_next_refill=jnp.full(n, self._interval, dtype=jnp.int64),
+            dn_tokens=jnp.asarray(dn_burst),
+            dn_next_refill=jnp.full(n, self._interval, dtype=jnp.int64),
+            cd_first_above=jnp.asarray(z64),
+            cd_drop_next=jnp.asarray(z64),
+            cd_drop_count=jnp.zeros(n, dtype=jnp.int32),
+            cd_dropping=jnp.zeros(n, dtype=bool),
+            m_sent=jnp.asarray(z64),
+            m_peer_offset=jnp.asarray(z64),
+            n_delivered=jnp.asarray(z64),
+            n_loss=jnp.asarray(z64),
+            n_codel=jnp.asarray(z64),
+            n_queue=jnp.asarray(z64),
+            recv_bytes=jnp.asarray(z64),
+            n_sends=jnp.asarray(z64),
+            n_hops=jnp.asarray(z64),
+            log=jnp.zeros((max(self.params.log_capacity, 1), 6), dtype=jnp.int64),
+            log_count=jnp.int64(0),
+            log_lost=jnp.int64(0),
+            rounds=jnp.int64(0),
+            now_window_end=jnp.int64(0),
+        )
+
+    # -- running -----------------------------------------------------------
+
+    def run(self, mode: str = "device") -> SimResult:
+        """``mode='device'``: one fused while_loop on the accelerator;
+        ``mode='step'``: one device call per round (debuggable, pausable)."""
+        state = self.initial_state()
+        t0 = wall_time.perf_counter()
+        if mode == "device":
+            run_fn = lanes.make_run_fn(self.params, self.tables)
+            state = run_fn(state)
+            state = jax.block_until_ready(state)
+        else:
+            round_fn = lanes.make_round_fn(self.params, self.tables)
+            while True:
+                state, done = round_fn(state)
+                if bool(done):
+                    break
+        wall = wall_time.perf_counter() - t0
+        return self._collect(state, wall)
+
+    def _collect(self, s: lanes.LaneState, wall: float) -> SimResult:
+        n_queue_drops = int(np.asarray(s.n_queue).sum())
+        if n_queue_drops and self.strict_capacity:
+            raise RuntimeError(
+                f"{n_queue_drops} events dropped on lane-queue overflow; raise "
+                "experimental.tpu_lane_queue_capacity (results would silently "
+                "diverge from the cpu backend)"
+            )
+        log_count = int(s.log_count)
+        log_lost = int(s.log_lost)
+        if log_lost:
+            raise RuntimeError(
+                f"device event log overflowed ({log_lost} records lost); "
+                "raise log_capacity or disable logging"
+            )
+        rows = np.asarray(s.log[: min(log_count, self.params.log_capacity)])
+        event_log = [
+            LogRecord(int(t), int(src), int(dst), int(seq), int(size), int(out))
+            for t, src, dst, seq, size, out in rows
+        ]
+        model = np.asarray(self.tables.model)
+        recv_bytes = np.asarray(s.recv_bytes)
+        delivered = np.asarray(s.n_delivered)
+        counters: dict[str, int] = {}
+
+        def add(key: str, val: int) -> None:
+            if val:
+                counters[key] = counters.get(key, 0) + int(val)
+
+        tgen_mask = np.isin(model, [lanes.M_TGEN_MESH, lanes.M_TGEN_CLIENT, lanes.M_TGEN_SERVER])
+        add("tgen_recv_bytes", int(recv_bytes[tgen_mask].sum()))
+        hops = np.asarray(s.n_hops)
+        add("phold_hops", int(hops[model == lanes.M_PHOLD].sum()))
+        add("lane_delivered", int(delivered.sum()))
+        add("lane_drop_loss", int(np.asarray(s.n_loss).sum()))
+        add("lane_drop_codel", int(np.asarray(s.n_codel).sum()))
+        add("lane_drop_queue", int(np.asarray(s.n_queue).sum()))
+        add("lane_sends", int(np.asarray(s.n_sends).sum()))
+
+        return SimResult(
+            sim_time_ns=self.params.stop_time,
+            wall_seconds=wall,
+            rounds=int(s.rounds),
+            event_log=event_log,
+            counters=counters,
+            per_host_counters=[],
+        )

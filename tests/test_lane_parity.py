@@ -1,0 +1,171 @@
+"""TPU lane backend vs CPU reference: bit-identical event logs.
+
+This is the determinism gate the reference enforces with its determinism
+test suite (src/test/determinism/CMakeLists.txt) — here applied *across
+backends*: the batched JAX lane engine must produce exactly the event log
+of the scalar Python engine for every supported workload.
+"""
+
+import pytest
+
+from shadow_tpu.backend.cpu_engine import CpuEngine
+from shadow_tpu.backend.tpu_engine import LaneCompatError, TpuEngine
+from shadow_tpu.config.options import ConfigOptions
+
+
+def both_logs(yaml: str, mode: str = "step"):
+    cpu = CpuEngine(ConfigOptions.from_yaml(yaml)).run()
+    tpu = TpuEngine(ConfigOptions.from_yaml(yaml)).run(mode=mode)
+    return cpu, tpu
+
+
+PHOLD_SMALL = """
+general: {stop_time: 500ms, seed: 7}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "2 ms" ]
+        edge [ source 0 target 1 latency "5 ms" ]
+        edge [ source 1 target 1 latency "2 ms" ]
+      ]
+hosts:
+  a: {network_node_id: 0, processes: [{path: phold, args: [--messages, "3"]}]}
+  b: {network_node_id: 1, processes: [{path: phold, args: [--messages, "3"]}]}
+  c: {network_node_id: 1, processes: [{path: phold, args: [--messages, "2"]}]}
+"""
+
+
+def test_phold_parity():
+    cpu, tpu = both_logs(PHOLD_SMALL)
+    assert len(cpu.event_log) > 50
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+def test_phold_parity_device_mode():
+    cpu, tpu = both_logs(PHOLD_SMALL, mode="device")
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+TGEN_PAIR = """
+general: {stop_time: 300ms, seed: 3}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "10 Mbit" host_bandwidth_down "10 Mbit" ]
+        node [ id 1 host_bandwidth_up "10 Mbit" host_bandwidth_down "10 Mbit" ]
+        edge [ source 0 target 1 latency "10 ms" packet_loss 0.2 ]
+      ]
+hosts:
+  tx: {network_node_id: 0, processes: [{path: tgen-client, args: [--server, rx, --interval, 5ms, --size, "600"]}]}
+  rx: {network_node_id: 1, processes: [{path: tgen-server}]}
+"""
+
+
+def test_tgen_lossy_parity():
+    cpu, tpu = both_logs(TGEN_PAIR)
+    assert len(cpu.event_log) > 30
+    assert any(r.outcome == 1 for r in cpu.event_log)  # some loss happened
+    assert cpu.log_tuples() == tpu.log_tuples()
+    assert cpu.counters["tgen_recv_bytes"] == tpu.counters["tgen_recv_bytes"]
+
+
+MESH = """
+general: {stop_time: 200ms, seed: 11}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 0 latency "3 ms" ]
+      ]
+hosts:
+  m: {count: 5, network_node_id: 0, processes: [{path: tgen-mesh, args: [--interval, 7ms, --size, "400"]}]}
+"""
+
+
+def test_tgen_mesh_parity():
+    cpu, tpu = both_logs(MESH)
+    assert len(cpu.event_log) > 50
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+PING = """
+general: {stop_time: 2s, seed: 5}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  cli: {network_node_id: 0, processes: [{path: ping, args: [--peer, srv, --count, "4", --interval, 250ms]}]}
+  srv: {network_node_id: 0, processes: [{path: ping}]}
+"""
+
+
+def test_ping_parity():
+    cpu, tpu = both_logs(PING)
+    assert len(cpu.event_log) == 8  # 4 requests + 4 echoes
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+BOTTLENECK = """
+general: {stop_time: 400ms, seed: 9}
+experimental: {tpu_lane_queue_capacity: 1024}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "20 Mbit" host_bandwidth_down "2 Mbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+      ]
+hosts:
+  blast: {network_node_id: 0, processes: [{path: tgen-client, args: [--server, sink, --interval, 1ms, --size, "1200"]}]}
+  sink: {network_node_id: 0}
+"""
+
+
+def test_codel_bottleneck_parity():
+    # saturated downlink: token-bucket queueing + CoDel drops on both backends
+    cpu, tpu = both_logs(BOTTLENECK)
+    assert any(r.outcome == 2 for r in cpu.event_log)  # codel drops happened
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+def test_bootstrap_parity():
+    yaml = TGEN_PAIR.replace(
+        "general: {stop_time: 300ms, seed: 3}",
+        "general: {stop_time: 300ms, seed: 3, bootstrap_end_time: 150ms}",
+    )
+    cpu, tpu = both_logs(yaml)
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+def test_lane_compat_gate():
+    with pytest.raises(LaneCompatError, match="at most one"):
+        TpuEngine(
+            ConfigOptions.from_yaml(
+                "general: {stop_time: 1s}\n"
+                "hosts: {a: {processes: [{path: phold}, {path: phold}]}}"
+            )
+        )
+
+
+def test_phold_hops_counter_parity():
+    cpu, tpu = both_logs(PHOLD_SMALL)
+    assert cpu.counters["phold_hops"] == tpu.counters["phold_hops"]
+
+
+def test_overflow_raises_loudly():
+    yaml = BOTTLENECK.replace("tpu_lane_queue_capacity: 1024", "tpu_lane_queue_capacity: 16")
+    from shadow_tpu.backend.tpu_engine import TpuEngine as TE
+
+    with pytest.raises(RuntimeError, match="lane-queue overflow"):
+        TE(ConfigOptions.from_yaml(yaml)).run(mode="step")
