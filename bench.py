@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Headline benchmark: sim-seconds per wall-second on the 10k-host tgen
+all-to-all mesh (BASELINE.md north-star config #4), TPU lane backend.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` divides by the reference's best in-repo measured
+sim/wall speedup (6.38x, fork Ethereum-testnet study, BASELINE.md) — the
+only quantitative end-to-end number the reference publishes.
+
+Env knobs (for local runs; the driver uses the defaults):
+  SHADOW_TPU_BENCH_HOSTS        lanes in the mesh   (default 10000)
+  SHADOW_TPU_BENCH_SIM_SECONDS  simulated duration  (default 10)
+"""
+
+import json
+import os
+import time
+
+import shadow_tpu  # noqa: F401  (enables jax x64 mode)
+from shadow_tpu.backend import lanes
+from shadow_tpu.backend.tpu_engine import TpuEngine
+from shadow_tpu.config.options import ConfigOptions
+
+REFERENCE_SPEEDUP = 6.38  # BASELINE.md: 180 sim-s in 28.23 wall-s
+
+N_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_HOSTS", "10000"))
+SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_SIM_SECONDS", "10"))
+
+# All-to-all mesh: every host sends a 1428 B datagram every 10 ms to a
+# round-robin peer over a 10 ms-latency switch (lookahead window = 10 ms).
+CONFIG = f"""
+general:
+  stop_time: {SIM_SECONDS} s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0  host_bandwidth_up "1 Gbit"  host_bandwidth_down "1 Gbit" ]
+        edge [ source 0  target 0  latency "10 ms" ]
+      ]
+experimental:
+  network_backend: tpu
+hosts:
+  peer:
+    count: {N_HOSTS}
+    network_node_id: 0
+    processes:
+      - path: tgen-mesh
+        args: --interval 10ms --size 1428
+        start_time: 0 s
+"""
+
+
+def main() -> None:
+    cfg = ConfigOptions.from_yaml(CONFIG)
+    engine = TpuEngine(cfg, log_capacity=0)  # logging off on the hot path
+    run_fn = lanes.make_run_fn(engine.params, engine.tables)
+
+    # AOT-compile so the timed run is the steady-state device program
+    import jax
+
+    state = engine.initial_state()
+    compiled = run_fn.lower(state).compile()
+    t0 = time.perf_counter()
+    final = jax.block_until_ready(compiled(state))
+    wall = time.perf_counter() - t0
+
+    result = engine._collect(final, wall)  # raises on queue/log overflow
+    value = result.sim_seconds_per_wall_second
+    print(
+        json.dumps(
+            {
+                "metric": f"sim_seconds_per_wall_second_tgen_mesh_{N_HOSTS}",
+                "value": round(value, 4),
+                "unit": "sim_s/wall_s",
+                "vs_baseline": round(value / REFERENCE_SPEEDUP, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

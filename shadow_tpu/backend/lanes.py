@@ -625,9 +625,9 @@ def make_round_fn(p: LaneParams, tb: LaneTables):
     return jax.jit(_build_round(p, tb))
 
 
-def make_run_fn(p: LaneParams, tb: LaneTables):
-    """Jitted full-simulation run: ``lax.while_loop`` over rounds, entirely
-    on-device — the bench hot path (one device call per simulation)."""
+def _build_full_run(p: LaneParams, tb: LaneTables):
+    """Raw (un-jitted) full-simulation run: ``lax.while_loop`` over rounds,
+    entirely on-device.  Shared by the single-device and sharded drivers."""
     round_fn = _build_round(p, tb)
 
     def full_run(s: LaneState) -> LaneState:
@@ -642,4 +642,10 @@ def make_run_fn(p: LaneParams, tb: LaneTables):
         final, _ = lax.while_loop(cond, body, (s, jnp.bool_(False)))
         return final
 
-    return jax.jit(full_run)
+    return full_run
+
+
+def make_run_fn(p: LaneParams, tb: LaneTables):
+    """Jitted full-simulation run — the bench hot path (one device call per
+    simulation)."""
+    return jax.jit(_build_full_run(p, tb))

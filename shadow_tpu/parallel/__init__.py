@@ -1,0 +1,17 @@
+from .mesh import (
+    HOST_AXIS,
+    make_mesh,
+    make_sharded_round_fn,
+    make_sharded_run_fn,
+    shard_state,
+    state_shardings,
+)
+
+__all__ = [
+    "HOST_AXIS",
+    "make_mesh",
+    "make_sharded_round_fn",
+    "make_sharded_run_fn",
+    "shard_state",
+    "state_shardings",
+]

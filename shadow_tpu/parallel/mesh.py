@@ -1,0 +1,88 @@
+"""Device-mesh sharding of host lanes.
+
+The reference scales by spreading *hosts* over worker threads with work
+stealing (scheduler crate, thread_per_core.rs:17-50); the cross-host packet
+push is a mutex-guarded queue insert (worker.rs:603-615).  The TPU-native
+equivalent: shard the lane axis of the batched simulation state over a
+``jax.sharding.Mesh`` axis (``hosts``), keep the routing tables replicated,
+and let XLA turn the cross-lane event exchange (the sort → rank → scatter in
+``lanes._append_events``) into ICI collectives.  Host-level data parallelism
+becomes SPMD data parallelism; the event exchange is the all-to-all.
+
+Determinism: the sharded program computes the same integer arithmetic and
+the same key sorts as the single-device one, so results are bit-identical
+regardless of mesh shape (tests/test_parallel.py diffs the event logs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..backend import lanes
+
+HOST_AXIS = "hosts"
+
+# LaneState fields that are not per-lane arrays and stay replicated
+_REPLICATED_FIELDS = frozenset(
+    ("log", "log_count", "log_lost", "rounds", "now_window_end")
+)
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = HOST_AXIS) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def state_shardings(mesh: Mesh, axis: str = HOST_AXIS) -> lanes.LaneState:
+    """A LaneState-shaped pytree of NamedShardings: per-lane arrays split on
+    the lane axis, the event log and scalars replicated."""
+    lane = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return lanes.LaneState(
+        **{
+            f: (repl if f in _REPLICATED_FIELDS else lane)
+            for f in lanes.LaneState._fields
+        }
+    )
+
+
+def shard_state(
+    s: lanes.LaneState, mesh: Mesh, axis: str = HOST_AXIS
+) -> lanes.LaneState:
+    n_lanes = s.q_time.shape[0]
+    if n_lanes % mesh.devices.size:
+        raise ValueError(
+            f"n_lanes={n_lanes} not divisible by mesh size {mesh.devices.size}"
+        )
+    return jax.device_put(s, state_shardings(mesh, axis))
+
+
+def make_sharded_round_fn(
+    p: lanes.LaneParams, tb: lanes.LaneTables, mesh: Mesh, axis: str = HOST_AXIS
+):
+    """Jitted one-round advance, lane axis sharded over ``mesh``."""
+    sh = state_shardings(mesh, axis)
+    return jax.jit(
+        lanes._build_round(p, tb),
+        in_shardings=(sh,),
+        out_shardings=(sh, NamedSharding(mesh, P())),
+    )
+
+
+def make_sharded_run_fn(
+    p: lanes.LaneParams, tb: lanes.LaneTables, mesh: Mesh, axis: str = HOST_AXIS
+):
+    """Jitted full-simulation run (while_loop over rounds), sharded."""
+    sh = state_shardings(mesh, axis)
+    return jax.jit(
+        lanes._build_full_run(p, tb), in_shardings=(sh,), out_shardings=sh
+    )
