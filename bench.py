@@ -15,59 +15,24 @@ Env knobs (for local runs; the driver uses the defaults):
 
 import json
 import os
-import time
 
 import shadow_tpu  # noqa: F401  (enables jax x64 mode)
-from shadow_tpu.backend import lanes
 from shadow_tpu.backend.tpu_engine import TpuEngine
-from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.config.presets import flagship_mesh_config
 
 REFERENCE_SPEEDUP = 6.38  # BASELINE.md: 180 sim-s in 28.23 wall-s
 
 N_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_HOSTS", "10000"))
 SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_SIM_SECONDS", "10"))
 
-# All-to-all mesh: every host sends a 1428 B datagram every 10 ms to a
-# round-robin peer over a 10 ms-latency switch (lookahead window = 10 ms).
-CONFIG = f"""
-general:
-  stop_time: {SIM_SECONDS} s
-network:
-  graph:
-    type: gml
-    inline: |
-      graph [
-        node [ id 0  host_bandwidth_up "1 Gbit"  host_bandwidth_down "1 Gbit" ]
-        edge [ source 0  target 0  latency "10 ms" ]
-      ]
-experimental:
-  network_backend: tpu
-hosts:
-  peer:
-    count: {N_HOSTS}
-    network_node_id: 0
-    processes:
-      - path: tgen-mesh
-        args: --interval 10ms --size 1428
-        start_time: 0 s
-"""
-
 
 def main() -> None:
-    cfg = ConfigOptions.from_yaml(CONFIG)
+    cfg = flagship_mesh_config(N_HOSTS, sim_seconds=SIM_SECONDS)
     engine = TpuEngine(cfg, log_capacity=0)  # logging off on the hot path
-    run_fn = lanes.make_run_fn(engine.params, engine.tables)
-
-    # AOT-compile so the timed run is the steady-state device program
-    import jax
-
-    state = engine.initial_state()
-    compiled = run_fn.lower(state).compile()
-    t0 = time.perf_counter()
-    final = jax.block_until_ready(compiled(state))
-    wall = time.perf_counter() - t0
-
-    result = engine._collect(final, wall)  # raises on queue/log overflow
+    # precompile: the timed run is the steady-state device program;
+    # collect() raises on queue/log overflow, so the number can't silently
+    # come from a diverged simulation
+    result = engine.run(mode="device", precompile=True)
     value = result.sim_seconds_per_wall_second
     print(
         json.dumps(

@@ -1,0 +1,116 @@
+"""CLI entry point: ``python -m shadow_tpu [options] <config.yaml>``.
+
+Mirrors the reference's CLI layering (src/main/core/configuration.rs:52
+CliOptions over src/main/shadow.rs:480): a YAML config file (or ``-`` for
+stdin, as the reference supports) with CLI flags merged on top, plus
+``--show-config`` to print the merged result and exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import sys
+
+import shadow_tpu
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu",
+        description="TPU-native discrete-event network simulator "
+        "(Shadow-capability rebuild)",
+    )
+    p.add_argument("config", help="YAML simulation config, or '-' for stdin")
+    p.add_argument("--version", action="version", version=shadow_tpu.__version__)
+    p.add_argument(
+        "--show-config", action="store_true", help="print merged config and exit"
+    )
+    # common flags with dedicated spellings (the reference's CliOptions)
+    flag_map = {
+        "--seed": "general.seed",
+        "--stop-time": "general.stop_time",
+        "--bootstrap-end-time": "general.bootstrap_end_time",
+        "--parallelism": "general.parallelism",
+        "--data-directory": "general.data_directory",
+        "--log-level": "general.log_level",
+        "--heartbeat-interval": "general.heartbeat_interval",
+        "--network-backend": "experimental.network_backend",
+        "--runahead": "experimental.runahead",
+        "--tpu-mesh-shape": "experimental.tpu_mesh_shape",
+    }
+    for flag, key in flag_map.items():
+        p.add_argument(flag, dest=key, default=None, metavar="V")
+    p.add_argument(
+        "--progress", action="store_true", help="log heartbeat progress lines"
+    )
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        action="append",
+        default=[],
+        metavar="SECTION.FIELD=VALUE",
+        help="generic dotted-key config override (repeatable)",
+    )
+    p.add_argument(
+        "--event-log",
+        action="store_true",
+        help="write the canonical sorted event log (determinism-diff artifact)",
+    )
+    return p
+
+
+def parse_overrides(ns: argparse.Namespace) -> dict[str, object]:
+    overrides: dict[str, object] = {}
+    for key, val in vars(ns).items():
+        if "." in key and val is not None:
+            overrides[key] = val
+    for item in ns.overrides:
+        key, sep, val = item.partition("=")
+        if not sep:
+            raise SystemExit(f"--set expects SECTION.FIELD=VALUE, got {item!r}")
+        overrides[key] = val
+    return overrides
+
+
+def main(argv: list[str] | None = None) -> int:
+    from shadow_tpu.config.options import ConfigError, ConfigOptions
+    from shadow_tpu.engine.sim import Simulation
+
+    ns = build_parser().parse_args(argv)
+    try:
+        if ns.config == "-":
+            cfg = ConfigOptions.from_yaml(sys.stdin.read())
+        else:
+            cfg = ConfigOptions.from_yaml_file(ns.config)
+        cfg.apply_overrides(parse_overrides(ns))
+        cfg.validate()
+    except (ConfigError, OSError, KeyError) as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+
+    logging.basicConfig(
+        level=getattr(logging, cfg.general.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s",
+        stream=sys.stderr,
+    )
+    if ns.show_config:
+        print(json.dumps(dataclasses.asdict(cfg), indent=2, default=str))
+        return 0
+
+    sim = Simulation(cfg)
+    try:
+        result = sim.run()
+    except Exception as e:  # surface backend errors with a nonzero exit
+        print(f"simulation failed: {e}", file=sys.stderr)
+        return 1
+    if ns.event_log:
+        path = sim.write_event_log(result)
+        print(f"event log: {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

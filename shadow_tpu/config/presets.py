@@ -1,0 +1,50 @@
+"""Canonical workload presets shared by the bench and the driver entry
+points, so the program the driver compile-checks is the one the bench times
+(BASELINE.md north-star configs)."""
+
+from __future__ import annotations
+
+from .options import ConfigOptions
+
+
+def flagship_mesh_config(
+    n_hosts: int,
+    sim_seconds: int = 10,
+    latency: str = "10 ms",
+    interval: str = "10ms",
+    size: int = 1428,
+    queue_capacity: int | None = None,
+    pops_per_round: int | None = None,
+) -> ConfigOptions:
+    """The tgen all-to-all mesh over a single switch (BASELINE config #4):
+    every host sends a ``size``-byte datagram every ``interval`` to a
+    round-robin peer; lookahead window = link ``latency``."""
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general:
+  stop_time: {sim_seconds} s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0  host_bandwidth_up "1 Gbit"  host_bandwidth_down "1 Gbit" ]
+        edge [ source 0  target 0  latency "{latency}" ]
+      ]
+experimental:
+  network_backend: tpu
+hosts:
+  peer:
+    count: {n_hosts}
+    network_node_id: 0
+    processes:
+      - path: tgen-mesh
+        args: --interval {interval} --size {size}
+        start_time: 0 s
+"""
+    )
+    if queue_capacity is not None:
+        cfg.experimental.tpu_lane_queue_capacity = queue_capacity
+    if pops_per_round is not None:
+        cfg.experimental.tpu_events_per_round = pops_per_round
+    return cfg

@@ -1,0 +1,3 @@
+from .sim import Simulation
+
+__all__ = ["Simulation"]

@@ -1,0 +1,166 @@
+"""Simulation facade: config -> backend -> results on disk.
+
+The user-facing runner, covering the reference's L0-L3 surface
+(shadow.rs:33-480 run_shadow, controller.rs, manager.rs): pick the network
+backend, run the round loop, emit heartbeat progress, and write the data
+directory (``sim-stats.json``, the counter dump the reference writes at
+manager.rs:844-846, plus an optional event log for determinism diffs).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Optional
+
+from ..backend.cpu_engine import OUTCOME_NAMES, CpuEngine, SimResult
+from ..config.options import ConfigOptions
+from ..core import time as stime
+
+log = logging.getLogger("shadow_tpu")
+
+
+class Simulation:
+    """Owns one simulation run end to end (the reference's Controller +
+    Manager collapsed: config in, data directory out)."""
+
+    def __init__(self, cfg: ConfigOptions) -> None:
+        cfg.validate()
+        self.cfg = cfg
+        self.data_dir = Path(cfg.general.data_directory)
+
+    # -- running -----------------------------------------------------------
+
+    def run(self, write_data: bool = True) -> SimResult:
+        cfg = self.cfg
+        backend = cfg.experimental.network_backend
+        t0 = time.perf_counter()
+        log.info(
+            "starting simulation: %d hosts, stop_time=%s, backend=%s, seed=%d",
+            len(cfg.hosts),
+            stime.fmt(cfg.general.stop_time),
+            backend,
+            cfg.general.seed,
+        )
+        if backend == "tpu":
+            result = self._run_tpu()
+        else:
+            result = self._run_cpu()
+        total = time.perf_counter() - t0
+        log.info(
+            "simulation done: %s simulated in %.2fs wall (%.2fx real time), "
+            "%d rounds, %d log records",
+            stime.fmt(result.sim_time_ns),
+            result.wall_seconds,
+            result.sim_seconds_per_wall_second,
+            result.rounds,
+            len(result.event_log),
+        )
+        if write_data:
+            self._write_data(result, total)
+        return result
+
+    def _run_cpu(self) -> SimResult:
+        engine = CpuEngine(self.cfg)
+        heartbeat = self.cfg.general.heartbeat_interval
+        if not heartbeat:
+            return engine.run()
+        # windowed run with heartbeat lines (manager.rs:602-608)
+        t0 = time.perf_counter()
+        next_beat = heartbeat
+        while True:
+            start = engine.next_event_time()
+            if start >= engine.stop_time or start == stime.NEVER:
+                break
+            engine.window_end = min(start + engine.runahead, engine.stop_time)
+            for host in engine.hosts:
+                host.execute(engine.window_end)
+            engine.rounds += 1
+            while engine.window_end >= next_beat:
+                log.info(
+                    "heartbeat: sim-time %s, %d rounds, %.1fs wall",
+                    stime.fmt(next_beat),
+                    engine.rounds,
+                    time.perf_counter() - t0,
+                )
+                next_beat += heartbeat
+        wall = time.perf_counter() - t0
+        counters: dict[str, int] = {}
+        for h in engine.hosts:
+            for k, v in h.counters.items():
+                counters[k] = counters.get(k, 0) + v
+        return SimResult(
+            sim_time_ns=engine.stop_time,
+            wall_seconds=wall,
+            rounds=engine.rounds,
+            event_log=engine.event_log,
+            counters=counters,
+            per_host_counters=[dict(h.counters) for h in engine.hosts],
+        )
+
+    def _run_tpu(self) -> SimResult:
+        from ..backend.tpu_engine import TpuEngine
+
+        engine = TpuEngine(self.cfg)
+        mesh_shape = self.cfg.experimental.tpu_mesh_shape
+        if mesh_shape is not None and len(mesh_shape) == 1 and mesh_shape[0] > 1:
+            import jax
+
+            from .. import parallel
+
+            mesh = parallel.make_mesh(mesh_shape[0])
+            state = parallel.shard_state(engine.initial_state(), mesh)
+            run_fn = parallel.make_sharded_run_fn(engine.params, engine.tables, mesh)
+            t0 = time.perf_counter()
+            final = jax.block_until_ready(run_fn(state))
+            return engine.collect(final, time.perf_counter() - t0)
+        return engine.run(mode="device")
+
+    # -- output ------------------------------------------------------------
+
+    def _write_data(self, result: SimResult, total_wall: float) -> None:
+        self.data_dir.mkdir(parents=True, exist_ok=True)
+        stats = {
+            "sim_time_ns": result.sim_time_ns,
+            "wall_seconds": result.wall_seconds,
+            "total_wall_seconds": total_wall,
+            "sim_seconds_per_wall_second": result.sim_seconds_per_wall_second,
+            "rounds": result.rounds,
+            "backend": self.cfg.experimental.network_backend,
+            "num_hosts": len(self.cfg.hosts),
+            "seed": self.cfg.general.seed,
+            "counters": dict(sorted(result.counters.items())),
+            "packet_outcomes": self._outcome_counts(result),
+        }
+        (self.data_dir / "sim-stats.json").write_text(
+            json.dumps(stats, indent=2) + "\n"
+        )
+        hosts_dir = self.data_dir / "hosts"
+        hosts_dir.mkdir(exist_ok=True)
+        if result.per_host_counters:
+            for hopt, counters in zip(self.cfg.hosts, result.per_host_counters):
+                d = hosts_dir / hopt.hostname
+                d.mkdir(exist_ok=True)
+                (d / "counters.json").write_text(
+                    json.dumps(dict(sorted(counters.items())), indent=2) + "\n"
+                )
+
+    def _outcome_counts(self, result: SimResult) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for r in result.event_log:
+            name = OUTCOME_NAMES.get(r.outcome, str(r.outcome))
+            out[name] = out.get(name, 0) + 1
+        return out
+
+    def write_event_log(self, result: SimResult, path: Optional[Path] = None) -> Path:
+        """Canonical sorted event log — the determinism-diff artifact
+        (src/test/determinism/ compares exactly this across runs)."""
+        path = path or (self.data_dir / "event-log.tsv")
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            f.write("time\tsrc\tdst\tseq\tsize\toutcome\n")
+            for row in result.log_tuples():
+                f.write("\t".join(str(x) for x in row) + "\n")
+        return path

@@ -77,7 +77,7 @@ def test_sharded_matches_cpu_reference():
     engine = TpuEngine(cfg)
     mesh = parallel.make_mesh(8)
     final = _final_state(engine, mesh)
-    tpu = engine._collect(final, wall=0.0)
+    tpu = engine.collect(final, wall=0.0)
     assert cpu.log_tuples() == tpu.log_tuples()
 
 
