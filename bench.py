@@ -27,7 +27,11 @@ SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_SIM_SECONDS", "10"))
 
 
 def main() -> None:
-    cfg = flagship_mesh_config(N_HOSTS, sim_seconds=SIM_SECONDS)
+    # tight static shapes for the mesh workload (~5 events resident per
+    # lane): smaller queue rows -> smaller sorts; overflow would raise
+    cfg = flagship_mesh_config(
+        N_HOSTS, sim_seconds=SIM_SECONDS, queue_capacity=16, pops_per_round=4
+    )
     engine = TpuEngine(cfg, log_capacity=0)  # logging off on the hot path
     # precompile: the timed run is the steady-state device program;
     # collect() raises on queue/log overflow, so the number can't silently

@@ -10,21 +10,34 @@ Replaces the reference's packet-scheduling hot path — ``Worker::send_packet``
 relay token buckets (relay/token_bucket.rs), and the per-host event queues
 (event_queue.rs) — with:
 
-- per-lane event queues: ``[N, C]`` arrays kept key-sorted by a multi-operand
-  ``lax.sort`` (the binary heap's batched equivalent);
+- per-lane event queues: ``[N, C]`` arrays kept key-sorted by ``lax.sort``
+  (the binary heap's batched equivalent).  The event key ``(time, kind,
+  src, seq)`` is packed into **two** int64 sort keys — ``time`` plus an
+  ``aux`` word holding ``kind|src|seq`` — so the comparator moves three
+  operands instead of five;
 - the latency/loss lookup as gathers into the dense ``[G, G]`` tables from
   ``net.graph``;
 - Bernoulli loss via the counter-based threefry streams of ``core.rng``
   (bit-identical to the CPU reference);
 - token bucket + CoDel as masked integer vector arithmetic (identical
   update laws to ``net.token_bucket`` / ``net.codel``);
-- cross-lane packet exchange as a sort → rank-within-destination → scatter
-  append (the shared-memory queue push's batched equivalent; under a sharded
-  mesh the same scatter rides XLA collectives).
+- cross-lane packet exchange as a single-key stable sort by destination →
+  segment bounds by ``searchsorted`` → an aligned row-gather + barrel shift
+  into a lane-aligned block (the shared-memory queue push's batched
+  equivalent; under a sharded mesh the exchange rides XLA collectives).
+  Same-lane insertions (delivery self-inserts, timer re-arms) skip the
+  exchange: they are lane-aligned blocks already;
+- appends by **merge, not scatter** (TPU scatters serialize): one row sort
+  of ``[old queue | same-lane inserts | cross block]`` keeps the first C
+  keys per lane.
 
 Determinism: every quantity is integer, every draw is counter-based, and
 event ordering is the same ``(time, kind, src, seq)`` total order — the
-event logs of this backend and the CPU reference diff equal.
+event logs of this backend and the CPU reference diff equal.  Queue rows
+are maintained **sorted by (time, aux) as an invariant** (established by
+``TpuEngine.initial_state``, preserved by the merge — or by the explicit
+re-sort on iterations that skip it), so the pop phase is a plain slice of
+the first K columns.
 """
 
 from __future__ import annotations
@@ -51,15 +64,41 @@ NEVER = stime.NEVER
 # lane-supported app models
 M_NONE, M_PHOLD, M_TGEN_MESH, M_TGEN_CLIENT, M_TGEN_SERVER, M_PING_CLIENT, M_PING_SERVER = range(7)
 
+# ---- packed aux word: kind(2b) | src(17b) | seq(44b), sign bit clear ------
+AUX_SEQ_BITS = 44
+AUX_SRC_BITS = 17
+AUX_SRC_SHIFT = AUX_SEQ_BITS
+AUX_KIND_SHIFT = AUX_SEQ_BITS + AUX_SRC_BITS
+MAX_LANES = 1 << AUX_SRC_BITS
+_SEQ_MASK = (1 << AUX_SEQ_BITS) - 1
+_SRC_MASK = (1 << AUX_SRC_BITS) - 1
+
+
+def pack_aux(kind, src, seq):
+    """(kind, src, seq) -> one int64 aux word preserving lexicographic
+    order.  src < 2**17 (131072 lanes), seq < 2**44 (~17.6e12 events per
+    source — unreachable in practice; TpuEngine guards the lane count)."""
+    i64 = jnp.int64
+    return (
+        (jnp.asarray(kind).astype(i64) << AUX_KIND_SHIFT)
+        | (jnp.asarray(src).astype(i64) << AUX_SRC_SHIFT)
+        | jnp.asarray(seq).astype(i64)
+    )
+
+
+def unpack_aux(aux):
+    kind = (aux >> AUX_KIND_SHIFT).astype(jnp.int32)
+    src = ((aux >> AUX_SRC_SHIFT) & _SRC_MASK).astype(jnp.int32)
+    seq = aux & _SEQ_MASK
+    return kind, src, seq
+
 
 class LaneState(NamedTuple):
     """The full device-resident simulation state (a pytree of arrays)."""
 
     # event queues [N, C]
     q_time: jnp.ndarray  # int64, NEVER = empty slot
-    q_kind: jnp.ndarray  # int32
-    q_src: jnp.ndarray  # int32
-    q_seq: jnp.ndarray  # int64
+    q_aux: jnp.ndarray  # int64 packed (kind, src, seq)
     q_size: jnp.ndarray  # int32
     # per-lane counters [N]
     send_seq: jnp.ndarray  # int64
@@ -108,6 +147,12 @@ class LaneParams:
     bootstrap_end: int
     runahead: int
     bucket_interval: int = DEFAULT_INTERVAL_NS
+
+    def __post_init__(self) -> None:
+        if self.n_lanes > MAX_LANES:
+            raise ValueError(
+                f"n_lanes={self.n_lanes} exceeds the packed-key limit {MAX_LANES}"
+            )
 
 
 class LaneTables(NamedTuple):
@@ -226,35 +271,36 @@ def rand_u32_lane(seed: int, stream, counter):
 
 
 def _sort_queues(s: LaneState) -> LaneState:
-    """Key-sort every lane's queue by (time, kind, src, seq); empty slots
-    (NEVER) end up at the back.  The batched binary heap."""
-    t, k, src, seq, size = lax.sort(
-        (s.q_time, s.q_kind, s.q_src, s.q_seq, s.q_size),
-        dimension=1,
-        num_keys=4,
+    """Key-sort every lane's queue by (time, aux) — the packed form of the
+    (time, kind, src, seq) total order; empty slots (NEVER) end at the back.
+
+    Establishes the sorted-row invariant on entry states
+    (``TpuEngine.initial_state``) and restores it on iterations that pop
+    events but skip the merge (see ``iter_body``)."""
+    t, aux, size = lax.sort(
+        (s.q_time, s.q_aux, s.q_size), dimension=1, num_keys=2
     )
-    return s._replace(q_time=t, q_kind=k, q_src=src, q_seq=seq, q_size=size)
+    return s._replace(q_time=t, q_aux=aux, q_size=size)
 
 
 class _SlotEmit(NamedTuple):
     """What one pop-slot step emits (all [N])."""
 
-    # generated events (self-inserts and outbound packets unified)
-    ev_valid: jnp.ndarray  # bool: event generated
-    ev_dst: jnp.ndarray  # int32 target lane
-    ev_time: jnp.ndarray  # int64
-    ev_kind: jnp.ndarray  # int32
-    ev_src: jnp.ndarray  # int32
-    ev_seq: jnp.ndarray  # int64
-    ev_size: jnp.ndarray  # int32
-    # second event channel (timer re-arm alongside a send)
-    ev2_valid: jnp.ndarray
-    ev2_dst: jnp.ndarray
-    ev2_time: jnp.ndarray
-    ev2_kind: jnp.ndarray
-    ev2_src: jnp.ndarray
-    ev2_seq: jnp.ndarray
-    ev2_size: jnp.ndarray
+    # same-lane insert channel 1: DELIVERY self-insert (packet pops)
+    ins_valid: jnp.ndarray  # bool
+    ins_time: jnp.ndarray  # int64
+    ins_aux: jnp.ndarray  # int64
+    ins_size: jnp.ndarray  # int32
+    # same-lane insert channel 2: timer re-arm (LOCAL, size 0)
+    arm_valid: jnp.ndarray
+    arm_time: jnp.ndarray
+    arm_aux: jnp.ndarray
+    # cross-lane channel: outbound packets
+    out_valid: jnp.ndarray
+    out_dst: jnp.ndarray  # int32
+    out_time: jnp.ndarray
+    out_aux: jnp.ndarray
+    out_size: jnp.ndarray
     # log record channel
     rec_valid: jnp.ndarray
     rec_time: jnp.ndarray
@@ -272,15 +318,12 @@ def _process_slot(
     n = p.n_lanes
     lanes = jnp.arange(n, dtype=jnp.int32)
     t = slot["time"]
-    kind = slot["kind"]
-    src = slot["src"]
-    seq = slot["seq"]
+    kind, src, seq = unpack_aux(slot["aux"])
     size = slot["size"]
     active = t < window_end
 
     i64 = jnp.int64
     i32 = jnp.int32
-    zero32 = jnp.zeros(n, dtype=i32)
 
     # ---- PACKET pops: down bucket + CoDel -> DELIVERY self-insert --------
     is_pkt = active & (kind == PACKET)
@@ -300,11 +343,8 @@ def _process_slot(
 
     # DELIVERY self-insert keyed by the packet's (src, seq)
     ins_valid = deliver
-    ins_dst = lanes
     ins_time = t_del
-    ins_kind = jnp.full(n, DELIVERY, dtype=i32)
-    ins_src = src
-    ins_seq = seq
+    ins_aux = pack_aux(DELIVERY, src, seq)
     ins_size = size
 
     # packet outcome log record
@@ -397,6 +437,7 @@ def _process_slot(
 
     arr = jnp.maximum(t_dep + lat, window_end)
     out_valid = do_send & ~lost
+    out_aux = pack_aux(jnp.full(n, PACKET, dtype=i32), lanes, snd_seq)
 
     # ---- timer (re-)arm channel -----------------------------------------
     has_timer = (
@@ -409,29 +450,9 @@ def _process_slot(
         | ping_tick
         | (is_timer & (model == M_TGEN_MESH) & (n == 1))
     )
-    rearm_time = t + tb.p_interval
-    rearm_seq = s.local_seq
+    arm_time = t + tb.p_interval
+    arm_aux = pack_aux(jnp.full(n, LOCAL, dtype=i32), lanes, s.local_seq)
     s = s._replace(local_seq=s.local_seq + rearm)
-
-    # ---- merge the two event channels per lane ---------------------------
-    # channel 1: DELIVERY self-insert (packet pops) OR outbound packet
-    # (they're mutually exclusive per slot: a slot is one kind)
-    ev_valid = ins_valid | out_valid
-    ev_dst = jnp.where(ins_valid, ins_dst, dst)
-    ev_time = jnp.where(ins_valid, ins_time, arr)
-    ev_kind = jnp.where(ins_valid, ins_kind, jnp.full(n, PACKET, dtype=i32))
-    ev_src = jnp.where(ins_valid, ins_src, lanes)
-    ev_seq = jnp.where(ins_valid, ins_seq, snd_seq)
-    ev_size = jnp.where(ins_valid, ins_size, out_size)
-
-    # channel 2: timer re-arm (can coincide with a send on the same slot)
-    ev2_valid = rearm
-    ev2_dst = lanes
-    ev2_time = rearm_time
-    ev2_kind = jnp.full(n, LOCAL, dtype=i32)
-    ev2_src = lanes
-    ev2_seq = rearm_seq
-    ev2_size = zero32
 
     # ---- log record (≤1 per slot: packet outcome, or send loss) ----------
     rec_valid = pk_rec_valid | lost
@@ -443,65 +464,129 @@ def _process_slot(
     rec_outcome = jnp.where(pk_rec_valid, pk_rec_outcome, DROP_LOSS).astype(i64)
 
     emit = _SlotEmit(
-        ev_valid, ev_dst, ev_time, ev_kind, ev_src, ev_seq, ev_size,
-        ev2_valid, ev2_dst, ev2_time, ev2_kind, ev2_src, ev2_seq, ev2_size,
+        ins_valid, ins_time, ins_aux, ins_size,
+        rearm, arm_time, arm_aux,
+        out_valid, dst, arr, out_aux, out_size,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
     )
     return s, emit
 
 
-def _append_events(p: LaneParams, s: LaneState, prefix_len, ev) -> tuple[LaneState, Any]:
-    """Scatter generated events into destination lanes.
+def _window_gather(arrs, start, c):
+    """Gather the contiguous windows ``arr[start[n] : start[n]+c]`` for all
+    lanes — but as one *aligned row* gather plus a barrel shift, because TPU
+    per-element gathers serialize (~20ns/elem) while row gathers and static
+    rolls vectorize.  ``arrs`` is a list of flat [m] arrays sharing ``start``;
+    entries past m are garbage the caller must mask (segment counts do)."""
+    m = arrs[0].shape[0]
+    # the barrel shift decomposes the offset over bits, so the row width
+    # must be a power of two >= c (c itself is any user-chosen capacity)
+    v = 1 << max(c - 1, 1).bit_length()
+    pad = (-m) % v
+    nrow = (m + pad) // v
+    i64 = jnp.int64
+    tab = jnp.stack([a.astype(i64) for a in arrs])  # [A, m]
+    tab = jnp.pad(tab, ((0, 0), (0, pad))).reshape(len(arrs), nrow, v)
+    q = jnp.clip(start // v, 0, nrow - 1)
+    rows = jnp.stack([q, jnp.clip(q + 1, 0, nrow - 1)], axis=1)  # [N, 2]
+    block = tab[:, rows].reshape(len(arrs), -1, 2 * v)  # [A, N, 2v]
+    sh = (start % v).astype(jnp.int32)
+    b = v >> 1
+    while b:
+        rolled = jnp.concatenate([block[:, :, b:], block[:, :, :b]], axis=2)
+        block = jnp.where(((sh & b) != 0)[None, :, None], rolled, block)
+        b >>= 1
+    return [block[i, :, :c] for i in range(len(arrs))]
 
-    ``ev`` is a dict of flat arrays [M]: valid, dst, time, kind, src, seq,
-    size.  Entries are ranked within their destination by the event key and
-    appended after each lane's current prefix; overflow beyond capacity is
-    counted and logged as DROP_QUEUE.  Returns overflow log-record arrays.
+
+def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
+    """Append all generated events by **merge**, not scatter (TPU scatters
+    serialize; sorts and gathers vectorize):
+
+    1. same-lane channels (delivery self-inserts, timer re-arms) are already
+       lane-aligned ``[N, 2K]`` blocks — invalid entries get time=NEVER;
+    2. outbound packets take one stable single-key sort by destination, then
+       a segment gather (``searchsorted`` for each lane's slice bounds) into
+       a lane-aligned ``[N, C]`` block — the batched equivalent of the
+       reference's cross-host queue push (worker.rs:603-615);
+    3. one row-sort of ``[old C | self 2K | cross C]`` by (time, aux) keeps
+       the first C per lane — the queue's sorted invariant is maintained,
+       so the pop phase needs no sort at all.
+
+    Events pushed past column C are capacity overflow: counted per lane
+    (the engine raises in strict mode) and logged as DROP_QUEUE; the merge
+    keeps the *earliest* C keys, so overflow sheds the latest events.
+    Returns (state, overflow log-record dict).
     """
     n, c = p.n_lanes, p.capacity
-    m = ev["dst"].shape[0]
-    big = jnp.int32(n)  # invalid entries sort last
-    dst_key = jnp.where(ev["valid"], ev["dst"], big)
-    # lexicographic sort by (dst, time, kind, src, seq), payload follows
-    dst_s, time_s, kind_s, src_s, seq_s, size_s, valid_s = lax.sort(
-        (
-            dst_key,
-            ev["time"],
-            ev["kind"],
-            ev["src"],
-            ev["seq"],
-            ev["size"],
-            ev["valid"],
-        ),
-        dimension=0,
-        num_keys=5,
-    )
-    first_of_dst = jnp.searchsorted(dst_s, dst_s, side="left")
-    rank = jnp.arange(m) - first_of_dst
-    base = prefix_len[jnp.clip(dst_s, 0, n - 1)]
-    pos = base + rank
-    fits = valid_s & (pos < c)
-    overflow = valid_s & (pos >= c)
+    i64 = jnp.int64
 
-    # out-of-range scatter indices are dropped (mode='drop')
-    lane_idx = jnp.where(fits, dst_s, n)
-    slot_idx = jnp.where(fits, pos, c)
+    # -- same-lane block [N, 2K] ------------------------------------------
+    self_valid = jnp.concatenate([emits.ins_valid.T, emits.arm_valid.T], axis=1)
+    self_time = jnp.where(
+        self_valid,
+        jnp.concatenate([emits.ins_time.T, emits.arm_time.T], axis=1),
+        NEVER,
+    )
+    self_aux = jnp.concatenate([emits.ins_aux.T, emits.arm_aux.T], axis=1)
+    self_size = jnp.concatenate(
+        [emits.ins_size.T, jnp.zeros_like(emits.ins_size.T)], axis=1
+    )
+
+    # -- cross-lane block [N, C] via sort-by-dst + segment gather ----------
+    valid = emits.out_valid.reshape(-1)
+    dst = jnp.where(valid, emits.out_dst.reshape(-1), jnp.int32(n))
+    m = dst.shape[0]
+    dst_s, time_s, aux_s, size_s = lax.sort(
+        (dst, emits.out_time.reshape(-1), emits.out_aux.reshape(-1),
+         emits.out_size.reshape(-1)),
+        dimension=0,
+        num_keys=1,
+    )
+    # one search over [0..N]: start of lane n+1 is the end of lane n
+    bounds = jnp.searchsorted(
+        dst_s, jnp.arange(n + 1, dtype=dst_s.dtype), side="left"
+    ).astype(jnp.int32)
+    start = bounds[:n]
+    cnt = bounds[1:] - start
+    r = jnp.arange(c, dtype=jnp.int32)[None, :]  # [1, C]
+    in_seg = r < cnt[:, None]
+    g_time, g_aux, g_size = _window_gather([time_s, aux_s, size_s], start, c)
+    cross_time = jnp.where(in_seg, g_time, NEVER)
+    cross_aux = jnp.where(in_seg, g_aux, 0)
+    cross_size = jnp.where(in_seg, g_size, 0).astype(jnp.int32)
+    # receivers of more than C events in one iteration lose the tail
+    # before the merge even sees it; count those drops too
+    lost_pre = jnp.maximum(cnt - c, 0).astype(i64)
+
+    # -- merge [N, C + 2K + C], keep first C ------------------------------
+    mt = jnp.concatenate([s.q_time, self_time, cross_time], axis=1)
+    ma = jnp.concatenate([s.q_aux, self_aux, cross_aux], axis=1)
+    ms = jnp.concatenate([s.q_size, self_size, cross_size], axis=1)
+    mt, ma, ms = lax.sort((mt, ma, ms), dimension=1, num_keys=2)
+    tail_mask = mt[:, c:] != NEVER
     s = s._replace(
-        q_time=s.q_time.at[lane_idx, slot_idx].set(time_s, mode="drop"),
-        q_kind=s.q_kind.at[lane_idx, slot_idx].set(kind_s, mode="drop"),
-        q_src=s.q_src.at[lane_idx, slot_idx].set(src_s, mode="drop"),
-        q_seq=s.q_seq.at[lane_idx, slot_idx].set(seq_s, mode="drop"),
-        q_size=s.q_size.at[lane_idx, slot_idx].set(size_s, mode="drop"),
-        n_queue=s.n_queue.at[jnp.where(overflow, dst_s, n)].add(1, mode="drop"),
+        q_time=mt[:, :c],
+        q_aux=ma[:, :c],
+        q_size=ms[:, :c],
+        n_queue=s.n_queue + tail_mask.sum(axis=1) + lost_pre,
+    )
+
+    # overflow log records from the merge tail (pre-gather losses surface
+    # only in n_queue; both paths raise in strict mode)
+    t_tail = mt[:, c:]
+    _, o_src, o_seq = unpack_aux(ma[:, c:])
+    rows = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int64)[:, None], tail_mask.shape
     )
     over_rec = {
-        "valid": overflow,
-        "time": time_s,
-        "src": src_s.astype(jnp.int64),
-        "dst": dst_s.astype(jnp.int64),
-        "seq": seq_s,
-        "size": size_s.astype(jnp.int64),
-        "outcome": jnp.full(m, DROP_QUEUE, dtype=jnp.int64),
+        "valid": tail_mask.reshape(-1),
+        "time": t_tail.reshape(-1),
+        "src": o_src.reshape(-1).astype(i64),
+        "dst": rows.reshape(-1),
+        "seq": o_seq.reshape(-1),
+        "size": ms[:, c:].reshape(-1).astype(i64),
+        "outcome": jnp.full(tail_mask.size, DROP_QUEUE, dtype=i64),
     }
     return s, over_rec
 
@@ -543,23 +628,19 @@ def _build_round(p: LaneParams, tb: LaneTables):
     k = p.pops_per_iter
 
     def iter_body(s: LaneState) -> LaneState:
-        s = _sort_queues(s)
+        # queue rows are kept sorted by (time, aux) — the pop is a slice
         window_end = s.now_window_end
-
-        # pop the first K columns
         popped = {
             "time": s.q_time[:, :k],
-            "kind": s.q_kind[:, :k],
-            "src": s.q_src[:, :k],
-            "seq": s.q_seq[:, :k],
+            "aux": s.q_aux[:, :k],
             "size": s.q_size[:, :k],
         }
         consumed = popped["time"] < window_end
-        s = s._replace(q_time=s.q_time.at[:, :k].set(jnp.where(consumed, NEVER, popped["time"])))
-        # compact the freed pop slots to the back before appending, so a
-        # full-but-stable workload (pop K, insert K) never false-overflows
-        s = _sort_queues(s)
-        prefix_len = (s.q_time != NEVER).sum(axis=1)
+        s = s._replace(
+            q_time=s.q_time.at[:, :k].set(
+                jnp.where(consumed, NEVER, popped["time"])
+            )
+        )
 
         def scan_body(carry, slot_cols):
             st = carry
@@ -569,33 +650,32 @@ def _build_round(p: LaneParams, tb: LaneTables):
         slots = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), popped)  # [K, N]
         s, emits = lax.scan(scan_body, s, slots)
 
-        # flatten the two event channels: [K, N] -> [2*K*N]
-        def flat2(a, b):
-            return jnp.concatenate([a.reshape(-1), b.reshape(-1)])
+        # the merge (exchange + wide row sort) is the expensive step; on
+        # iterations that generated no events (e.g. windows that only pop
+        # deliveries) a plain row re-sort restores the sorted invariant the
+        # consumed->NEVER holes in the first K columns just broke
+        any_new = (
+            jnp.any(emits.ins_valid)
+            | jnp.any(emits.arm_valid)
+            | jnp.any(emits.out_valid)
+        )
 
-        ev = {
-            "valid": flat2(emits.ev_valid, emits.ev2_valid),
-            "dst": flat2(emits.ev_dst, emits.ev2_dst),
-            "time": flat2(emits.ev_time, emits.ev2_time),
-            "kind": flat2(emits.ev_kind, emits.ev2_kind),
-            "src": flat2(emits.ev_src, emits.ev2_src),
-            "seq": flat2(emits.ev_seq, emits.ev2_seq),
-            "size": flat2(emits.ev_size, emits.ev2_size),
-        }
-        s, over_rec = _append_events(p, s, prefix_len, ev)
+        def do_merge(st: LaneState) -> LaneState:
+            st, over_rec = _merge_append(p, st, emits)
+            return _append_log(p, st, over_rec)
 
-        recs = {
-            "valid": jnp.concatenate([emits.rec_valid.reshape(-1), over_rec["valid"]]),
-            "time": jnp.concatenate([emits.rec_time.reshape(-1), over_rec["time"]]),
-            "src": jnp.concatenate([emits.rec_src.reshape(-1), over_rec["src"]]),
-            "dst": jnp.concatenate([emits.rec_dst.reshape(-1), over_rec["dst"]]),
-            "seq": jnp.concatenate([emits.rec_seq.reshape(-1), over_rec["seq"]]),
-            "size": jnp.concatenate([emits.rec_size.reshape(-1), over_rec["size"]]),
-            "outcome": jnp.concatenate(
-                [emits.rec_outcome.reshape(-1), over_rec["outcome"]]
-            ),
+        s = lax.cond(any_new, do_merge, _sort_queues, s)
+
+        per_slot = {
+            "valid": emits.rec_valid.reshape(-1),
+            "time": emits.rec_time.reshape(-1),
+            "src": emits.rec_src.reshape(-1),
+            "dst": emits.rec_dst.reshape(-1),
+            "seq": emits.rec_seq.reshape(-1),
+            "size": emits.rec_size.reshape(-1),
+            "outcome": emits.rec_outcome.reshape(-1),
         }
-        s = _append_log(p, s, recs)
+        s = _append_log(p, s, per_slot)
         return s
 
     def round_fn(s: LaneState) -> tuple[LaneState, jnp.ndarray]:

@@ -35,6 +35,14 @@ class LaneCompatError(ValueError):
     ``experimental.network_backend: cpu``)."""
 
 
+# NOTE on ``strict_capacity=False``: queue overflow on this backend evicts
+# the *latest-keyed* events of the full lane (the merge keeps the earliest C)
+# and burst arrivals past C per iteration are counted but not logged, whereas
+# the CPU reference never drops (its queues are unbounded).  Non-strict runs
+# are therefore NOT log-parity comparable once any lane overflows; strict
+# mode (the default) raises instead of diverging silently.
+
+
 class TpuEngine:
     def __init__(
         self,
@@ -176,28 +184,30 @@ class TpuEngine:
         p = self.params
         n, c = p.n_lanes, p.capacity
         q_time = np.full((n, c), NEVER, dtype=np.int64)
-        q_kind = np.zeros((n, c), dtype=np.int32)
-        q_src = np.zeros((n, c), dtype=np.int32)
-        q_seq = np.zeros((n, c), dtype=np.int64)
+        q_aux = np.zeros((n, c), dtype=np.int64)
         q_size = np.zeros((n, c), dtype=np.int32)
         fill = np.zeros(n, dtype=np.int64)
         for lane, t, kind, src, seq, size in self._init_events:
             i = fill[lane]
             q_time[lane, i] = t
-            q_kind[lane, i] = kind
-            q_src[lane, i] = src
-            q_seq[lane, i] = seq
+            q_aux[lane, i] = (
+                (kind << lanes.AUX_KIND_SHIFT) | (src << lanes.AUX_SRC_SHIFT) | seq
+            )
             q_size[lane, i] = size
             fill[lane] += 1
+        # the round kernel keeps queue rows sorted by (time, aux) as an
+        # invariant; establish it here
+        order = np.lexsort((q_aux, q_time), axis=1)
+        q_time = np.take_along_axis(q_time, order, axis=1)
+        q_aux = np.take_along_axis(q_aux, order, axis=1)
+        q_size = np.take_along_axis(q_size, order, axis=1)
 
         up_burst = np.asarray(self.tables.up_burst)
         dn_burst = np.asarray(self.tables.dn_burst)
         z64 = np.zeros(n, dtype=np.int64)
         return lanes.LaneState(
             q_time=jnp.asarray(q_time),
-            q_kind=jnp.asarray(q_kind),
-            q_src=jnp.asarray(q_src),
-            q_seq=jnp.asarray(q_seq),
+            q_aux=jnp.asarray(q_aux),
             q_size=jnp.asarray(q_size),
             send_seq=jnp.asarray(z64),
             local_seq=jnp.asarray(self._local_seq0),

@@ -163,6 +163,42 @@ def test_phold_hops_counter_parity():
     assert cpu.counters["phold_hops"] == tpu.counters["phold_hops"]
 
 
+SINK_DRAIN = """
+general: {stop_time: 100ms, seed: 2}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+      ]
+hosts:
+  c: {count: 30, network_node_id: 0, processes: [{path: tgen-client, args: [--server, sink, --interval, 20ms, --size, "200"]}]}
+  sink: {network_node_id: 0}
+"""
+
+
+def test_passive_sink_drain_parity():
+    # regression: a lane popping >K passive DELIVERY events in one window
+    # used to skip the merge AND the re-sort, wedging the device while_loop
+    cpu, tpu = both_logs(SINK_DRAIN)
+    assert len(cpu.event_log) > 100
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+def test_non_power_of_two_capacity_parity():
+    # regression: the barrel-shift gather assumed power-of-two capacities
+    yaml = MESH.replace(
+        "general: {stop_time: 200ms, seed: 11}",
+        "general: {stop_time: 200ms, seed: 11}\n"
+        "experimental: {tpu_lane_queue_capacity: 23}",
+    )
+    cpu, tpu = both_logs(yaml)
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
 def test_overflow_raises_loudly():
     yaml = BOTTLENECK.replace("tpu_lane_queue_capacity: 1024", "tpu_lane_queue_capacity: 16")
     from shadow_tpu.backend.tpu_engine import TpuEngine as TE
