@@ -1,0 +1,121 @@
+/* pingpong: a real UDP binary for the managed-process end-to-end test
+ * (the analog of the reference's dual-target test apps, src/test/socket/).
+ *
+ * server mode:  pingpong server <port> <count>
+ *   recvfrom <count> datagrams, echo each back, print totals, exit 0.
+ * client mode:  pingpong client <server-ip> <port> <count> <interval-ms>
+ *   every interval: send "ping <i> @ <now>" and wait for the echo;
+ *   print the RTT observed on the (simulated) clock; exit 0 when done.
+ *
+ * The binary uses only the interposed surface: socket/bind/sendto/recvfrom,
+ * clock_gettime, nanosleep, getrandom.  Everything it prints is derived
+ * from simulated time, so output is bit-deterministic run-to-run.
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/random.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static uint64_t now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+static void sleep_ms(long ms) {
+    struct timespec ts = {ms / 1000, (ms % 1000) * 1000000L};
+    nanosleep(&ts, NULL);
+}
+
+static int run_server(int port, int count) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    struct sockaddr_in me = {0};
+    me.sin_family = AF_INET;
+    me.sin_port = htons(port);
+    if (bind(fd, (struct sockaddr *)&me, sizeof me) != 0) {
+        perror("bind");
+        return 1;
+    }
+    long long bytes = 0;
+    for (int i = 0; i < count; i++) {
+        char buf[2048];
+        struct sockaddr_in peer;
+        socklen_t plen = sizeof peer;
+        ssize_t n = recvfrom(fd, buf, sizeof buf, 0,
+                             (struct sockaddr *)&peer, &plen);
+        if (n < 0) { perror("recvfrom"); return 1; }
+        bytes += n;
+        if (sendto(fd, buf, (size_t)n, 0, (struct sockaddr *)&peer, plen) < 0) {
+            perror("sendto");
+            return 1;
+        }
+    }
+    printf("server: echoed %d datagrams, %lld bytes, done @ %llu ns\n", count,
+           bytes, (unsigned long long)now_ns());
+    close(fd);
+    return 0;
+}
+
+static int run_client(const char *ip, int port, int count, long interval_ms) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    struct sockaddr_in srv = {0};
+    srv.sin_family = AF_INET;
+    srv.sin_port = htons(port);
+    if (inet_pton(AF_INET, ip, &srv.sin_addr) != 1) {
+        fprintf(stderr, "bad ip %s\n", ip);
+        return 1;
+    }
+    uint64_t token;
+    if (getrandom(&token, sizeof token, 0) != sizeof token) {
+        perror("getrandom");
+        return 1;
+    }
+    for (int i = 0; i < count; i++) {
+        sleep_ms(interval_ms);
+        char msg[256];
+        uint64_t t0 = now_ns();
+        int len = snprintf(msg, sizeof msg, "ping %d tok=%016llx @ %llu", i,
+                           (unsigned long long)token, (unsigned long long)t0);
+        if (sendto(fd, msg, (size_t)len, 0, (struct sockaddr *)&srv,
+                   sizeof srv) < 0) {
+            perror("sendto");
+            return 1;
+        }
+        char buf[2048];
+        struct sockaddr_in from;
+        socklen_t flen = sizeof from;
+        ssize_t n = recvfrom(fd, buf, sizeof buf, 0, (struct sockaddr *)&from,
+                             &flen);
+        if (n < 0) { perror("recvfrom"); return 1; }
+        uint64_t rtt = now_ns() - t0;
+        if (n != len || memcmp(buf, msg, (size_t)n) != 0) {
+            fprintf(stderr, "echo mismatch on ping %d\n", i);
+            return 1;
+        }
+        printf("client: ping %d rtt %llu ns\n", i, (unsigned long long)rtt);
+    }
+    printf("client: done @ %llu ns\n", (unsigned long long)now_ns());
+    close(fd);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IOLBF, 0);
+    if (argc >= 4 && strcmp(argv[1], "server") == 0)
+        return run_server(atoi(argv[2]), atoi(argv[3]));
+    if (argc >= 6 && strcmp(argv[1], "client") == 0)
+        return run_client(argv[2], atoi(argv[3]), atoi(argv[4]),
+                          atol(argv[5]));
+    fprintf(stderr,
+            "usage: pingpong server <port> <count>\n"
+            "       pingpong client <ip> <port> <count> <interval-ms>\n");
+    return 2;
+}

@@ -1,0 +1,67 @@
+/* Shared-memory ABI between the in-plugin shim (C, LD_PRELOADed into real
+ * Linux binaries) and the simulator's process manager (Python, ctypes).
+ *
+ * Rebuild of the reference's shadow<->shim substrate: the IPCData pair of
+ * futex channels in shared memory (shadow-shim-helper-rs/src/ipc.rs:14,
+ * vasi-sync/src/scchannel.rs:166) and the HostShmem sim clock the shim
+ * services time from locally (shim/shim_sys.c:24-37) -- the reference's
+ * single biggest perf win (~50ns clock reads vs ~10us trapped syscalls,
+ * MyTest/SUMMARY.md:71-75).
+ *
+ * Layout rules: fixed-width types only, no pointers (the region is mapped
+ * at different addresses in each process), explicit padding; the Python
+ * side mirrors this struct byte-for-byte in shadow_tpu/native/abi.py and
+ * checks SHIM_ABI_MAGIC + sizeof via shim_shmem_size().
+ */
+#ifndef SHADOW_SHIM_ABI_H
+#define SHADOW_SHIM_ABI_H
+
+#include <stdint.h>
+
+#define SHIM_ABI_MAGIC 0x53485457534d4831ull /* "SHTWSMH1" */
+#define SHIM_PAYLOAD_MAX 65536
+
+/* plugin -> shadow ops */
+enum {
+    SHIM_OP_NONE = 0,
+    SHIM_OP_START = 1,     /* shim initialized, waiting for go */
+    SHIM_OP_EXIT = 2,      /* args[0] = exit code */
+    SHIM_OP_NANOSLEEP = 3, /* args[0] = ns */
+    SHIM_OP_SOCKET = 4,    /* args[0] = domain, args[1] = type */
+    SHIM_OP_BIND = 5,      /* args[0] = fd, args[1] = port (host order) */
+    SHIM_OP_SENDTO = 6,    /* args[0]=fd args[1]=dst_ip(BE u32) args[2]=dst_port; payload */
+    SHIM_OP_RECVFROM = 7,  /* args[0] = fd, args[1] = max_len; reply payload + args */
+    SHIM_OP_CLOSE = 8,     /* args[0] = fd */
+    SHIM_OP_CONNECT = 9,   /* args[0]=fd args[1]=ip(BE) args[2]=port */
+    SHIM_OP_GETSOCKNAME = 10, /* args[0]=fd; reply args[1]=ip args[2]=port */
+};
+
+/* shadow -> plugin reply status */
+enum {
+    SHIM_REPLY_OK = 0,
+    SHIM_REPLY_ERRNO = 1, /* ret = -errno */
+};
+
+/* One direction of the duplex channel.  `turn` is the futex word:
+ * 0 = empty (receiver sleeps), 1 = message ready (sender wrote). */
+typedef struct {
+    uint32_t turn; /* futex word; atomic access on both sides */
+    uint32_t op;
+    int64_t args[6];
+    int64_t ret;
+    uint32_t payload_len;
+    uint32_t _pad;
+    uint8_t payload[SHIM_PAYLOAD_MAX];
+} shim_msg;
+
+typedef struct {
+    uint64_t magic;
+    uint64_t abi_size;         /* sizeof(shim_shmem), checked by both sides */
+    uint64_t sim_clock_ns;     /* emulated wall clock, ns since Unix epoch */
+    uint64_t rng_seed;         /* per-process deterministic RNG key */
+    uint64_t rng_counter;      /* splitmix64 counter (shim-local draws) */
+    shim_msg to_shadow;        /* plugin -> manager */
+    shim_msg to_shim;          /* manager -> plugin */
+} shim_shmem;
+
+#endif /* SHADOW_SHIM_ABI_H */

@@ -1,0 +1,401 @@
+/* shadow_shim: LD_PRELOADed interposition runtime for managed plugins.
+ *
+ * Rebuild of the reference's in-plugin shim (src/lib/shim/): co-opts a real,
+ * unmodified Linux binary into the discrete-event simulation by interposing
+ * the libc API surface the simulation owns:
+ *
+ *   - time (clock_gettime/gettimeofday/time) is serviced *locally* from the
+ *     shared-memory sim clock, no channel hop (shim/shim_sys.c:24-37);
+ *   - sleeping and UDP socket I/O round-trip to the manager over a pair of
+ *     futex-word channels in shared memory (the IPCData equivalent,
+ *     shadow-shim-helper-rs/src/ipc.rs:14);
+ *   - getrandom / /dev/urandom-free entropy is deterministic splitmix64
+ *     keyed per process (preload-openssl/src/rng.c's determinism goal).
+ *
+ * Interposition here is symbol-level (LD_PRELOAD overrides the PLT), the
+ * fast path the reference prefers over seccomp for the same reason
+ * (preload-libc/: "faster than seccomp"); the seccomp SIGSYS backstop for
+ * raw-syscall binaries is future work.  Static binaries are rejected by
+ * the manager, as in the reference (src/test/static-bin).
+ *
+ * Virtual fds live at >= SHIM_FD_BASE so real fds pass through untouched.
+ */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <limits.h>
+#include <linux/futex.h>
+#include <netinet/in.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/random.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "../include/shadow_shim_abi.h"
+
+#define SHIM_FD_BASE 10000
+
+static shim_shmem *g_shm = NULL;
+static int g_ready = 0;
+
+/* real libc entry points (resolved once; interposed wrappers fall through
+ * for fds we don't own) */
+static int (*real_socket)(int, int, int);
+static int (*real_bind)(int, const struct sockaddr *, socklen_t);
+static int (*real_connect)(int, const struct sockaddr *, socklen_t);
+static ssize_t (*real_sendto)(int, const void *, size_t, int,
+                              const struct sockaddr *, socklen_t);
+static ssize_t (*real_recvfrom)(int, void *, size_t, int, struct sockaddr *,
+                                socklen_t *);
+static int (*real_close)(int);
+static int (*real_getsockname)(int, struct sockaddr *, socklen_t *);
+
+/* ---------------------------------------------------------------- futex */
+
+static void futex_wait(uint32_t *addr, uint32_t expected) {
+    syscall(SYS_futex, addr, FUTEX_WAIT, expected, NULL, NULL, 0);
+}
+
+static void futex_wake(uint32_t *addr) {
+    syscall(SYS_futex, addr, FUTEX_WAKE, 1, NULL, NULL, 0);
+}
+
+static void msg_publish(shim_msg *m) {
+    __atomic_store_n(&m->turn, 1, __ATOMIC_RELEASE);
+    futex_wake(&m->turn);
+}
+
+static void msg_await(shim_msg *m) {
+    while (__atomic_load_n(&m->turn, __ATOMIC_ACQUIRE) == 0)
+        futex_wait(&m->turn, 0);
+    __atomic_store_n(&m->turn, 0, __ATOMIC_RELEASE);
+}
+
+/* Synchronous call: fill to_shadow, wake manager, block for the reply.
+ * The protocol strictly alternates, exactly like the reference's
+ * ManagedThread::continue_plugin loop (managed_thread.rs:434-472). */
+static int64_t shim_call(uint32_t op, const int64_t args[6], const void *out,
+                         uint32_t out_len, void *in, uint32_t *in_len,
+                         int64_t reply_args[6]) {
+    shim_msg *tx = &g_shm->to_shadow;
+    shim_msg *rx = &g_shm->to_shim;
+    tx->op = op;
+    for (int i = 0; i < 6; i++) tx->args[i] = args ? args[i] : 0;
+    if (out_len > SHIM_PAYLOAD_MAX) out_len = SHIM_PAYLOAD_MAX;
+    if (out && out_len) memcpy(tx->payload, out, out_len);
+    tx->payload_len = out_len;
+    msg_publish(tx);
+    msg_await(rx);
+    if (reply_args)
+        for (int i = 0; i < 6; i++) reply_args[i] = rx->args[i];
+    if (in && in_len) {
+        uint32_t n = rx->payload_len < *in_len ? rx->payload_len : *in_len;
+        memcpy(in, rx->payload, n);
+        *in_len = n;
+    }
+    return rx->ret;
+}
+
+/* ------------------------------------------------------------ init/exit */
+
+static void shim_abort(const char *why) {
+    const char *msg = "shadow_shim: fatal: ";
+    (void)!write(2, msg, strlen(msg));
+    (void)!write(2, why, strlen(why));
+    (void)!write(2, "\n", 1);
+    _exit(127);
+}
+
+__attribute__((constructor)) static void shim_init(void) {
+    const char *path = getenv("SHADOW_TPU_SHM");
+    if (!path) return; /* not under the simulator: become a no-op */
+
+    real_socket = dlsym(RTLD_NEXT, "socket");
+    real_bind = dlsym(RTLD_NEXT, "bind");
+    real_connect = dlsym(RTLD_NEXT, "connect");
+    real_sendto = dlsym(RTLD_NEXT, "sendto");
+    real_recvfrom = dlsym(RTLD_NEXT, "recvfrom");
+    real_close = dlsym(RTLD_NEXT, "close");
+    real_getsockname = dlsym(RTLD_NEXT, "getsockname");
+
+    int fd = open(path, O_RDWR);
+    if (fd < 0) shim_abort("cannot open SHADOW_TPU_SHM");
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(shim_shmem))
+        shim_abort("shm too small");
+    g_shm = mmap(NULL, sizeof(shim_shmem), PROT_READ | PROT_WRITE, MAP_SHARED,
+                 fd, 0);
+    real_close(fd);
+    if (g_shm == MAP_FAILED) shim_abort("mmap failed");
+    if (g_shm->magic != SHIM_ABI_MAGIC || g_shm->abi_size != sizeof(shim_shmem))
+        shim_abort("ABI mismatch between shim and manager");
+
+    g_ready = 1;
+    /* report in and wait for the go signal: from here on the plugin only
+     * runs while the manager has handed it the turn */
+    shim_call(SHIM_OP_START, NULL, NULL, 0, NULL, NULL, NULL);
+}
+
+__attribute__((destructor)) static void shim_fini(void) {
+    if (!g_ready) return;
+    g_ready = 0;
+    int64_t args[6] = {0};
+    shim_msg *tx = &g_shm->to_shadow;
+    tx->op = SHIM_OP_EXIT;
+    for (int i = 0; i < 6; i++) tx->args[i] = args[i];
+    tx->payload_len = 0;
+    msg_publish(tx); /* no reply: the process is on its way out */
+}
+
+/* --------------------------------------------------------------- time */
+
+static uint64_t sim_now_ns(void) {
+    return __atomic_load_n(&g_shm->sim_clock_ns, __ATOMIC_ACQUIRE);
+}
+
+int clock_gettime(clockid_t clk, struct timespec *ts) {
+    if (!g_ready) {
+        /* pre-init or unmanaged: raw syscall (cannot recurse into us) */
+        return syscall(SYS_clock_gettime, clk, ts);
+    }
+    uint64_t now = sim_now_ns();
+    ts->tv_sec = now / 1000000000ull;
+    ts->tv_nsec = now % 1000000000ull;
+    return 0;
+}
+
+int gettimeofday(struct timeval *tv, void *tz) {
+    (void)tz;
+    if (!g_ready) return syscall(SYS_gettimeofday, tv, tz);
+    uint64_t now = sim_now_ns();
+    tv->tv_sec = now / 1000000000ull;
+    tv->tv_usec = (now % 1000000000ull) / 1000;
+    return 0;
+}
+
+time_t time(time_t *tloc) {
+    if (!g_ready) {
+        struct timespec ts;
+        syscall(SYS_clock_gettime, CLOCK_REALTIME, &ts);
+        if (tloc) *tloc = ts.tv_sec;
+        return ts.tv_sec;
+    }
+    time_t t = (time_t)(sim_now_ns() / 1000000000ull);
+    if (tloc) *tloc = t;
+    return t;
+}
+
+/* -------------------------------------------------------------- sleep */
+
+int nanosleep(const struct timespec *req, struct timespec *rem) {
+    if (!g_ready) return syscall(SYS_nanosleep, req, rem);
+    if (!req || req->tv_sec < 0 || req->tv_nsec < 0 ||
+        req->tv_nsec >= 1000000000L) {
+        errno = EINVAL;
+        return -1;
+    }
+    int64_t args[6] = {0};
+    args[0] = (int64_t)req->tv_sec * 1000000000ll + req->tv_nsec;
+    shim_call(SHIM_OP_NANOSLEEP, args, NULL, 0, NULL, NULL, NULL);
+    if (rem) rem->tv_sec = rem->tv_nsec = 0;
+    return 0;
+}
+
+int usleep(useconds_t usec) {
+    if (!g_ready) {
+        struct timespec ts = {usec / 1000000, (long)(usec % 1000000) * 1000};
+        return syscall(SYS_nanosleep, &ts, NULL);
+    }
+    struct timespec ts = {usec / 1000000, (long)(usec % 1000000) * 1000};
+    return nanosleep(&ts, NULL);
+}
+
+unsigned int sleep(unsigned int seconds) {
+    struct timespec ts = {seconds, 0};
+    if (nanosleep(&ts, NULL) != 0) return seconds;
+    return 0;
+}
+
+/* ------------------------------------------------------------- random */
+
+static uint64_t splitmix64_next(void) {
+    uint64_t c = __atomic_fetch_add(&g_shm->rng_counter, 1, __ATOMIC_RELAXED);
+    uint64_t x = g_shm->rng_seed + c * 0x9E3779B97F4A7C15ull;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+    return x ^ (x >> 31);
+}
+
+ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
+    if (!g_ready) return syscall(SYS_getrandom, buf, buflen, flags);
+    uint8_t *p = buf;
+    size_t left = buflen;
+    while (left) {
+        uint64_t v = splitmix64_next();
+        size_t n = left < 8 ? left : 8;
+        memcpy(p, &v, n);
+        p += n;
+        left -= n;
+    }
+    return (ssize_t)buflen;
+}
+
+/* ------------------------------------------------------------- sockets */
+
+static int is_virtual_fd(int fd) { return g_ready && fd >= SHIM_FD_BASE; }
+
+int socket(int domain, int type, int protocol) {
+    int base_type = type & ~(SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (!g_ready || domain != AF_INET || base_type != SOCK_DGRAM)
+        return real_socket(domain, type, protocol);
+    int64_t args[6] = {domain, base_type, 0, 0, 0, 0};
+    int64_t ret = shim_call(SHIM_OP_SOCKET, args, NULL, 0, NULL, NULL, NULL);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    return (int)ret; /* manager hands out fds >= SHIM_FD_BASE */
+}
+
+int bind(int fd, const struct sockaddr *addr, socklen_t len) {
+    if (!is_virtual_fd(fd)) return real_bind(fd, addr, len);
+    if (!addr || len < sizeof(struct sockaddr_in) ||
+        addr->sa_family != AF_INET) {
+        errno = EINVAL;
+        return -1;
+    }
+    const struct sockaddr_in *sin = (const struct sockaddr_in *)addr;
+    int64_t args[6] = {fd, ntohs(sin->sin_port), 0, 0, 0, 0};
+    int64_t ret = shim_call(SHIM_OP_BIND, args, NULL, 0, NULL, NULL, NULL);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    return 0;
+}
+
+int connect(int fd, const struct sockaddr *addr, socklen_t len) {
+    if (!is_virtual_fd(fd)) return real_connect(fd, addr, len);
+    if (!addr || len < sizeof(struct sockaddr_in) ||
+        addr->sa_family != AF_INET) {
+        errno = EINVAL;
+        return -1;
+    }
+    const struct sockaddr_in *sin = (const struct sockaddr_in *)addr;
+    int64_t args[6] = {fd, (int64_t)(uint32_t)sin->sin_addr.s_addr,
+                       ntohs(sin->sin_port), 0, 0, 0};
+    int64_t ret = shim_call(SHIM_OP_CONNECT, args, NULL, 0, NULL, NULL, NULL);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    return 0;
+}
+
+ssize_t sendto(int fd, const void *buf, size_t n, int flags,
+               const struct sockaddr *addr, socklen_t len) {
+    if (!is_virtual_fd(fd)) return real_sendto(fd, buf, n, flags, addr, len);
+    uint32_t ip = 0;
+    uint16_t port = 0;
+    if (addr) {
+        if (len < sizeof(struct sockaddr_in) || addr->sa_family != AF_INET) {
+            errno = EINVAL;
+            return -1;
+        }
+        const struct sockaddr_in *sin = (const struct sockaddr_in *)addr;
+        ip = sin->sin_addr.s_addr;
+        port = ntohs(sin->sin_port);
+    }
+    if (n > SHIM_PAYLOAD_MAX) n = SHIM_PAYLOAD_MAX;
+    int64_t args[6] = {fd, (int64_t)ip, port, 0, 0, 0};
+    int64_t ret = shim_call(SHIM_OP_SENDTO, args, buf, (uint32_t)n, NULL,
+                            NULL, NULL);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    return (ssize_t)ret;
+}
+
+ssize_t send(int fd, const void *buf, size_t n, int flags) {
+    if (!is_virtual_fd(fd)) {
+        static ssize_t (*real_send)(int, const void *, size_t, int);
+        if (!real_send) real_send = dlsym(RTLD_NEXT, "send");
+        return real_send(fd, buf, n, flags);
+    }
+    return sendto(fd, buf, n, flags, NULL, 0);
+}
+
+ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
+                 struct sockaddr *addr, socklen_t *alen) {
+    if (!is_virtual_fd(fd)) return real_recvfrom(fd, buf, n, flags, addr, alen);
+    int64_t args[6] = {fd, (int64_t)n, 0, 0, 0, 0};
+    int64_t reply[6];
+    uint32_t got = (uint32_t)(n > SHIM_PAYLOAD_MAX ? SHIM_PAYLOAD_MAX : n);
+    int64_t ret = shim_call(SHIM_OP_RECVFROM, args, NULL, 0, buf, &got, reply);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in *sin = (struct sockaddr_in *)addr;
+        memset(sin, 0, sizeof(*sin));
+        sin->sin_family = AF_INET;
+        sin->sin_addr.s_addr = (uint32_t)reply[1]; /* BE ip */
+        sin->sin_port = htons((uint16_t)reply[2]);
+        *alen = sizeof(struct sockaddr_in);
+    }
+    return (ssize_t)ret;
+}
+
+ssize_t recv(int fd, void *buf, size_t n, int flags) {
+    if (!is_virtual_fd(fd)) {
+        static ssize_t (*real_recv)(int, void *, size_t, int);
+        if (!real_recv) real_recv = dlsym(RTLD_NEXT, "recv");
+        return real_recv(fd, buf, n, flags);
+    }
+    return recvfrom(fd, buf, n, flags, NULL, NULL);
+}
+
+int getsockname(int fd, struct sockaddr *addr, socklen_t *alen) {
+    if (!is_virtual_fd(fd)) return real_getsockname(fd, addr, alen);
+    int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+    int64_t reply[6];
+    int64_t ret =
+        shim_call(SHIM_OP_GETSOCKNAME, args, NULL, 0, NULL, NULL, reply);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in *sin = (struct sockaddr_in *)addr;
+        memset(sin, 0, sizeof(*sin));
+        sin->sin_family = AF_INET;
+        sin->sin_addr.s_addr = (uint32_t)reply[1];
+        sin->sin_port = htons((uint16_t)reply[2]);
+        *alen = sizeof(struct sockaddr_in);
+    }
+    return 0;
+}
+
+int close(int fd) {
+    if (!is_virtual_fd(fd)) return real_close(fd);
+    int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+    int64_t ret = shim_call(SHIM_OP_CLOSE, args, NULL, 0, NULL, NULL, NULL);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    return 0;
+}

@@ -54,11 +54,16 @@ class LogRecord:
 
 @dataclasses.dataclass
 class Delivery:
-    """Payload of a LOCAL delivery event (step 6 of the lifecycle)."""
+    """Payload of a LOCAL delivery event (step 6 of the lifecycle).
+
+    ``payload`` is opaque engine-side cargo (managed processes ride their
+    datagram bytes + ports here); it never affects event ordering or the
+    event log, which record sizes only."""
 
     src: int
     seq: int
     size: int
+    payload: object = None
 
 
 class Host:
@@ -94,8 +99,8 @@ class Host:
     def num_hosts(self) -> int:
         return len(self.engine.hosts)
 
-    def send(self, dst: int, size_bytes: int) -> int:
-        return self.engine.send_packet(self, dst, size_bytes)
+    def send(self, dst: int, size_bytes: int, payload: object = None) -> int:
+        return self.engine.send_packet(self, dst, size_bytes, payload)
 
     def set_timer(self, t_abs_ns: int) -> None:
         app = self._current_app
@@ -113,6 +118,17 @@ class Host:
 
     def resolve(self, hostname: str) -> int:
         return self.engine.resolve(hostname)
+
+    def ip_of(self, host_id: int) -> str:
+        return self.engine.ips.by_host[host_id]
+
+    @property
+    def data_directory(self) -> str:
+        return self.engine.cfg.general.data_directory
+
+    @property
+    def master_seed(self) -> int:
+        return self.engine.seed
 
     def rand_u32(self) -> int:
         v = int(
@@ -150,7 +166,10 @@ class Host:
                 data = ev.data
                 for app in self.apps:
                     self._current_app = app
-                    app.on_delivery(self, ev.time, data.src, data.seq, data.size)
+                    app.on_delivery(
+                        self, ev.time, data.src, data.seq, data.size,
+                        payload=data.payload,
+                    )
             else:
                 ev.data.execute(self)
 
@@ -188,7 +207,7 @@ class CpuEngine:
         for hid, hopt in enumerate(cfg.hosts):
             host = self.hosts[hid]
             for p in hopt.processes:
-                app = create_model(p.path, list(p.args))
+                app = create_model(p.path, list(p.args), dict(p.environment))
                 host.apps.append(app)
                 host.push_local(
                     p.start_time, Task(lambda h, a=app: _start_app(h, a), label="start")
@@ -207,7 +226,9 @@ class CpuEngine:
 
     # -- packet path (SEMANTICS.md lifecycle) ------------------------------
 
-    def send_packet(self, src_host: Host, dst: int, size_bytes: int) -> int:
+    def send_packet(
+        self, src_host: Host, dst: int, size_bytes: int, payload: object = None
+    ) -> int:
         t = src_host.now
         seq = src_host.send_seq
         src_host.send_seq += 1
@@ -226,13 +247,19 @@ class CpuEngine:
 
         arr = max(t_dep + lat_ns, self.window_end)
         self.hosts[d].queue.push(
-            Event(arr, EventKind.PACKET, src_host=s, seq=seq, data=size_bytes)
+            Event(
+                arr,
+                EventKind.PACKET,
+                src_host=s,
+                seq=seq,
+                data=(size_bytes, payload),
+            )
         )
         return seq
 
     def inbound(self, dst_host: Host, ev: Event) -> None:
         """Steps 5a-5c: down bucket, CoDel, schedule delivery."""
-        size_bytes: int = ev.data
+        size_bytes, payload = ev.data
         bits = (size_bytes + FRAME_OVERHEAD_BYTES) * 8
         t_deliver = dst_host.down_bucket.charge(ev.time, bits)
         sojourn = t_deliver - ev.time
@@ -250,7 +277,7 @@ class CpuEngine:
                 EventKind.DELIVERY,
                 src_host=ev.src_host,
                 seq=ev.seq,
-                data=Delivery(ev.src_host, ev.seq, size_bytes),
+                data=Delivery(ev.src_host, ev.seq, size_bytes, payload),
             )
         )
 
@@ -258,6 +285,16 @@ class CpuEngine:
 
     def next_event_time(self) -> int:
         return min((h.queue.next_time() for h in self.hosts), default=stime.NEVER)
+
+    def finalize(self) -> None:
+        """End-of-simulation teardown: reap managed processes still parked
+        past stop_time (the reference kills plugins at teardown too,
+        manager.rs end-of-sim)."""
+        for h in self.hosts:
+            for app in h.apps:
+                shutdown = getattr(app, "shutdown", None)
+                if shutdown is not None:
+                    shutdown()
 
     def run(self) -> "SimResult":
         t0 = wall_time.perf_counter()
@@ -269,6 +306,7 @@ class CpuEngine:
             for host in self.hosts:  # id order; serial == deterministic
                 host.execute(self.window_end)
             self.rounds += 1
+        self.finalize()
         wall = wall_time.perf_counter() - t0
 
         counters: dict[str, int] = {}

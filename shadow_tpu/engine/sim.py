@@ -86,6 +86,7 @@ class Simulation:
                     time.perf_counter() - t0,
                 )
                 next_beat += heartbeat
+        engine.finalize()
         wall = time.perf_counter() - t0
         counters: dict[str, int] = {}
         for h in engine.hosts:

@@ -53,7 +53,7 @@ class AppModel(Protocol):
 
     def on_timer(self, api: HostApi, t: int) -> None: ...
 
-    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int) -> None: ...
+    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None) -> None: ...
 
 
 _REGISTRY: dict[str, Callable[..., AppModel]] = {}
@@ -67,16 +67,26 @@ def register_model(name: str):
     return deco
 
 
-def create_model(path: str, args: list[str]) -> AppModel:
-    """Instantiate a built-in model from a process ``path`` + ``args``
-    (config-compatible with the reference's process entries: the model name
-    sits where the binary path would)."""
-    if path not in _REGISTRY:
-        raise ValueError(
-            f"unknown app model {path!r} (built-ins: {sorted(_REGISTRY)}); "
-            "real binaries require the native shim runtime"
-        )
-    return _REGISTRY[path].from_args(args)  # type: ignore[attr-defined]
+def create_model(
+    path: str, args: list[str], environment: dict | None = None
+) -> AppModel:
+    """Instantiate an app from a process ``path`` + ``args`` (config-
+    compatible with the reference's process entries).  A registered model
+    name selects the built-in (lane-compilable) tier; an executable path
+    selects the native-shim tier — a real Linux binary run under syscall
+    interposition, as the reference does for every process."""
+    if path in _REGISTRY:
+        return _REGISTRY[path].from_args(args)  # type: ignore[attr-defined]
+    import os
+
+    if os.path.isfile(path) and os.access(path, os.X_OK):
+        from ..native.process import ManagedApp
+
+        return ManagedApp([path, *args], environment)
+    raise ValueError(
+        f"unknown app model {path!r}: neither a built-in model "
+        f"({sorted(_REGISTRY)}) nor an executable file"
+    )
 
 
 def parse_kv_args(args: list[str], known: set[str] | None = None) -> dict[str, str]:

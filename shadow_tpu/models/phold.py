@@ -48,6 +48,6 @@ class Phold:
     def on_timer(self, api: HostApi, t: int) -> None:  # pragma: no cover
         pass
 
-    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int) -> None:
+    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None) -> None:
         api.count("phold_hops")
         api.send(self._pick_peer(api), self.size)

@@ -51,7 +51,7 @@ class TgenMesh:
             api.count("tgen_sent_bytes", self.size)
         api.set_timer_relative(self.interval)
 
-    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int) -> None:
+    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None) -> None:
         api.count("tgen_recv_bytes", size)
 
 
@@ -85,7 +85,7 @@ class TgenClient:
         api.count("tgen_sent_bytes", self.size)
         api.set_timer_relative(self.interval)
 
-    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int) -> None:
+    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None) -> None:
         api.count("tgen_recv_bytes", size)
 
 
@@ -102,7 +102,7 @@ class TgenServer:
     def on_timer(self, api: HostApi, t: int) -> None:
         pass
 
-    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int) -> None:
+    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None) -> None:
         api.count("tgen_recv_bytes", size)
 
 
@@ -143,7 +143,7 @@ class Ping:
             api.count("ping_sent")
             api.set_timer_relative(self.interval)
 
-    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int) -> None:
+    def on_delivery(self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None) -> None:
         if self.peer is None:
             # echo server: bounce straight back
             api.send(src, size)

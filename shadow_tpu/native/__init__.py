@@ -1,0 +1,3 @@
+from .process import ManagedApp
+
+__all__ = ["ManagedApp"]

@@ -1,0 +1,174 @@
+"""ctypes mirror of native/include/shadow_shim_abi.h + futex helpers.
+
+The byte layout must match the C struct exactly; both sides check the magic
+and total size at attach time, so drift fails loudly instead of corrupting.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import mmap
+import time
+
+SHIM_ABI_MAGIC = 0x53485457534D4831
+SHIM_PAYLOAD_MAX = 65536
+
+# ops
+OP_START = 1
+OP_EXIT = 2
+OP_NANOSLEEP = 3
+OP_SOCKET = 4
+OP_BIND = 5
+OP_SENDTO = 6
+OP_RECVFROM = 7
+OP_CLOSE = 8
+OP_CONNECT = 9
+OP_GETSOCKNAME = 10
+
+OP_NAMES = {
+    1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
+    6: "sendto", 7: "recvfrom", 8: "close", 9: "connect", 10: "getsockname",
+}
+
+SHIM_FD_BASE = 10000
+
+
+class ShimMsg(ctypes.Structure):
+    _fields_ = [
+        ("turn", ctypes.c_uint32),
+        ("op", ctypes.c_uint32),
+        ("args", ctypes.c_int64 * 6),
+        ("ret", ctypes.c_int64),
+        ("payload_len", ctypes.c_uint32),
+        ("_pad", ctypes.c_uint32),
+        ("payload", ctypes.c_uint8 * SHIM_PAYLOAD_MAX),
+    ]
+
+
+class ShimShmem(ctypes.Structure):
+    _fields_ = [
+        ("magic", ctypes.c_uint64),
+        ("abi_size", ctypes.c_uint64),
+        ("sim_clock_ns", ctypes.c_uint64),
+        ("rng_seed", ctypes.c_uint64),
+        ("rng_counter", ctypes.c_uint64),
+        ("to_shadow", ShimMsg),
+        ("to_shim", ShimMsg),
+    ]
+
+
+# -- futex (x86-64 syscall 202) ----------------------------------------------
+
+_libc = ctypes.CDLL(None, use_errno=True)
+_SYS_futex = 202
+FUTEX_WAIT = 0
+FUTEX_WAKE = 1
+
+
+class _Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+def futex_wait(addr: int, expected: int, timeout_s: float) -> None:
+    """Sleep while *addr == expected (or until timeout/wakeup)."""
+    ts = _Timespec(int(timeout_s), int((timeout_s % 1.0) * 1e9))
+    _libc.syscall(
+        _SYS_futex,
+        ctypes.c_void_p(addr),
+        FUTEX_WAIT,
+        ctypes.c_uint32(expected),
+        ctypes.byref(ts),
+        None,
+        0,
+    )
+
+
+def futex_wake(addr: int) -> None:
+    _libc.syscall(_SYS_futex, ctypes.c_void_p(addr), FUTEX_WAKE, 1, None, None, 0)
+
+
+class ShmChannel:
+    """Manager-side view of one plugin's shared-memory block."""
+
+    def __init__(self, path: str, seed: int) -> None:
+        size = ctypes.sizeof(ShimShmem)
+        with open(path, "wb") as f:
+            f.truncate(size)
+        self._f = open(path, "r+b")
+        self.mm = mmap.mmap(self._f.fileno(), size)
+        self.shm = ShimShmem.from_buffer(self.mm)
+        self.shm.magic = SHIM_ABI_MAGIC
+        self.shm.abi_size = size
+        self.shm.rng_seed = seed & ((1 << 64) - 1)
+        self.shm.rng_counter = 0
+
+    def close(self) -> None:
+        # ctypes views derived from from_buffer pin the mmap's export flag
+        # until collected; drop ours, collect, and tolerate stragglers (the
+        # region is tiny and unmapped at interpreter exit regardless)
+        import gc
+
+        del self.shm
+        gc.collect()
+        try:
+            self.mm.close()
+        except BufferError:
+            pass
+        self._f.close()
+
+    # -- protocol ----------------------------------------------------------
+
+    def set_clock(self, emu_ns: int) -> None:
+        self.shm.sim_clock_ns = emu_ns
+
+    def try_recv(self) -> bool:
+        """True if a plugin->manager message is ready (and claims it)."""
+        msg = self.shm.to_shadow
+        if msg.turn == 0:
+            return False
+        msg.turn = 0
+        return True
+
+    def wait_recv(self, alive, timeout_s: float = 30.0) -> None:
+        """Block until the plugin posts a message.  ``alive()`` is polled so
+        a dead plugin raises instead of deadlocking (the ChildPidWatcher's
+        job in the reference, utility/childpid_watcher.rs)."""
+        msg = self.shm.to_shadow
+        addr = ctypes.addressof(msg)  # 'turn' is the first field
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if msg.turn != 0:
+                msg.turn = 0
+                return
+            if not alive():
+                raise PluginDied("plugin exited without a farewell message")
+            if time.monotonic() > deadline:
+                raise TimeoutError("plugin unresponsive (blocked outside the shim?)")
+            futex_wait(addr, 0, 0.05)
+
+    def reply(self, ret: int = 0, args=None, payload: bytes = b"") -> None:
+        msg = self.shm.to_shim
+        msg.ret = ret
+        for i in range(6):
+            msg.args[i] = args[i] if args and i < len(args) else 0
+        n = min(len(payload), SHIM_PAYLOAD_MAX)
+        if n:
+            ctypes.memmove(msg.payload, payload, n)
+        msg.payload_len = n
+        msg.turn = 1
+        futex_wake(ctypes.addressof(msg))
+
+    # -- request accessors -------------------------------------------------
+
+    @property
+    def req(self) -> ShimMsg:
+        return self.shm.to_shadow
+
+    def req_payload(self) -> bytes:
+        msg = self.shm.to_shadow
+        return bytes(msg.payload[: msg.payload_len])
+
+
+class PluginDied(RuntimeError):
+    pass

@@ -1,0 +1,378 @@
+"""Managed real-binary processes: the host side of the native shim.
+
+The manager-side counterpart of the reference's process stack (L6:
+process.rs / managed_thread.rs): spawns a real Linux binary with the
+LD_PRELOAD shim injected, owns its shared-memory channel, and co-opts it
+into the discrete-event simulation — the plugin only runs while the
+simulation has handed it the turn, time only advances at event boundaries,
+and all of its network I/O flows through the simulated packet path.
+
+A ManagedApp is a normal engine app model (on_start/on_timer/on_delivery),
+so managed processes and built-in models coexist on the same simulated
+network.  CPU backend only: the lane backend rejects them via
+LaneCompatError (syscall servicing is inherently host-side; that is the
+design split BASELINE.json prescribes).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket as pysocket
+import struct
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+from ..core import time as stime
+from ..models.base import HostApi
+from . import abi
+
+log = logging.getLogger("shadow_tpu.native")
+
+UDP_HEADER_BYTES = 28  # IP (20) + UDP (8): wire size = payload + header
+EPHEMERAL_PORT_START = 49152
+
+
+def default_shim_path() -> Path:
+    return (
+        Path(__file__).resolve().parents[2] / "native" / "build" / "libshadow_shim.so"
+    )
+
+
+def require_dynamic_elf(path: str) -> None:
+    """Reject static binaries up front: LD_PRELOAD cannot interpose them
+    (same policy as the reference, src/test/static-bin)."""
+    with open(path, "rb") as f:
+        ident = f.read(16)
+        if ident[:4] != b"\x7fELF":
+            raise ValueError(f"{path!r} is not an ELF binary")
+        is64 = ident[4] == 2
+        if not is64:
+            raise ValueError(f"{path!r}: only 64-bit ELF is supported")
+        f.seek(0)
+        hdr = f.read(64)
+        e_phoff = struct.unpack_from("<Q", hdr, 0x20)[0]
+        e_phentsize = struct.unpack_from("<H", hdr, 0x36)[0]
+        e_phnum = struct.unpack_from("<H", hdr, 0x38)[0]
+        f.seek(e_phoff)
+        phdrs = f.read(e_phentsize * e_phnum)
+        for i in range(e_phnum):
+            p_type = struct.unpack_from("<I", phdrs, i * e_phentsize)[0]
+            if p_type == 3:  # PT_INTERP
+                return
+    raise ValueError(
+        f"{path!r} is statically linked; the shim requires dynamic binaries"
+    )
+
+
+class _VSocket:
+    """One virtual UDP socket of a managed process."""
+
+    __slots__ = ("vfd", "port", "default_dst", "queue")
+
+    def __init__(self, vfd: int) -> None:
+        self.vfd = vfd
+        self.port: Optional[int] = None
+        self.default_dst: Optional[tuple[int, int]] = None  # (ip_be, port)
+        self.queue: list[tuple[int, int, bytes]] = []  # (src_ip_be, src_port, data)
+
+
+class ManagedApp:
+    """Drives one real binary as a simulation app."""
+
+    def __init__(self, argv: list[str], environment: Optional[dict] = None) -> None:
+        self.argv = argv
+        self.environment = dict(environment or {})
+        self.proc: Optional[subprocess.Popen] = None
+        self.chan: Optional[abi.ShmChannel] = None
+        self.sockets: dict[int, _VSocket] = {}
+        self._next_vfd = abi.SHIM_FD_BASE
+        self._sleeping = False
+        # (vfd, caller buffer length) while parked in recvfrom
+        self._recv_blocked: Optional[tuple[int, int]] = None
+        self.finished = False
+        self.exit_code: Optional[int] = None
+        self._stdout_file = None
+        self._api = None  # host handle, set at on_start (needed for teardown)
+
+    # -- host-level port namespace (shared across sibling processes) -------
+
+    @staticmethod
+    def _host_ports(api) -> dict:
+        """port -> (app, vfd) for the whole host, so sibling processes see
+        each other's binds (EADDRINUSE) and each datagram has one owner."""
+        return api.__dict__.setdefault("_udp_ports", {})
+
+    @staticmethod
+    def _alloc_port(api) -> int:
+        nxt = api.__dict__.setdefault("_udp_next_port", EPHEMERAL_PORT_START)
+        ports = ManagedApp._host_ports(api)
+        while nxt in ports:
+            nxt += 1
+        api.__dict__["_udp_next_port"] = nxt + 1
+        return nxt
+
+    # -- engine stimuli ----------------------------------------------------
+
+    def on_start(self, api: HostApi) -> None:
+        require_dynamic_elf(self.argv[0])
+        self._api = api
+        host_dir = self._host_dir(api)
+        host_dir.mkdir(parents=True, exist_ok=True)
+        # unique per process on the host: sibling instances of one binary
+        # must not share a channel or a stdout file
+        idx = getattr(api, "apps", [self]).index(self)
+        stem = f"{Path(self.argv[0]).name}.{idx}" if idx else Path(self.argv[0]).name
+        shm_path = host_dir / f"{stem}.shm"
+        self.chan = abi.ShmChannel(str(shm_path), seed=self._proc_seed(api))
+        self.chan.set_clock(stime.sim_to_emu(api.now))
+
+        env = dict(os.environ)
+        env.update(self.environment)
+        shim = default_shim_path()
+        if not shim.exists():
+            raise RuntimeError(
+                f"native shim not built at {shim}; run `make -C native`"
+            )
+        prior = env.get("LD_PRELOAD")
+        env["LD_PRELOAD"] = f"{shim}:{prior}" if prior else str(shim)
+        env["SHADOW_TPU_SHM"] = str(shm_path)
+        self._stdout_file = open(host_dir / f"{stem}.stdout", "wb")
+        self.proc = subprocess.Popen(
+            self.argv,
+            env=env,
+            stdout=self._stdout_file,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+        )
+        api.count("managed_procs")
+        # first stop: the shim's OP_START from its constructor
+        self._service(api)
+
+    def on_timer(self, api: HostApi, t: int) -> None:
+        if self.finished or not self._sleeping:
+            return
+        self._sleeping = False
+        self._resume(api)
+        self._service(api)
+
+    def on_delivery(
+        self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None
+    ) -> None:
+        if payload is None:
+            return
+        src_port, dst_port, data = payload
+        owner = self._host_ports(api).get(dst_port)
+        if owner is None:
+            # count once per datagram, not once per sibling app
+            if getattr(api, "apps", [self])[0] is self:
+                api.count("udp_unreachable_drops")
+            return
+        app, vfd = owner
+        if app is not self or self.finished:
+            return
+        src_ip_be = _ip_to_be(api.ip_of(src))
+        self.sockets[vfd].queue.append((src_ip_be, src_port, data))
+        api.count("udp_rx_bytes", len(data))
+        if self._recv_blocked is not None and self._recv_blocked[0] == vfd:
+            _, max_len = self._recv_blocked
+            self._recv_blocked = None
+            self._reply_recv(api, vfd, max_len)
+            self._service(api)
+
+    # -- channel servicing -------------------------------------------------
+
+    def _alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def _resume(self, api: HostApi) -> None:
+        """Hand the turn back to the plugin at the current sim time."""
+        self.chan.set_clock(stime.sim_to_emu(api.now))
+        self.chan.reply(0)
+
+    def _reply_recv(self, api: HostApi, vfd: int, max_len: int) -> None:
+        src_ip_be, src_port, data = self.sockets[vfd].queue.pop(0)
+        # UDP truncation semantics: excess bytes of the datagram are
+        # discarded and the caller sees the truncated length
+        data = data[: max(max_len, 0)]
+        self.chan.set_clock(stime.sim_to_emu(api.now))
+        self.chan.reply(len(data), args=[0, src_ip_be, src_port], payload=data)
+
+    def _service(self, api: HostApi) -> None:
+        """Run the plugin until it blocks (sleep/recv) or exits — the analog
+        of ManagedThread::resume's event loop (managed_thread.rs:187-325)."""
+        while True:
+            try:
+                self.chan.wait_recv(self._alive)
+            except abi.PluginDied:
+                self._finish(api, unexpected=True)
+                return
+            req = self.chan.req
+            op = req.op
+            if op == abi.OP_START:
+                self._resume(api)
+            elif op == abi.OP_EXIT:
+                self._finish(api, unexpected=False)
+                return
+            elif op == abi.OP_NANOSLEEP:
+                ns = req.args[0]
+                if ns <= 0:
+                    self._resume(api)
+                else:
+                    self._sleeping = True
+                    api.set_timer(api.now + ns)
+                    return  # plugin stays parked until the timer fires
+            elif op == abi.OP_SOCKET:
+                vfd = self._next_vfd
+                self._next_vfd += 1
+                self.sockets[vfd] = _VSocket(vfd)
+                self.chan.reply(vfd)
+            elif op == abi.OP_BIND:
+                self._op_bind(api, req)
+            elif op == abi.OP_CONNECT:
+                self._op_connect(api, req)
+            elif op == abi.OP_SENDTO:
+                self._op_sendto(api, req)
+            elif op == abi.OP_RECVFROM:
+                vfd = req.args[0]
+                max_len = int(req.args[1])
+                sock = self.sockets.get(vfd)
+                if sock is None:
+                    self.chan.reply(-9)  # EBADF
+                elif sock.queue:
+                    self._reply_recv(api, vfd, max_len)
+                else:
+                    self._recv_blocked = (vfd, max_len)
+                    return  # parked until a delivery arrives
+            elif op == abi.OP_GETSOCKNAME:
+                self._op_getsockname(api, req)
+            elif op == abi.OP_CLOSE:
+                vfd = req.args[0]
+                sock = self.sockets.pop(vfd, None)
+                if sock is not None and sock.port is not None:
+                    self._host_ports(api).pop(sock.port, None)
+                self.chan.reply(0 if sock else -9)
+            else:
+                log.warning("unknown shim op %d from %s", op, self.argv[0])
+                self.chan.reply(-38)  # ENOSYS
+
+    # -- ops ---------------------------------------------------------------
+
+    def _op_bind(self, api: HostApi, req) -> None:
+        vfd, port = req.args[0], int(req.args[1])
+        sock = self.sockets.get(vfd)
+        if sock is None:
+            self.chan.reply(-9)
+            return
+        ports = self._host_ports(api)
+        if port == 0:
+            port = self._alloc_port(api)
+        elif port in ports:
+            self.chan.reply(-98)  # EADDRINUSE
+            return
+        sock.port = port
+        ports[port] = (self, vfd)
+        self.chan.reply(0)
+
+    def _op_connect(self, api: HostApi, req) -> None:
+        vfd = req.args[0]
+        sock = self.sockets.get(vfd)
+        if sock is None:
+            self.chan.reply(-9)
+            return
+        sock.default_dst = (int(req.args[1]) & 0xFFFFFFFF, int(req.args[2]))
+        self.chan.reply(0)
+
+    def _op_getsockname(self, api: HostApi, req) -> None:
+        sock = self.sockets.get(req.args[0])
+        if sock is None:
+            self.chan.reply(-9)
+            return
+        ip_be = _ip_to_be(api.ip_of(api.host_id))
+        self.chan.reply(0, args=[0, ip_be, sock.port or 0])
+
+    def _op_sendto(self, api: HostApi, req) -> None:
+        vfd = req.args[0]
+        sock = self.sockets.get(vfd)
+        if sock is None:
+            self.chan.reply(-9)
+            return
+        ip_be = int(req.args[1]) & 0xFFFFFFFF
+        port = int(req.args[2])
+        if ip_be == 0 and port == 0:
+            if sock.default_dst is None:
+                self.chan.reply(-89)  # EDESTADDRREQ
+                return
+            ip_be, port = sock.default_dst
+        data = self.chan.req_payload()
+        dst = api.resolve(_be_to_ip(ip_be))
+        if sock.port is None:  # auto-bind an ephemeral source port
+            sock.port = self._alloc_port(api)
+            self._host_ports(api)[sock.port] = (self, vfd)
+        api.send(dst, len(data) + UDP_HEADER_BYTES, payload=(sock.port, port, data))
+        api.count("udp_tx_bytes", len(data))
+        self.chan.reply(len(data))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _finish(self, api: HostApi, unexpected: bool) -> None:
+        self.finished = True
+        ports = self._host_ports(api)
+        for port, (app, _vfd) in list(ports.items()):
+            if app is self:
+                del ports[port]
+        if self.proc is not None:
+            try:
+                self.exit_code = self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.exit_code = self.proc.wait()
+        if self._stdout_file:
+            self._stdout_file.close()
+            self._stdout_file = None
+        if self.chan is not None:
+            self.chan.close()
+            self.chan = None
+        api.count("managed_exit_unexpected" if unexpected else "managed_exit_clean")
+        if unexpected:
+            log.warning("%s died without exit handshake", self.argv[0])
+
+    def shutdown(self) -> None:
+        """End-of-simulation teardown: a plugin still parked (blocked in
+        recvfrom past stop_time — the typical long-lived server shape) is
+        killed and reaped so no orphan OS process outlives the run.  The
+        engine calls this for every app when the simulation ends."""
+        if self.finished or self.proc is None:
+            return
+        self.finished = True
+        self.proc.kill()
+        self.exit_code = self.proc.wait()
+        if self._api is not None:
+            ports = self._host_ports(self._api)
+            for port, (app, _vfd) in list(ports.items()):
+                if app is self:
+                    del ports[port]
+            self._api.count("managed_killed_at_stop")
+        if self._stdout_file:
+            self._stdout_file.close()
+            self._stdout_file = None
+        if self.chan is not None:
+            self.chan.close()
+            self.chan = None
+
+    def _host_dir(self, api: HostApi) -> Path:
+        return Path(api.data_directory) / "hosts" / api.hostname
+
+    def _proc_seed(self, api: HostApi) -> int:
+        from ..core.rng import host_seed
+
+        return host_seed(api.master_seed, api.host_id)
+
+
+def _ip_to_be(ip: str) -> int:
+    return int.from_bytes(pysocket.inet_aton(ip), "little")
+
+
+def _be_to_ip(ip_be: int) -> str:
+    return pysocket.inet_ntoa(ip_be.to_bytes(4, "little"))

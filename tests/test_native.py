@@ -1,0 +1,113 @@
+"""Managed real-binary execution under the native LD_PRELOAD shim.
+
+The round-1 end-to-end slice of the reference's defining capability
+(SURVEY.md §7 step 4): a real, unmodified C binary runs as an OS process,
+is co-opted into the simulation via interposed libc (time from the shmem
+sim clock, sleep/UDP through the futex channel), and exchanges datagrams
+with a peer across the simulated network — bit-deterministically.
+"""
+
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.sim import Simulation
+
+REPO = Path(__file__).resolve().parents[1]
+BUILD = REPO / "native" / "build"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_build():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+    )
+    assert (BUILD / "libshadow_shim.so").exists()
+    assert (BUILD / "pingpong").exists()
+
+
+def _config(tmp_path: Path, count: int = 5) -> ConfigOptions:
+    # cli sorts before srv: cli = 11.0.0.1, srv = 11.0.0.2
+    return ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: 2s, seed: 21, data_directory: {tmp_path / 'data'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  cli:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'pingpong'}
+        args: [client, 11.0.0.2, "9000", "{count}", "100"]
+  srv:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'pingpong'}
+        args: [server, "9000", "{count}"]
+"""
+    )
+
+
+def _run(tmp_path: Path, count: int = 5):
+    sim = Simulation(_config(tmp_path, count))
+    result = sim.run()
+    data = tmp_path / "data"
+    cli_out = (data / "hosts" / "cli" / "pingpong.stdout").read_text()
+    srv_out = (data / "hosts" / "srv" / "pingpong.stdout").read_text()
+    return result, cli_out, srv_out
+
+
+def test_pingpong_end_to_end(tmp_path):
+    result, cli_out, srv_out = _run(tmp_path)
+    # 5 pings + 5 echoes, all delivered
+    delivered = [r for r in result.event_log if r.outcome == 0]
+    assert len(delivered) == 10
+    assert result.counters["managed_exit_clean"] == 2
+    assert result.counters["udp_tx_bytes"] > 0
+    assert "client: done" in cli_out
+    assert "server: echoed 5 datagrams" in srv_out
+    # RTTs come off the simulated clock: 1 ms each way over the switch
+    for line in cli_out.splitlines():
+        if line.startswith("client: ping"):
+            rtt = int(line.rsplit(" ", 2)[1])
+            assert 2_000_000 <= rtt < 10_000_000, line
+    stats = json.loads((tmp_path / "data" / "sim-stats.json").read_text())
+    assert stats["packet_outcomes"]["delivered"] == 10
+
+
+def test_pingpong_deterministic(tmp_path):
+    r1, cli1, srv1 = _run(tmp_path / "a")
+    r2, cli2, srv2 = _run(tmp_path / "b")
+    assert r1.log_tuples() == r2.log_tuples()
+    # stdout text includes sim-clock timestamps and RTTs: must be identical
+    assert cli1 == cli2
+    assert srv1 == srv2
+
+
+def test_stuck_server_reaped_at_stop(tmp_path):
+    # server expects 6 datagrams, client sends 5: the server is still parked
+    # in recvfrom at stop_time and must be killed/reaped, not orphaned
+    cfg = _config(tmp_path, count=5)
+    cfg.hosts[1].processes[0].args[-1] = "6"
+    result = Simulation(cfg).run()
+    assert result.counters["managed_killed_at_stop"] == 1
+    assert result.counters["managed_exit_clean"] == 1  # the client
+
+
+def test_static_binary_rejected(tmp_path):
+    from shadow_tpu.native.process import require_dynamic_elf
+
+    with pytest.raises(ValueError, match="not an ELF"):
+        p = tmp_path / "script.sh"
+        p.write_text("#!/bin/sh\necho hi\n")
+        p.chmod(0o755)
+        require_dynamic_elf(str(p))
+
+
+def test_unknown_model_message():
+    from shadow_tpu.models.base import create_model
+
+    with pytest.raises(ValueError, match="neither a built-in model"):
+        create_model("no-such-model", [])
