@@ -47,6 +47,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--progress", action="store_true", help="log heartbeat progress lines"
     )
     p.add_argument(
+        "--run-control",
+        action="store_true",
+        help="interactive pause/step/restart console on stdin "
+        "(p / c / cN / n / s / s:<pid> / r / rN at window boundaries)",
+    )
+    p.add_argument(
+        "--perf-logging",
+        action="store_true",
+        help="print [window-agg]/[host-exec-agg] parallelism telemetry",
+    )
+    p.add_argument(
         "--set",
         dest="overrides",
         action="append",
@@ -85,7 +96,12 @@ def main(argv: list[str] | None = None) -> int:
             cfg = ConfigOptions.from_yaml(sys.stdin.read())
         else:
             cfg = ConfigOptions.from_yaml_file(ns.config)
-        cfg.apply_overrides(parse_overrides(ns))
+        overrides = parse_overrides(ns)
+        if ns.run_control:
+            overrides["experimental.run_control"] = True
+        if ns.perf_logging:
+            overrides["experimental.perf_logging"] = True
+        cfg.apply_overrides(overrides)
         cfg.validate()
     except (ConfigError, OSError, KeyError) as e:
         print(f"config error: {e}", file=sys.stderr)

@@ -154,6 +154,15 @@ class Host:
 
     def execute(self, until: int) -> None:
         """Pop and run all events < until (Host::execute, host.rs:762-803)."""
+        pl = self.engine.perf_log
+        if pl is not None:
+            t0 = pl.timer()
+            self._execute(until)
+            pl.host_exec(self.hostname, pl.timer() - t0, until)
+        else:
+            self._execute(until)
+
+    def _execute(self, until: int) -> None:
         while True:
             ev = self.queue.peek()
             if ev is None or ev.time >= until:
@@ -216,6 +225,9 @@ class CpuEngine:
         self.event_log: list[LogRecord] = []
         self.window_end = 0
         self.rounds = 0
+        # [window-agg]/[host-exec-agg] telemetry sink (set by the facade
+        # when experimental.perf_logging is on; None = zero overhead)
+        self.perf_log = None
 
     # -- DNS --------------------------------------------------------------
 
@@ -296,16 +308,50 @@ class CpuEngine:
                 if shutdown is not None:
                     shutdown()
 
-    def run(self) -> "SimResult":
+    def describe_next_window(self, until: int) -> list[tuple[str, int, list[int]]]:
+        """Hosts with events before ``until`` + native PIDs of their managed
+        processes — what the run-control console prints while paused so a
+        debugger can attach (manager.rs:660-748)."""
+        out = []
+        for h in self.hosts:
+            t = h.queue.next_time()
+            if t < until:
+                pids = [
+                    app.proc.pid
+                    for app in h.apps
+                    if getattr(app, "proc", None) is not None
+                    and app.proc.poll() is None
+                ]
+                out.append((h.hostname, t, pids))
+        return out
+
+    def run(self, on_window=None) -> "SimResult":
+        """Round loop.  ``on_window(window_start, window_end,
+        next_event_time)`` runs after every round — the seam where the
+        facade hangs heartbeats, perf telemetry, and run-control pauses
+        (and through which RestartRequest propagates)."""
         t0 = wall_time.perf_counter()
         while True:
             start = self.next_event_time()
             if start >= self.stop_time or start == stime.NEVER:
                 break
             self.window_end = min(start + self.runahead, self.stop_time)
+            pl = self.perf_log
+            if pl is not None:
+                active = sum(
+                    1 for h in self.hosts if h.queue.next_time() < self.window_end
+                )
             for host in self.hosts:  # id order; serial == deterministic
                 host.execute(self.window_end)
             self.rounds += 1
+            if pl is not None or on_window is not None:
+                next_ev = self.next_event_time()
+                if pl is not None:
+                    pl.window_agg(
+                        active, start, self.window_end, min(next_ev, self.stop_time)
+                    )
+                if on_window is not None:
+                    on_window(start, self.window_end, next_ev)
         self.finalize()
         wall = wall_time.perf_counter() - t0
 

@@ -172,6 +172,8 @@ class TpuEngine:
         self._init_events = init_events
         self._local_seq0 = local_seq0
         self._interval = lanes.DEFAULT_INTERVAL_NS
+        # [window-agg] telemetry sink (step mode only; set by the facade)
+        self.perf_log = None
 
     def _resolve(self, hostname: str, n: int) -> int:
         from .setup import resolve_host
@@ -238,9 +240,13 @@ class TpuEngine:
 
     # -- running -----------------------------------------------------------
 
-    def run(self, mode: str = "device", precompile: bool = False) -> SimResult:
+    def run(
+        self, mode: str = "device", precompile: bool = False, on_window=None
+    ) -> SimResult:
         """``mode='device'``: one fused while_loop on the accelerator;
-        ``mode='step'``: one device call per round (debuggable, pausable).
+        ``mode='step'``: one device call per round (debuggable, pausable —
+        ``on_window(window_start, window_end, next_event_time)`` runs after
+        every round, the run-control/heartbeat seam).
         ``precompile``: AOT-compile before starting the wall-clock timer so
         ``wall_seconds`` measures only the steady-state device program."""
         state = self.initial_state()
@@ -255,9 +261,25 @@ class TpuEngine:
             round_fn = lanes.make_round_fn(self.params, self.tables)
             t0 = wall_time.perf_counter()
             while True:
+                if on_window is not None or self.perf_log is not None:
+                    # queue rows are sorted: column 0 is each lane's min
+                    lane_next = np.asarray(state.q_time[:, 0])
+                    start = int(lane_next.min())
+                    we_pred = min(start + self.params.runahead, self.params.stop_time)
+                    active = int((lane_next < we_pred).sum())
                 state, done = round_fn(state)
                 if bool(done):
                     break
+                if on_window is not None or self.perf_log is not None:
+                    window_end = int(state.now_window_end)
+                    next_ev = int(np.asarray(state.q_time[:, 0]).min())
+                    if self.perf_log is not None:
+                        self.perf_log.window_agg(
+                            active, start, window_end,
+                            min(next_ev, self.params.stop_time),
+                        )
+                    if on_window is not None:
+                        on_window(start, window_end, next_ev)
             wall = wall_time.perf_counter() - t0
         return self.collect(state, wall)
 

@@ -80,6 +80,10 @@ class ExperimentalOptions:
     interface_qdisc: str = "fifo"  # | "round-robin"
     # strace-style logging
     strace_logging_mode: str = "off"  # off | standard | deterministic
+    # fork features: interactive run-control console (pause/step/restart at
+    # window boundaries) and [window-agg]/[host-exec-agg] telemetry
+    run_control: bool = False
+    perf_logging: bool = False
     # --- TPU-native extensions -------------------------------------------
     network_backend: str = "cpu"  # "cpu" | "tpu"
     tpu_lane_queue_capacity: int = 64  # per-host in-flight packet slots

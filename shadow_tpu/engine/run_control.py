@@ -1,0 +1,292 @@
+"""Interactive run-control and perf telemetry (the fork's EDT features).
+
+Rebuild of the reference fork's run-control console and perf logging
+(reference manager.rs:40-111,1117-1443 and host.rs:39-43,807-830): the
+simulation soft-pauses only at window boundaries (never mid-host, never
+mid-syscall-IPC), a stdin console drives pause/continue/step/restart, and
+window/host-execution telemetry prints aggregate ``[window-agg]`` /
+``[host-exec-agg]`` lines for parallelism studies.
+
+Command grammar (identical to the reference fork):
+
+- ``p``        pause at the next window boundary
+- ``c``        continue (resume)
+- ``cN``       continue for N seconds of *simulated* time, then pause
+- ``n``        run exactly one more window, then pause (gdb-like next)
+- ``s``        show next-window hosts/PIDs (when paused)
+- ``s:<pid>``  print a gdb attach command for a managed process
+- ``info``     same as ``s``
+- ``r``        restart from t=0 (in-process, deterministic)
+- ``rN``       restart and run to N simulated seconds, then pause
+
+Restart is delivered as a :class:`RestartRequest` raised out of the round
+loop and caught by the simulation facade, which rebuilds the engine from the
+same config (determinism makes the re-run bit-identical) — the analog of the
+reference's ``RestartRequest`` error unwound to shadow.rs:233-241.
+"""
+
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+import time as wall_time
+from typing import Callable, Optional, TextIO
+
+from ..core import time as stime
+
+NANOS_PER_SEC = stime.NANOS_PER_SEC
+
+
+class RestartRequest(Exception):
+    """Unwound out of the round loop to trigger an in-process restart."""
+
+    def __init__(self, run_until_ns: Optional[int] = None) -> None:
+        self.run_until_ns = run_until_ns
+        if run_until_ns is None:
+            super().__init__("restart requested")
+        else:
+            super().__init__(f"restart requested: run until {run_until_ns} ns")
+
+
+# one entry per host that has events in the next window:
+# (hostname, next_event_time_ns, [native pids of managed processes])
+WindowInfo = list[tuple[str, int, list[int]]]
+
+
+class RunControl:
+    """Window-boundary soft-pause state machine.
+
+    Commands arrive on an internal queue — from the interactive stdin
+    reader thread (:meth:`start_stdin_thread`) or scripted via
+    :meth:`feed` (tests, programmatic drivers)."""
+
+    def __init__(
+        self,
+        out: TextIO = sys.stderr,
+        poll_interval: float = 0.2,
+        max_wait: Optional[float] = None,
+    ) -> None:
+        self._cmds: "queue.Queue[str]" = queue.Queue()
+        self._out = out
+        self._poll = poll_interval
+        self._max_wait = max_wait  # tests: raise instead of blocking forever
+        self.pause_requested = False
+        self.step_windows_remaining = 0
+        self.run_until_abs_ns: Optional[int] = None
+        self.pauses = 0  # telemetry: how many soft-pauses happened
+        self._stdin_started = False
+        # set by the engine before each boundary so s/info can answer
+        self._describe: Optional[Callable[[], WindowInfo]] = None
+
+    # -- command input -----------------------------------------------------
+
+    def feed(self, *commands: str) -> None:
+        """Queue commands programmatically (the scripted stdin)."""
+        for c in commands:
+            self._cmds.put(c)
+
+    def start_stdin_thread(self) -> None:
+        """Read commands from stdin on a daemon thread (interactive use)."""
+        if self._stdin_started:
+            return
+        self._stdin_started = True
+
+        def pump() -> None:
+            for line in sys.stdin:
+                self._cmds.put(line.strip())
+
+        threading.Thread(target=pump, name="run-control-stdin", daemon=True).start()
+
+    # -- boundary hook (called by the engine after every window) -----------
+
+    def at_window_boundary(
+        self,
+        window_start: int,
+        window_end: int,
+        next_event_time: int,
+        describe: Optional[Callable[[], WindowInfo]] = None,
+    ) -> None:
+        """Apply pending requests; soft-pause (block) if asked.  Raises
+        :class:`RestartRequest` when a restart command arrives."""
+        self._describe = describe
+        # pending step/run-until pauses take effect before new commands read
+        should_pause = self.pause_requested
+        if self.step_windows_remaining > 0:
+            self.step_windows_remaining -= 1
+            should_pause = should_pause or self.step_windows_remaining == 0
+        if self.run_until_abs_ns is not None and window_end >= self.run_until_abs_ns:
+            self.run_until_abs_ns = None
+            should_pause = True
+        if not should_pause and self.run_until_abs_ns is None:
+            # read typed-ahead commands — at most one *state-changing*
+            # command per boundary, and none at all while a run-until pause
+            # is scheduled, so a queued resume command survives for the
+            # pause it is meant to end (scripted drivers)
+            while True:
+                try:
+                    cmd = self._cmds.get_nowait()
+                except queue.Empty:
+                    break
+                self._apply(cmd)
+                if self.pause_requested:
+                    should_pause = True
+                    break
+                if self.step_windows_remaining > 0:
+                    self.step_windows_remaining -= 1
+                    if self.step_windows_remaining == 0:
+                        should_pause = True
+                        break
+                if self._pending_run_for is not None:
+                    break
+
+        self.pause_requested = False
+        if not should_pause:
+            return
+
+        self.pauses += 1
+        self._print(
+            f"[run-control] paused at window boundary: sim-time "
+            f"{stime.fmt(window_end)} (next event {stime.fmt(next_event_time)}); "
+            "commands: c / cN / n / s / s:<pid> / r / rN"
+        )
+        self._print_info()
+        # soft-wait: block until a resuming command arrives
+        waited = 0.0
+        while True:
+            try:
+                cmd = self._cmds.get(timeout=self._poll)
+            except queue.Empty:
+                waited += self._poll
+                if self._max_wait is not None and waited >= self._max_wait:
+                    raise RuntimeError(
+                        "run-control pause exceeded max_wait with no command"
+                    )
+                continue
+            if self._apply(cmd, paused=True):
+                return
+
+    # -- command semantics -------------------------------------------------
+
+    def _apply(self, cmd: str, paused: bool = False) -> bool:
+        """Apply one command; returns True iff it resumes a paused run."""
+        cmd = cmd.strip()
+        if not cmd:
+            return False
+        if cmd == "p":
+            self.pause_requested = True
+            return False
+        if cmd == "c":
+            return True  # resume; when running, a bare c is a no-op
+        if cmd.startswith("c") and cmd[1:].strip().isdigit():
+            # run-for is relative to *now*; the engine translates it into an
+            # absolute pause time via consume_run_for at the resume point
+            self.run_until_abs_ns = None
+            self._pending_run_for = int(cmd[1:].strip()) * NANOS_PER_SEC
+            self.pause_requested = False
+            return True
+        if cmd == "n":
+            self.step_windows_remaining = 1
+            return True
+        if cmd in ("s", "info"):
+            if paused:
+                self._print_info()
+            else:
+                self._print("[run-control] info is available while paused (p first)")
+            return False
+        if cmd.startswith("s:"):
+            pid = cmd[2:].strip()
+            self._print(
+                f"[run-control] attach with: gdb -p {pid}  "
+                "(process is parked at a window boundary)"
+            )
+            return False
+        if cmd == "r":
+            raise RestartRequest(None)
+        if cmd.startswith("r") and cmd[1:].strip().isdigit():
+            raise RestartRequest(int(cmd[1:].strip()) * NANOS_PER_SEC)
+        self._print(f"[run-control] unknown command {cmd!r}")
+        return False
+
+    _pending_run_for: Optional[int] = None
+
+    def consume_run_for(self, now_ns: int) -> None:
+        """Translate a pending relative ``cN`` into an absolute pause time
+        (called by the engine right after a resume)."""
+        if self._pending_run_for is not None:
+            self.run_until_abs_ns = now_ns + self._pending_run_for
+            self._pending_run_for = None
+
+    def arm_after_restart(self, run_until_ns: Optional[int]) -> None:
+        """Configure the fresh run after a restart: run to the target time
+        then pause (rN), or run freely (r)."""
+        self.pause_requested = False
+        self.step_windows_remaining = 0
+        self._pending_run_for = None
+        self.run_until_abs_ns = run_until_ns
+
+    # -- output ------------------------------------------------------------
+
+    def _print(self, line: str) -> None:
+        print(line, file=self._out, flush=True)
+
+    def _print_info(self) -> None:
+        if self._describe is None:
+            return
+        info = self._describe()
+        if not info:
+            self._print("[run-control] no hosts with events in the next window")
+            return
+        self._print(
+            f"[run-control] {len(info)} host(s) with events in the next window:"
+        )
+        for hostname, t, pids in info:
+            pid_s = f" pids={','.join(map(str, pids))}" if pids else ""
+            self._print(f"[run-control]   {hostname}: next event {stime.fmt(t)}{pid_s}")
+
+
+class PerfLog:
+    """``[window-agg]`` / ``[host-exec-agg]`` telemetry (reference fork
+    manager.rs:636-656, host.rs:807-830).  Line formats match the fork so
+    existing analysis tooling parses both."""
+
+    HOST_EXEC_LOG_EVERY = 1000  # host.rs:43
+
+    def __init__(self, out: Optional[TextIO] = None) -> None:
+        self._out = out  # None = whatever sys.stderr is at emit time
+        self.host_exec_calls = 0
+        self.host_exec_total_ns = 0
+
+    @property
+    def _sink(self) -> TextIO:
+        return self._out if self._out is not None else sys.stderr
+
+    def window_agg(
+        self,
+        active_hosts: int,
+        window_start: int,
+        window_end: int,
+        next_event_time: int,
+    ) -> None:
+        print(
+            f"[window-agg] active_hosts_in_window={active_hosts} "
+            f"window_start_ns={window_start} window_end_ns={window_end} "
+            f"next_event_ns={next_event_time}",
+            file=self._sink,
+            flush=True,
+        )
+
+    def host_exec(self, hostname: str, elapsed_ns: int, window_end: int) -> None:
+        self.host_exec_calls += 1
+        self.host_exec_total_ns += elapsed_ns
+        if self.host_exec_calls % self.HOST_EXEC_LOG_EVERY == 0:
+            print(
+                f"[host-exec-agg] calls={self.host_exec_calls} "
+                f"total_ns={self.host_exec_total_ns} last_ns={elapsed_ns} "
+                f"host={hostname} window_end_abs_ns={window_end}",
+                file=self._sink,
+                flush=True,
+            )
+
+    def timer(self) -> float:
+        return wall_time.perf_counter_ns()

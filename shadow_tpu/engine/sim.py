@@ -5,12 +5,18 @@ The user-facing runner, covering the reference's L0-L3 surface
 backend, run the round loop, emit heartbeat progress, and write the data
 directory (``sim-stats.json``, the counter dump the reference writes at
 manager.rs:844-846, plus an optional event log for determinism diffs).
+
+Also owns the fork-feature surface: in-process restart (RestartRequest
+unwound from the round loop and re-run from a fresh engine, the analog of
+shadow.rs:233-241) and the run-control / perf-logging hooks of
+:mod:`shadow_tpu.engine.run_control`.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import sys
 import time
 from pathlib import Path
 from typing import Optional
@@ -18,6 +24,7 @@ from typing import Optional
 from ..backend.cpu_engine import OUTCOME_NAMES, CpuEngine, SimResult
 from ..config.options import ConfigOptions
 from ..core import time as stime
+from .run_control import PerfLog, RestartRequest, RunControl
 
 log = logging.getLogger("shadow_tpu")
 
@@ -26,10 +33,20 @@ class Simulation:
     """Owns one simulation run end to end (the reference's Controller +
     Manager collapsed: config in, data directory out)."""
 
-    def __init__(self, cfg: ConfigOptions) -> None:
+    def __init__(
+        self, cfg: ConfigOptions, run_control: Optional[RunControl] = None
+    ) -> None:
         cfg.validate()
         self.cfg = cfg
         self.data_dir = Path(cfg.general.data_directory)
+        self.run_control = run_control
+        if run_control is None and cfg.experimental.run_control:
+            self.run_control = RunControl()
+            if sys.stdin is not None and not sys.stdin.closed:
+                # works for interactive terminals and piped command scripts
+                # alike; a stdin already drained for the config just EOFs
+                self.run_control.start_stdin_thread()
+        self.restarts = 0
 
     # -- running -----------------------------------------------------------
 
@@ -44,10 +61,24 @@ class Simulation:
             backend,
             cfg.general.seed,
         )
-        if backend == "tpu":
-            result = self._run_tpu()
-        else:
-            result = self._run_cpu()
+        # in-process restart loop: a RestartRequest aborts the round loop,
+        # the engine is torn down, and a fresh deterministic run begins
+        while True:
+            try:
+                if backend == "tpu":
+                    result = self._run_tpu()
+                else:
+                    result = self._run_cpu()
+                break
+            except RestartRequest as rr:
+                self.restarts += 1
+                log.info(
+                    "restarting simulation (restart #%d, run_until=%s)",
+                    self.restarts,
+                    "-" if rr.run_until_ns is None else stime.fmt(rr.run_until_ns),
+                )
+                if self.run_control is not None:
+                    self.run_control.arm_after_restart(rr.run_until_ns)
         total = time.perf_counter() - t0
         log.info(
             "simulation done: %s simulated in %.2fs wall (%.2fx real time), "
@@ -62,44 +93,56 @@ class Simulation:
             self._write_data(result, total)
         return result
 
+    def _make_on_window(self, describe_source, runahead: int, t0: float):
+        """Compose the per-round callback: heartbeat lines + run-control
+        boundary processing.  ``describe_source(until)`` names the hosts
+        with events before ``until`` (for the pause console)."""
+        heartbeat = self.cfg.general.heartbeat_interval
+        rc = self.run_control
+        if not heartbeat and rc is None:
+            return None  # no consumer: keep the round loop free of the hook
+        state = {"next_beat": heartbeat or 0, "rounds": 0}
+
+        def on_window(window_start: int, window_end: int, next_ev: int) -> None:
+            state["rounds"] += 1
+            if heartbeat:
+                while window_end >= state["next_beat"]:
+                    log.info(
+                        "heartbeat: sim-time %s, %d rounds, %.1fs wall",
+                        stime.fmt(state["next_beat"]),
+                        state["rounds"],
+                        time.perf_counter() - t0,
+                    )
+                    state["next_beat"] += heartbeat
+            if rc is not None:
+                # next_ev == NEVER means no next window: describe nothing
+                # rather than listing every idle host
+                until = next_ev + runahead if next_ev < stime.NEVER else 0
+                rc.at_window_boundary(
+                    window_start,
+                    window_end,
+                    next_ev,
+                    describe=(
+                        (lambda: describe_source(until)) if describe_source else None
+                    ),
+                )
+                rc.consume_run_for(window_end)
+
+        return on_window
+
     def _run_cpu(self) -> SimResult:
         engine = CpuEngine(self.cfg)
-        heartbeat = self.cfg.general.heartbeat_interval
-        if not heartbeat:
-            return engine.run()
-        # windowed run with heartbeat lines (manager.rs:602-608)
+        if self.cfg.experimental.perf_logging:
+            engine.perf_log = PerfLog()
         t0 = time.perf_counter()
-        next_beat = heartbeat
-        while True:
-            start = engine.next_event_time()
-            if start >= engine.stop_time or start == stime.NEVER:
-                break
-            engine.window_end = min(start + engine.runahead, engine.stop_time)
-            for host in engine.hosts:
-                host.execute(engine.window_end)
-            engine.rounds += 1
-            while engine.window_end >= next_beat:
-                log.info(
-                    "heartbeat: sim-time %s, %d rounds, %.1fs wall",
-                    stime.fmt(next_beat),
-                    engine.rounds,
-                    time.perf_counter() - t0,
-                )
-                next_beat += heartbeat
-        engine.finalize()
-        wall = time.perf_counter() - t0
-        counters: dict[str, int] = {}
-        for h in engine.hosts:
-            for k, v in h.counters.items():
-                counters[k] = counters.get(k, 0) + v
-        return SimResult(
-            sim_time_ns=engine.stop_time,
-            wall_seconds=wall,
-            rounds=engine.rounds,
-            event_log=engine.event_log,
-            counters=counters,
-            per_host_counters=[dict(h.counters) for h in engine.hosts],
+        on_window = self._make_on_window(
+            engine.describe_next_window, engine.runahead, t0
         )
+        try:
+            return engine.run(on_window=on_window)
+        except RestartRequest:
+            engine.finalize()  # reap managed processes before the re-run
+            raise
 
     def _run_tpu(self) -> SimResult:
         from ..backend.tpu_engine import TpuEngine
@@ -111,13 +154,29 @@ class Simulation:
 
             from .. import parallel
 
+            if self.run_control is not None or self.cfg.experimental.perf_logging:
+                log.warning(
+                    "run-control / perf-logging are not supported on the "
+                    "sharded-mesh driver (fused on-device loop); running "
+                    "without them — drop tpu_mesh_shape to use them"
+                )
+
             mesh = parallel.make_mesh(mesh_shape[0])
             state = parallel.shard_state(engine.initial_state(), mesh)
             run_fn = parallel.make_sharded_run_fn(engine.params, engine.tables, mesh)
             t0 = time.perf_counter()
             final = jax.block_until_ready(run_fn(state))
             return engine.collect(final, time.perf_counter() - t0)
-        return engine.run(mode="device")
+        # run-control / perf logging force the step-wise driver (one device
+        # call per round, pausable); otherwise the fused on-device loop
+        needs_steps = self.run_control is not None or self.cfg.experimental.perf_logging
+        if not needs_steps:
+            return engine.run(mode="device")
+        t0 = time.perf_counter()
+        on_window = self._make_on_window(None, engine.params.runahead, t0)
+        if self.cfg.experimental.perf_logging:
+            engine.perf_log = PerfLog()
+        return engine.run(mode="step", on_window=on_window)
 
     # -- output ------------------------------------------------------------
 
@@ -129,6 +188,7 @@ class Simulation:
             "total_wall_seconds": total_wall,
             "sim_seconds_per_wall_second": result.sim_seconds_per_wall_second,
             "rounds": result.rounds,
+            "restarts": self.restarts,
             "backend": self.cfg.experimental.network_backend,
             "num_hosts": len(self.cfg.hosts),
             "seed": self.cfg.general.seed,

@@ -1,0 +1,174 @@
+"""Run-control console + perf logging (the reference fork's EDT features,
+manager.rs:40-111,1117-1443; host.rs:807-830).
+
+Commands are scripted through RunControl.feed — the same queue the
+interactive stdin thread feeds — so the tests drive exactly the production
+code path minus the terminal.
+"""
+
+import io
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.run_control import PerfLog, RestartRequest, RunControl
+from shadow_tpu.engine.sim import Simulation
+
+BASE_YAML = """
+general:
+  stop_time: 3s
+  heartbeat_interval: null
+experimental:
+  runahead: 100 ms
+hosts:
+  a:
+    processes: [{path: ping, args: --peer b --count 5 --interval 200ms}]
+  b:
+    processes: [{path: ping}]
+"""
+
+
+def make_cfg(**overrides):
+    cfg = ConfigOptions.from_yaml(BASE_YAML)
+    cfg.apply_overrides(overrides)
+    return cfg
+
+
+def run_with_commands(cfg, *commands):
+    rc = RunControl(out=io.StringIO(), poll_interval=0.01, max_wait=10)
+    rc.feed(*commands)
+    sim = Simulation(cfg, run_control=rc)
+    result = sim.run(write_data=False)
+    return rc, sim, result
+
+
+class TestCommandParsing:
+    def test_pause_request(self):
+        rc = RunControl(out=io.StringIO())
+        assert rc._apply("p") is False
+        assert rc.pause_requested
+
+    def test_continue_resumes(self):
+        rc = RunControl(out=io.StringIO())
+        assert rc._apply("c", paused=True) is True
+
+    def test_run_for_seconds(self):
+        rc = RunControl(out=io.StringIO())
+        assert rc._apply("c2") is True
+        rc.consume_run_for(500)
+        assert rc.run_until_abs_ns == 500 + 2 * 10**9
+
+    def test_step_one_window(self):
+        rc = RunControl(out=io.StringIO())
+        assert rc._apply("n") is True
+        assert rc.step_windows_remaining == 1
+
+    def test_restart(self):
+        rc = RunControl(out=io.StringIO())
+        with pytest.raises(RestartRequest) as ei:
+            rc._apply("r")
+        assert ei.value.run_until_ns is None
+
+    def test_restart_to_time(self):
+        rc = RunControl(out=io.StringIO())
+        with pytest.raises(RestartRequest) as ei:
+            rc._apply("r2")
+        assert ei.value.run_until_ns == 2 * 10**9
+
+    def test_unknown_command_reports(self):
+        out = io.StringIO()
+        rc = RunControl(out=out)
+        rc._apply("bogus")
+        assert "unknown command" in out.getvalue()
+
+    def test_attach_hint(self):
+        out = io.StringIO()
+        rc = RunControl(out=out)
+        rc._apply("s:1234")
+        assert "gdb -p 1234" in out.getvalue()
+
+
+class TestSoftPause:
+    def test_pause_then_continue_completes(self):
+        # p pauses at the first boundary; c resumes; the run completes
+        rc, sim, result = run_with_commands(make_cfg(), "p", "c")
+        assert rc.pauses == 1
+        assert result.counters.get("ping_recv", 0) == 5
+
+    def test_step_pauses_each_window(self):
+        # n runs exactly one more window then pauses again; three steps
+        # then continue
+        rc, sim, result = run_with_commands(make_cfg(), "n", "n", "n", "c")
+        # the first n is consumed while running (acts like "pause after
+        # next window"); each subsequent n is issued from a pause
+        assert rc.pauses == 3
+        assert result.counters.get("ping_recv", 0) == 5
+
+    def test_run_for_simulated_time(self):
+        # c1: run one simulated second then pause; then c to finish
+        rc, sim, result = run_with_commands(make_cfg(), "c1", "c")
+        assert rc.pauses == 1
+        assert result.counters.get("ping_recv", 0) == 5
+
+    def test_info_prints_hosts(self):
+        out = io.StringIO()
+        rc = RunControl(out=out, poll_interval=0.01, max_wait=10)
+        rc.feed("p", "s", "c")
+        sim = Simulation(make_cfg(), run_control=rc)
+        sim.run(write_data=False)
+        text = out.getvalue()
+        assert "host(s) with events in the next window" in text
+        assert "a:" in text or "b:" in text
+
+
+class TestRestart:
+    def test_restart_reruns_deterministically(self):
+        # restart at the first boundary, then run through; the final result
+        # must equal an undisturbed run (determinism = replay)
+        rc, sim, result = run_with_commands(make_cfg(), "r")
+        assert sim.restarts == 1
+        baseline = Simulation(make_cfg()).run(write_data=False)
+        assert result.log_tuples() == baseline.log_tuples()
+        assert result.counters == baseline.counters
+
+    def test_restart_to_time_pauses_then_resumes(self):
+        rc, sim, result = run_with_commands(make_cfg(), "r1", "c")
+        assert sim.restarts == 1
+        assert rc.pauses == 1  # paused once at ~1s after the restart
+        baseline = Simulation(make_cfg()).run(write_data=False)
+        assert result.log_tuples() == baseline.log_tuples()
+
+
+class TestPerfLogging:
+    def test_window_agg_lines_cpu(self, capsys):
+        cfg = make_cfg(**{"experimental.perf_logging": True})
+        Simulation(cfg).run(write_data=False)
+        err = capsys.readouterr().err
+        assert "[window-agg] active_hosts_in_window=" in err
+        assert "window_start_ns=" in err
+
+    def test_window_agg_lines_tpu_step(self, capsys):
+        cfg = make_cfg(
+            **{
+                "experimental.perf_logging": True,
+                "experimental.network_backend": "tpu",
+            }
+        )
+        Simulation(cfg).run(write_data=False)
+        err = capsys.readouterr().err
+        assert "[window-agg] active_hosts_in_window=" in err
+
+    def test_host_exec_agg_threshold(self):
+        out = io.StringIO()
+        pl = PerfLog(out=out)
+        for _ in range(PerfLog.HOST_EXEC_LOG_EVERY):
+            pl.host_exec("h", 100, 10**9)
+        text = out.getvalue()
+        assert "[host-exec-agg] calls=1000" in text
+        assert "host=h" in text
+
+    def test_parity_with_perf_logging_off(self):
+        base = Simulation(make_cfg()).run(write_data=False)
+        cfg = make_cfg(**{"experimental.perf_logging": True})
+        withperf = Simulation(cfg).run(write_data=False)
+        assert base.log_tuples() == withperf.log_tuples()
