@@ -123,6 +123,10 @@ class Host:
         return self.engine.ips.by_host[host_id]
 
     @property
+    def hosts_file_path(self):
+        return self.engine.hosts_file_path
+
+    @property
     def data_directory(self) -> str:
         return self.engine.cfg.general.data_directory
 
@@ -200,7 +204,7 @@ class CpuEngine:
         (
             self.graph,
             self.ips,
-            self.hostname_to_id,
+            self.dns,
             self.routing,
             bw_up_arr,
             bw_dn_arr,
@@ -222,6 +226,19 @@ class CpuEngine:
                     p.start_time, Task(lambda h, a=app: _start_app(h, a), label="start")
                 )
 
+        # managed (real-binary) processes resolve simulated names through an
+        # /etc/hosts-style file (the reference passes plugins a memfd hosts
+        # file, dns.rs:130-190); written once per run, only when needed
+        from pathlib import Path
+
+        from ..native.process import ManagedApp
+
+        self.hosts_file_path = None
+        if any(isinstance(a, ManagedApp) for h in self.hosts for a in h.apps):
+            self.hosts_file_path = self.dns.write_hosts_file(
+                Path(cfg.general.data_directory) / "etc-hosts"
+            )
+
         self.event_log: list[LogRecord] = []
         self.window_end = 0
         self.rounds = 0
@@ -229,12 +246,10 @@ class CpuEngine:
         # when experimental.perf_logging is on; None = zero overhead)
         self.perf_log = None
 
-    # -- DNS --------------------------------------------------------------
+    # -- DNS (network/dns.rs) ----------------------------------------------
 
     def resolve(self, hostname: str) -> int:
-        from .setup import resolve_host
-
-        return resolve_host(hostname, self.hostname_to_id, self.ips, len(self.hosts))
+        return self.dns.resolve(hostname)
 
     # -- packet path (SEMANTICS.md lifecycle) ------------------------------
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..config.options import ConfigOptions
+from ..net.dns import Dns
 from ..net.graph import IpAssignment, NetworkGraph, RoutingInfo
 
 
@@ -24,16 +25,17 @@ def build_graph(cfg: ConfigOptions) -> NetworkGraph:
 
 
 def build_world(cfg: ConfigOptions):
-    """(graph, ips, hostname_to_id, routing, bw_up[N], bw_dn[N], runahead)."""
+    """(graph, ips, dns, routing, bw_up[N], bw_dn[N], runahead)."""
     graph = build_graph(cfg)
     ips = IpAssignment()
-    hostname_to_id = {h.hostname: i for i, h in enumerate(cfg.hosts)}
+    dns = Dns()
     node_map: dict[int, int] = {}
     n = len(cfg.hosts)
     bw_up = np.zeros(n, dtype=np.int64)
     bw_dn = np.zeros(n, dtype=np.int64)
     for hid, hopt in enumerate(cfg.hosts):
-        ips.assign(hid, hopt.ip_addr)
+        ip = ips.assign(hid, hopt.ip_addr)
+        dns.register(hid, hopt.hostname, ip)
         node_map[hid] = hopt.network_node_id
         nb_up, nb_down = graph.node_bandwidth(hopt.network_node_id)
         up = hopt.bandwidth_up if hopt.bandwidth_up is not None else nb_up
@@ -46,22 +48,4 @@ def build_world(cfg: ConfigOptions):
     routing = RoutingInfo(graph, node_map)
     floor = cfg.experimental.runahead or 0
     runahead = max(routing.min_used_latency_ns(), floor, 1)
-    return graph, ips, hostname_to_id, routing, bw_up, bw_dn, runahead
-
-
-def resolve_host(
-    hostname: str, hostname_to_id: dict[str, int], ips: IpAssignment, n: int
-) -> int:
-    """DNS-style resolution: hostname, IP string, or numeric host id."""
-    if hostname in hostname_to_id:
-        return hostname_to_id[hostname]
-    hid = ips.host_for_ip(hostname)
-    if hid is not None:
-        return hid
-    try:
-        hid = int(hostname)
-    except ValueError:
-        raise ValueError(f"unknown hostname {hostname!r}") from None
-    if not 0 <= hid < n:
-        raise ValueError(f"host id {hid} out of range (have {n} hosts)")
-    return hid
+    return graph, ips, dns, routing, bw_up, bw_dn, runahead

@@ -61,7 +61,7 @@ class TpuEngine:
         (
             self.graph,
             self.ips,
-            self.hostname_to_id,
+            self.dns,
             self.routing,
             bw_up,
             bw_dn,
@@ -176,9 +176,7 @@ class TpuEngine:
         self.perf_log = None
 
     def _resolve(self, hostname: str, n: int) -> int:
-        from .setup import resolve_host
-
-        return resolve_host(hostname, self.hostname_to_id, self.ips, n)
+        return self.dns.resolve(hostname)
 
     # -- state construction ------------------------------------------------
 

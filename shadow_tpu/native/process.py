@@ -138,6 +138,11 @@ class ManagedApp:
         prior = env.get("LD_PRELOAD")
         env["LD_PRELOAD"] = f"{shim}:{prior}" if prior else str(shim)
         env["SHADOW_TPU_SHM"] = str(shm_path)
+        # simulated-name resolution: the shim's getaddrinfo parses this
+        # hosts file locally (the reference's memfd /etc/hosts, dns.rs:130)
+        hosts_file = getattr(api, "hosts_file_path", None)
+        if hosts_file is not None:
+            env["SHADOW_TPU_HOSTS_FILE"] = str(hosts_file)
         self._stdout_file = open(host_dir / f"{stem}.stdout", "wb")
         self.proc = subprocess.Popen(
             self.argv,

@@ -247,7 +247,7 @@ def test_unknown_model_args_rejected():
 
 
 def test_out_of_range_numeric_peer_rejected():
-    with pytest.raises(ValueError, match="out of range"):
+    with pytest.raises(ValueError, match="unknown hostname"):
         CpuEngine(
             ConfigOptions.from_yaml(
                 "general: {stop_time: 1s}\n"

@@ -1,0 +1,108 @@
+"""DNS registry + hosts-file emission (reference network/dns.rs:86-190)."""
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.net.dns import Dns, DnsError
+
+
+def make_dns():
+    d = Dns()
+    d.register(0, "alpha", "11.0.0.1")
+    d.register(1, "beta", "11.0.0.2")
+    d.register(2, "gamma", "10.1.2.3")
+    return d
+
+
+class TestRegistry:
+    def test_forward_lookup(self):
+        d = make_dns()
+        assert d.resolve("alpha") == 0
+        assert d.resolve("gamma") == 2
+
+    def test_reverse_lookup(self):
+        d = make_dns()
+        assert d.resolve("11.0.0.2") == 1
+        assert d.host_for_ip("10.1.2.3") == 2
+        assert d.host_for_ip("9.9.9.9") is None
+
+    def test_numeric_id_lookup(self):
+        d = make_dns()
+        assert d.resolve("1") == 1
+        assert d.try_resolve("99") is None
+
+    def test_ip_and_name_of(self):
+        d = make_dns()
+        assert d.ip_of(0) == "11.0.0.1"
+        assert d.name_of(2) == "gamma"
+
+    def test_unknown_raises(self):
+        with pytest.raises(DnsError):
+            make_dns().resolve("nope")
+
+    def test_duplicate_hostname_rejected(self):
+        d = make_dns()
+        with pytest.raises(DnsError):
+            d.register(3, "alpha", "11.0.0.9")
+
+    def test_duplicate_ip_rejected(self):
+        d = make_dns()
+        with pytest.raises(DnsError):
+            d.register(3, "delta", "11.0.0.1")
+
+
+class TestHostsFile:
+    def test_format(self):
+        text = make_dns().hosts_file()
+        lines = text.splitlines()
+        assert lines[0] == "127.0.0.1 localhost"
+        assert lines[1] == "11.0.0.1 alpha"
+        assert lines[3] == "10.1.2.3 gamma"
+
+    def test_write(self, tmp_path):
+        p = make_dns().write_hosts_file(tmp_path / "sub" / "etc-hosts")
+        assert p.read_text() == make_dns().hosts_file()
+
+
+class TestEngineIntegration:
+    YAML = """
+general: {stop_time: 1s, heartbeat_interval: null}
+hosts:
+  server: {processes: [{path: ping}]}
+  client:
+    processes: [{path: ping, args: --peer server --count 2 --interval 100ms}]
+"""
+
+    def test_engines_share_registry(self):
+        from shadow_tpu.backend.cpu_engine import CpuEngine
+        from shadow_tpu.backend.tpu_engine import TpuEngine
+
+        cfg = ConfigOptions.from_yaml(self.YAML)
+        cpu = CpuEngine(cfg)
+        tpu = TpuEngine(ConfigOptions.from_yaml(self.YAML))
+        # hosts sort lexicographically: client=0, server=1
+        assert cpu.dns.resolve("server") == tpu.dns.resolve("server") == 1
+        assert cpu.dns.ip_of(0) == tpu.dns.ip_of(0)
+        assert cpu.dns.hosts_file() == tpu.dns.hosts_file()
+
+    def test_model_resolution_by_ip(self):
+        # a model may name its peer by simulated IP instead of hostname
+        from shadow_tpu.backend.cpu_engine import CpuEngine
+
+        cfg = ConfigOptions.from_yaml(self.YAML)
+        probe = CpuEngine(cfg)
+        server_ip = probe.dns.ip_of(1)
+        cfg2 = ConfigOptions.from_yaml(self.YAML.replace("--peer server", f"--peer {server_ip}"))
+        result_ip = None
+        engine = CpuEngine(cfg2)
+        res = engine.run()
+        assert res.counters.get("ping_recv", 0) == 2
+
+    def test_no_hosts_file_for_pure_model_runs(self, tmp_path):
+        cfg = ConfigOptions.from_yaml(self.YAML)
+        cfg.general.data_directory = str(tmp_path / "data")
+        from shadow_tpu.backend.cpu_engine import CpuEngine
+
+        engine = CpuEngine(cfg)
+        assert engine.hosts_file_path is None
+        assert not (tmp_path / "data" / "etc-hosts").exists()
