@@ -1,0 +1,441 @@
+"""Sans-I/O TCP state machine tests.
+
+Mirrors the reference TCP crate's in-crate suite (src/lib/tcp/src/tests/
+{transitions,send_recv,window_scale}.rs) driven by a simulated-time fake
+harness: two endpoints joined by a deterministic wire with explicit latency
+and scripted loss, the integer clock advanced event-by-event.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from shadow_tpu.transport.tcp import (
+    PollState,
+    State,
+    TcpConfig,
+    TcpError,
+    TcpFlags,
+    TcpListener,
+    TcpState,
+    seq_add,
+    seq_lt,
+    seq_sub,
+)
+
+MS = 1_000_000
+LATENCY = 5 * MS
+
+A_ADDR = (0x0B000001, 1000)
+B_ADDR = (0x0B000002, 2000)
+
+
+class Wire:
+    """Deterministic duplex wire + clock for two endpoints ("a", "b").
+    ``loss`` is a set of global segment indices to drop (order of first
+    transmission over the wire, both directions)."""
+
+    def __init__(self, a: TcpState, b: TcpState, loss: set[int] | None = None):
+        self.now = 0
+        self.ends = {"a": a, "b": b}
+        self.flight: list[tuple[int, str, object, bytes]] = []
+        self.loss = loss or set()
+        self.sent = 0
+        self.segments: list[tuple[str, object, bytes]] = []  # transmit log
+
+    def _pump_sends(self) -> None:
+        for name, ep in self.ends.items():
+            while ep.wants_to_send():
+                out = ep.pop_packet(self.now)
+                if out is None:
+                    break
+                hdr, payload = out
+                idx = self.sent
+                self.sent += 1
+                self.segments.append((name, hdr, payload))
+                if idx in self.loss:
+                    continue
+                dst = "b" if name == "a" else "a"
+                self.flight.append((self.now + LATENCY, dst, hdr, payload))
+
+    def step(self) -> bool:
+        """Deliver/fire the earliest pending event; False when idle."""
+        self._pump_sends()
+        candidates: list[tuple[int, int, str]] = []
+        if self.flight:
+            t = min(f[0] for f in self.flight)
+            candidates.append((t, 0, ""))
+        for name, ep in self.ends.items():
+            d = ep.next_timeout()
+            if d is not None:
+                candidates.append((d, 1, name))
+        if not candidates:
+            return False
+        t, kind, who = min(candidates)
+        self.now = max(self.now, t)
+        if kind == 0:
+            due = sorted(
+                [f for f in self.flight if f[0] <= self.now], key=lambda f: f[0]
+            )
+            self.flight = [f for f in self.flight if f[0] > self.now]
+            for _, dst, hdr, payload in due:
+                self.ends[dst].push_packet(self.now, hdr, payload)
+        else:
+            self.ends[who].on_timer(self.now)
+        self._pump_sends()
+        return True
+
+    def run(self, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            if not self.step():
+                return
+        raise AssertionError("wire did not go idle")
+
+
+def handshake(loss: set[int] | None = None, cfg_a=None, cfg_b=None):
+    """Client a connects to listener on b; returns (a, b_child, wire)."""
+    a = TcpState(cfg_a)
+    listener = TcpListener(B_ADDR, config=cfg_b)
+    b_holder: dict = {}
+
+    class ListenerAdapter:
+        """Routes b-side segments: SYNs to the listener, the rest to the
+        accepted child (the socket-layer demux in miniature)."""
+
+        def push_packet(self, now, hdr, payload=b""):
+            child = b_holder.get("child")
+            if child is not None:
+                child.push_packet(now, hdr, payload)
+                return
+            if hdr.flags & TcpFlags.SYN and not hdr.flags & TcpFlags.ACK:
+                child = listener.push_syn(now, hdr, iss=7000)
+                if child is not None:
+                    b_holder["child"] = child
+
+        def wants_to_send(self):
+            c = b_holder.get("child")
+            return c.wants_to_send() if c else False
+
+        def pop_packet(self, now):
+            c = b_holder.get("child")
+            return c.pop_packet(now) if c else None
+
+        def next_timeout(self):
+            c = b_holder.get("child")
+            return c.next_timeout() if c else None
+
+        def on_timer(self, now):
+            c = b_holder.get("child")
+            if c:
+                c.on_timer(now)
+
+    wire = Wire(a, ListenerAdapter(), loss=loss)
+    a.connect(A_ADDR, B_ADDR, iss=3000, now=0)
+    wire.run()
+    child = b_holder["child"]
+    return a, child, wire
+
+
+def transfer(a: TcpState, b: TcpState, wire: Wire, data: bytes, src="a"):
+    """Send ``data`` from src endpoint, pumping until fully received."""
+    sender = a if src == "a" else b
+    receiver = b if src == "a" else a
+    got = bytearray()
+    sent = 0
+    for _ in range(100000):
+        if sent < len(data):
+            sent += sender.send(data[sent : sent + 65536])
+        wire.run()
+        got.extend(receiver.recv(1 << 20))
+        if len(got) >= len(data) and sent == len(data):
+            break
+    return bytes(got)
+
+
+class TestHandshake:
+    def test_three_way(self):
+        a, b, wire = handshake()
+        assert a.state == State.ESTABLISHED
+        assert b.state == State.ESTABLISHED
+        assert a.snd_una == seq_add(3000, 1)
+        assert a.rcv_nxt == seq_add(7000, 1)
+        assert b.rcv_nxt == seq_add(3000, 1)
+
+    def test_syn_loss_retries(self):
+        a, b, wire = handshake(loss={0})  # first SYN dropped
+        assert a.state == State.ESTABLISHED
+        assert b.state == State.ESTABLISHED
+        assert wire.now >= TcpConfig().rto_initial  # took an RTO
+
+    def test_synack_loss_retries(self):
+        a, b, wire = handshake(loss={1})
+        assert a.state == State.ESTABLISHED
+        assert b.state == State.ESTABLISHED
+
+    def test_refused_by_rst(self):
+        a = TcpState()
+        a.connect(A_ADDR, B_ADDR, iss=100, now=0)
+        hdr, _ = a.pop_packet(0)
+        from shadow_tpu.transport.tcp import TcpHeader
+
+        rst = TcpHeader(
+            src_ip=B_ADDR[0], src_port=B_ADDR[1],
+            dst_ip=A_ADDR[0], dst_port=A_ADDR[1],
+            seq=0, ack=seq_add(100, 1),
+            flags=TcpFlags.RST | TcpFlags.ACK, window=0,
+        )
+        a.push_packet(LATENCY, rst)
+        assert a.state == State.RST
+        assert a.error == TcpError.REFUSED
+        assert a.poll() & PollState.ERROR
+
+    def test_listener_backlog_drops_syn(self):
+        listener = TcpListener(B_ADDR, backlog=1)
+        from shadow_tpu.transport.tcp import TcpHeader
+
+        syn = lambda port: TcpHeader(
+            src_ip=A_ADDR[0], src_port=port,
+            dst_ip=B_ADDR[0], dst_port=B_ADDR[1],
+            seq=50, ack=0, flags=TcpFlags.SYN, window=1000,
+        )
+        assert listener.push_syn(0, syn(1), iss=1) is not None
+        assert listener.push_syn(0, syn(2), iss=2) is None  # over backlog
+
+    def test_closed_listener_ignores_syn(self):
+        listener = TcpListener(B_ADDR)
+        listener.close()
+        from shadow_tpu.transport.tcp import TcpHeader
+
+        syn = TcpHeader(
+            src_ip=A_ADDR[0], src_port=1,
+            dst_ip=B_ADDR[0], dst_port=B_ADDR[1],
+            seq=50, ack=0, flags=TcpFlags.SYN, window=1000,
+        )
+        assert listener.push_syn(0, syn, iss=1) is None
+
+
+class TestTransitions:
+    """transitions.rs: the close choreography."""
+
+    def test_active_close(self):
+        a, b, wire = handshake()
+        a.close(wire.now)
+        wire.run()
+        # b hasn't closed: a in FIN_WAIT_2, b in CLOSE_WAIT
+        assert a.state == State.FIN_WAIT_2
+        assert b.state == State.CLOSE_WAIT
+        b.close(wire.now)
+        seen_time_wait = False
+        for _ in range(1000):
+            alive = wire.step()
+            seen_time_wait = seen_time_wait or a.state == State.TIME_WAIT
+            if not alive:
+                break
+        assert seen_time_wait  # passed through 2MSL
+        assert b.state == State.CLOSED
+        assert a.state == State.CLOSED
+        assert wire.now >= TcpConfig().time_wait
+
+    def test_simultaneous_close(self):
+        a, b, wire = handshake()
+        a.close(wire.now)
+        b.close(wire.now)
+        wire.run()
+        assert a.state == State.CLOSED
+        assert b.state == State.CLOSED
+        assert wire.now >= TcpConfig().time_wait
+
+    def test_recv_eof_after_fin(self):
+        a, b, wire = handshake()
+        a.send(b"bye")
+        a.close(wire.now)
+        wire.run()
+        assert b.recv(100) == b"bye"
+        assert b.at_eof()
+        assert b.poll() & PollState.RECV_CLOSED
+
+    def test_close_before_connect_is_noop(self):
+        t = TcpState()
+        t.close(0)
+        assert t.state == State.CLOSED
+
+    def test_fin_loss_retransmits(self):
+        a, b, wire = handshake()
+        n_before = wire.sent
+        a.close(wire.now)
+        wire.loss.add(n_before)  # drop the first FIN
+        wire.run()
+        b.close(wire.now)
+        wire.run()
+        assert a.state in (State.TIME_WAIT, State.CLOSED)
+        assert b.state == State.CLOSED
+
+
+class TestSendRecv:
+    """send_recv.rs: integrity, segmentation, loss recovery."""
+
+    def test_small_transfer(self):
+        a, b, wire = handshake()
+        got = transfer(a, b, wire, b"hello world")
+        assert got == b"hello world"
+
+    def test_bulk_transfer_both_ways(self):
+        a, b, wire = handshake()
+        blob = bytes(i & 0xFF for i in range(200_000))
+        assert transfer(a, b, wire, blob) == blob
+        blob2 = bytes((i * 7) & 0xFF for i in range(100_000))
+        assert transfer(a, b, wire, blob2, src="b") == blob2
+
+    def test_segmentation_respects_mss(self):
+        cfg = TcpConfig(mss=500)
+        a, b, wire = handshake(cfg_a=cfg, cfg_b=TcpConfig(mss=500))
+        transfer(a, b, wire, bytes(5000))
+        data_segs = [p for (_, h, p) in wire.segments if p]
+        assert data_segs and all(len(p) <= 500 for p in data_segs)
+
+    def test_loss_recovery_fast_retransmit(self):
+        a, b, wire = handshake()
+        blob = bytes(i & 0xFF for i in range(150_000))
+        # drop a mid-stream data segment: dup-acks trigger fast retransmit
+        wire.loss.add(wire.sent + 5)
+        got = transfer(a, b, wire, blob)
+        assert got == blob
+
+    def test_loss_recovery_rto(self):
+        a, b, wire = handshake()
+        # drop an isolated small send entirely (no dup-acks possible)
+        wire.loss.add(wire.sent)
+        got = transfer(a, b, wire, b"x" * 100)
+        assert got == b"x" * 100
+        assert wire.now >= TcpConfig().rto_min
+
+    def test_heavy_periodic_loss(self):
+        a, b, wire = handshake()
+        blob = bytes((i * 13) & 0xFF for i in range(120_000))
+        start = wire.sent
+        wire.loss.update(range(start + 7, start + 3000, 13))
+        got = transfer(a, b, wire, blob)
+        assert got == blob
+
+    def test_send_after_shutdown_raises(self):
+        a, b, wire = handshake()
+        a.close(wire.now)
+        with pytest.raises(BrokenPipeError):
+            a.send(b"late")
+
+    def test_reno_fast_retransmit_halves_cwnd(self):
+        a, b, wire = handshake()
+        blob = bytes(300_000)
+        a.send(blob[:131072])
+        wire.loss.add(wire.sent + 3)
+        pre = a.cwnd
+        wire.run()
+        b.recv(1 << 20)
+        assert a.ssthresh < 1 << 30  # loss event recorded
+        assert a.cwnd <= max(pre, a.ssthresh + 3 * a.cfg.mss)
+
+    def test_rtt_estimation(self):
+        a, b, wire = handshake()
+        transfer(a, b, wire, bytes(20_000))
+        # srtt should be near 2*LATENCY (ack round trip)
+        assert a.srtt > 0
+        assert abs(a.srtt - 2 * LATENCY) < LATENCY
+
+
+class TestFlowControl:
+    """window_scale.rs + zero-window behavior."""
+
+    def test_window_scaling_negotiated(self):
+        big = TcpConfig(recv_buffer=1 << 20)
+        a, b, wire = handshake(cfg_a=big, cfg_b=TcpConfig(recv_buffer=1 << 20))
+        assert a.rcv_wscale > 0
+        assert b.snd_wscale == a.rcv_wscale
+        assert a.snd_wscale == b.rcv_wscale
+
+    def test_no_scaling_when_disabled(self):
+        off = TcpConfig(window_scaling=False, recv_buffer=1 << 20)
+        a, b, wire = handshake(cfg_a=off, cfg_b=TcpConfig(window_scaling=False))
+        assert a.rcv_wscale == 0 and a.snd_wscale == 0
+        # advertised window is clamped to 16 bits
+        assert b.snd_wnd <= 0xFFFF
+
+    def test_peer_without_scaling_disables_ours(self):
+        a, b, wire = handshake(
+            cfg_a=TcpConfig(window_scaling=True),
+            cfg_b=TcpConfig(window_scaling=False),
+        )
+        assert a.snd_wscale == 0
+        assert b.snd_wscale == 0
+
+    def test_receiver_stall_blocks_sender(self):
+        cfg = TcpConfig(recv_buffer=10_000, send_buffer=1 << 20)
+        a, b, wire = handshake(cfg_a=TcpConfig(send_buffer=1 << 20), cfg_b=cfg)
+        a.send(bytes(60_000))
+        wire.run()
+        # receiver never reads: at most recv_buffer bytes cross the wire
+        assert len(b._rcv_buf) <= 10_000
+        assert seq_sub(a.snd_nxt, a.iss) <= 10_000 + 2
+        # reading re-opens the window and the rest flows
+        got = bytearray(b.recv(1 << 20))
+        for _ in range(200):
+            wire.run()
+            got.extend(b.recv(1 << 20))
+            if len(got) >= 60_000:
+                break
+        assert len(got) == 60_000
+
+    def test_big_buffer_fills_pipe_beyond_64k(self):
+        big = TcpConfig(recv_buffer=1 << 20, send_buffer=1 << 20)
+        a, b, wire = handshake(cfg_a=big, cfg_b=big)
+        blob = bytes(i & 0xFF for i in range(400_000))
+        got = transfer(a, b, wire, blob)
+        assert got == blob
+        # with scaling, flight exceeded the 16-bit window at some point
+        assert max(
+            seq_sub(h.seq, a.iss) for (s, h, p) in wire.segments if s == "a"
+        ) > 0xFFFF
+
+
+class TestSeqArithmetic:
+    def test_wrapping_compare(self):
+        assert seq_lt(0xFFFFFFF0, 0x10)
+        assert not seq_lt(0x10, 0xFFFFFFF0)
+        assert seq_sub(0x10, 0xFFFFFFF0) == 0x20
+
+    def test_wrap_transfer(self):
+        # connection whose sequence space wraps mid-transfer
+        a, b, wire = handshake()
+        a.snd_una = a.snd_nxt = (a.snd_nxt + 0xFFFFFF00) & 0xFFFFFFFF
+        # (simulate by instead picking a high ISS on a fresh pair)
+        a2 = TcpState()
+        listener = TcpListener(B_ADDR)
+        holder = {}
+
+        class Adapter:
+            def push_packet(self, now, hdr, payload=b""):
+                c = holder.get("c")
+                if c is not None:
+                    c.push_packet(now, hdr, payload)
+                elif hdr.flags & TcpFlags.SYN:
+                    holder["c"] = listener.push_syn(now, hdr, iss=0xFFFFFE00)
+
+            def wants_to_send(self):
+                return holder.get("c") and holder["c"].wants_to_send()
+
+            def pop_packet(self, now):
+                return holder["c"].pop_packet(now)
+
+            def next_timeout(self):
+                c = holder.get("c")
+                return c.next_timeout() if c else None
+
+            def on_timer(self, now):
+                holder["c"].on_timer(now)
+
+        w = Wire(a2, Adapter())
+        a2.connect(A_ADDR, B_ADDR, iss=0xFFFFFF00, now=0)
+        w.run()
+        c = holder["c"]
+        blob = bytes(i & 0xFF for i in range(50_000))
+        got = transfer(a2, c, w, blob)
+        assert got == blob
