@@ -21,9 +21,11 @@ from ..core.event import Event, EventKind, Task
 from ..core.event_queue import EventQueue
 from ..models import phold as _phold  # noqa: F401  (register built-ins)
 from ..models import tgen as _tgen  # noqa: F401
+from ..models import tgen_tcp as _tgen_tcp  # noqa: F401
 from ..models.base import create_model
 from ..net.codel import CoDel
 from ..net.graph import IpAssignment, NetworkGraph, RoutingInfo
+from ..net.stack import TcpSegment as _TcpSegment
 from ..net.token_bucket import (
     FRAME_OVERHEAD_BYTES,
     TokenBucket,
@@ -92,6 +94,7 @@ class Host:
         self.apps: list = []
         self.counters: dict[str, int] = {}
         self.now = 0  # current event time while executing
+        self._net = None  # lazy HostNetStack (TCP tier)
 
     # -- HostApi ----------------------------------------------------------
 
@@ -125,6 +128,15 @@ class Host:
     @property
     def hosts_file_path(self):
         return self.engine.hosts_file_path
+
+    @property
+    def net(self):
+        """The host's transport stack (TCP sockets over the packet path)."""
+        if self._net is None:
+            from ..net.stack import HostNetStack
+
+            self._net = HostNetStack(self)
+        return self._net
 
     @property
     def data_directory(self) -> str:
@@ -177,12 +189,15 @@ class Host:
                 self.engine.inbound(self, ev)
             elif ev.kind == EventKind.DELIVERY:
                 data = ev.data
-                for app in self.apps:
-                    self._current_app = app
-                    app.on_delivery(
-                        self, ev.time, data.src, data.seq, data.size,
-                        payload=data.payload,
-                    )
+                if isinstance(data.payload, _TcpSegment):
+                    self.net.on_segment(ev.time, data.payload)
+                else:
+                    for app in self.apps:
+                        self._current_app = app
+                        app.on_delivery(
+                            self, ev.time, data.src, data.seq, data.size,
+                            payload=data.payload,
+                        )
             else:
                 ev.data.execute(self)
 

@@ -117,10 +117,13 @@ def parse_bandwidth(value: str | int) -> int:
 
 
 def parse_bytes(value: str | int) -> int:
-    """Parse a size quantity to bytes (``"16 MiB"``, ``"1500 B"``, bare int)."""
+    """Parse a size quantity to bytes (``"16 MiB"``, ``"1500 B"``, bare
+    numbers — int or digit string — are bytes)."""
     if isinstance(value, int):
         return value
     num, unit = _split(value)
+    if unit == "":
+        return int(num)
     if unit not in _BYTE_UNITS:
         raise UnitError(f"unknown size unit {unit!r} in {value!r}")
     scale = _BYTE_UNITS[unit]

@@ -1,0 +1,161 @@
+"""TCP over the simulated packet path: socket layer + tgen-tcp workloads.
+
+The integration tier above tests/test_tcp.py: full engine runs where TCP
+segments ride the same token buckets, loss draws, latency lookups, and
+CoDel as every other packet (reference call stack 3.3, worker.rs:330).
+"""
+
+import pytest
+
+from shadow_tpu.backend.cpu_engine import CpuEngine
+from shadow_tpu.config.options import ConfigOptions
+
+MIB = 1024 * 1024
+
+
+def run_cfg(yaml: str):
+    return CpuEngine(ConfigOptions.from_yaml(yaml)).run()
+
+
+BASIC = """
+general: {{stop_time: {stop}, seed: {seed}}}
+hosts:
+  client:
+    processes: [{{path: tgen-tcp-client, args: --server server --size {size}, start_time: 10ms}}]
+  server:
+    processes: [{{path: tgen-tcp-server}}]
+"""
+
+
+LOSSY = """
+general: {{stop_time: {stop}, seed: {seed}}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss {loss} ]
+      ]
+hosts:
+  client:
+    processes: [{{path: tgen-tcp-client, args: --server server --size {size}, start_time: 10ms}}]
+  server:
+    processes: [{{path: tgen-tcp-server}}]
+"""
+
+
+class TestBasicTransfer:
+    def test_fixed_size_transfer_completes(self):
+        res = run_cfg(BASIC.format(stop="5s", seed=1, size=MIB))
+        assert res.counters["tcp_tx_bytes"] == MIB
+        assert res.counters["tcp_rx_bytes"] == MIB
+        assert res.counters["tcp_complete"] == 1
+        assert res.counters["tcp_accepted"] == 1
+        assert res.counters["tcp_conns_closed"] == 1
+
+    def test_deterministic_replay(self):
+        r1 = run_cfg(BASIC.format(stop="5s", seed=3, size=256 * 1024))
+        r2 = run_cfg(BASIC.format(stop="5s", seed=3, size=256 * 1024))
+        assert r1.log_tuples() == r2.log_tuples()
+        assert r1.counters == r2.counters
+
+    def test_different_seed_different_schedule(self):
+        r1 = run_cfg(BASIC.format(stop="5s", seed=1, size=64 * 1024))
+        r2 = run_cfg(BASIC.format(stop="5s", seed=2, size=64 * 1024))
+        # ISS and port draws differ -> packet timing may match but the
+        # transfer still completes identically at the app level
+        assert r1.counters["tcp_rx_bytes"] == r2.counters["tcp_rx_bytes"]
+
+    def test_connection_refused(self):
+        yaml = """
+general: {stop_time: 2s}
+hosts:
+  client:
+    processes: [{path: tgen-tcp-client, args: --server server --size 1024, start_time: 10ms}]
+  server: {}
+"""
+        res = run_cfg(yaml)
+        assert res.counters.get("tcp_refused", 0) == 1
+        assert res.counters.get("tcp_rx_bytes", 0) == 0
+
+    def test_many_clients_one_server(self):
+        yaml = """
+general: {stop_time: 10s, seed: 5}
+hosts:
+  server:
+    processes: [{path: tgen-tcp-server}]
+  client:
+    count: 4
+    processes: [{path: tgen-tcp-client, args: --server server --size 131072, start_time: 50ms}]
+"""
+        res = run_cfg(yaml)
+        assert res.counters["tcp_accepted"] == 4
+        assert res.counters["tcp_rx_bytes"] == 4 * 131072
+        assert res.counters["tcp_complete"] == 4
+
+
+class TestLossRecovery:
+    def test_transfer_survives_heavy_loss(self):
+        res = run_cfg(LOSSY.format(stop="60s", seed=11, loss=0.05, size=128 * 1024))
+        assert res.counters["tcp_rx_bytes"] == 128 * 1024
+        assert res.counters["tcp_complete"] == 1
+        # the engine really dropped TCP segments on the wire
+        lost = sum(1 for r in res.event_log if r.outcome == 1)
+        assert lost > 0
+
+    def test_loss_free_graph_no_retransmits(self):
+        res = run_cfg(LOSSY.format(stop="30s", seed=11, loss=0.0, size=128 * 1024))
+        assert res.counters["tcp_rx_bytes"] == 128 * 1024
+        assert all(r.outcome == 0 for r in res.event_log)
+
+    def test_lossy_determinism(self):
+        a = run_cfg(LOSSY.format(stop="60s", seed=13, loss=0.03, size=64 * 1024))
+        b = run_cfg(LOSSY.format(stop="60s", seed=13, loss=0.03, size=64 * 1024))
+        assert a.log_tuples() == b.log_tuples()
+
+
+class TestBandwidthPacing:
+    YAML = """
+general: {{stop_time: {stop}, seed: 1}}
+hosts:
+  client:
+    bandwidth_up: {bw}
+    processes: [{{path: tgen-tcp-client, args: --server server --size {size}, start_time: 10ms}}]
+  server:
+    processes: [{{path: tgen-tcp-server}}]
+"""
+
+    def test_slow_uplink_paces_transfer(self):
+        # 4 MiB at 1 Mbit/s needs ~34 s: a 2 s run cannot finish...
+        res = run_cfg(self.YAML.format(stop="2s", bw="1 Mbit", size=4 * MIB))
+        assert res.counters.get("tcp_rx_bytes", 0) < 4 * MIB
+        # ...but roughly bw*t bytes should have crossed (within 2x slack)
+        assert res.counters.get("tcp_rx_bytes", 0) > 1_000_000 // 8 // 2
+
+    def test_fast_uplink_finishes(self):
+        res = run_cfg(self.YAML.format(stop="2s", bw="1 Gbit", size=4 * MIB))
+        assert res.counters["tcp_rx_bytes"] == 4 * MIB
+
+
+class TestStackApi:
+    def test_listen_port_conflict(self):
+        cfg = ConfigOptions.from_yaml(
+            "general: {stop_time: 1s}\nhosts: {a: {}, b: {}}\n"
+        )
+        engine = CpuEngine(cfg)
+        host = engine.hosts[0]
+        host.net.listen(80)
+        with pytest.raises(OSError, match="EADDRINUSE"):
+            host.net.listen(80)
+
+    def test_ephemeral_ports_unique(self):
+        cfg = ConfigOptions.from_yaml(
+            "general: {stop_time: 1s}\nhosts: {a: {}, b: {}}\n"
+        )
+        engine = CpuEngine(cfg)
+        host = engine.hosts[0]
+        s1 = host.net.connect(1, 80)
+        s2 = host.net.connect(1, 80)
+        assert s1.key[1] != s2.key[1]
