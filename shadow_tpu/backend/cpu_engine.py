@@ -20,6 +20,7 @@ from ..core import time as stime
 from ..core.event import Event, EventKind, Task
 from ..core.event_queue import EventQueue
 from ..models import phold as _phold  # noqa: F401  (register built-ins)
+from ..models import tcpflow as _tcpflow  # noqa: F401
 from ..models import tgen as _tgen  # noqa: F401
 from ..models import tgen_tcp as _tgen_tcp  # noqa: F401
 from ..models.base import create_model
@@ -118,6 +119,13 @@ class Host:
 
     def set_timer_relative(self, delta_ns: int) -> None:
         self.set_timer(self.now + delta_ns)
+
+    def schedule_at(self, t_abs_ns: int, fn) -> None:
+        """Exact-time local event (``fn(host)``), the scalar twin of the
+        lane backend's arm channels: unlike ``set_timer`` it may land at
+        the current instant (pump events pop later in the same window, in
+        (time, kind, src, seq) order)."""
+        self.push_local(max(t_abs_ns, self.now), Task(fn, label="app"))
 
     def resolve(self, hostname: str) -> int:
         return self.engine.resolve(hostname)

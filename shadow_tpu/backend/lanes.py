@@ -107,8 +107,10 @@ class LaneState(NamedTuple):
     # token buckets [N]
     up_tokens: jnp.ndarray  # int64
     up_next_refill: jnp.ndarray  # int64
+    up_last_depart: jnp.ndarray  # int64
     dn_tokens: jnp.ndarray
     dn_next_refill: jnp.ndarray
+    dn_last_depart: jnp.ndarray
     # CoDel [N]
     cd_first_above: jnp.ndarray  # int64
     cd_drop_next: jnp.ndarray  # int64
@@ -180,11 +182,15 @@ class LaneTables(NamedTuple):
 # --------------------------------------------------------------------------
 
 
-def bucket_charge_vec(tokens, next_refill, rate, burst, t, bits, active, interval):
+def bucket_charge_vec(
+    tokens, next_refill, last_depart, rate, burst, t, bits, active, interval
+):
     """Masked vector form of TokenBucket.charge; returns (tokens',
-    next_refill', depart)."""
+    next_refill', last_depart', depart).  FIFO law: the charge clock is
+    ``max(t, last_depart)`` so departures are monotone per lane."""
     unlimited = rate == 0
     act = active & ~unlimited
+    t = jnp.maximum(t, last_depart)
 
     do_refill = act & (t >= next_refill)
     k = jnp.where(do_refill, (t - next_refill) // interval + 1, 0)
@@ -204,7 +210,8 @@ def bucket_charge_vec(tokens, next_refill, rate, burst, t, bits, active, interva
     )
     tokens = jnp.where(act, new_tokens, tokens)
     next_refill = jnp.where(act & ~have, next_refill + w * interval, next_refill)
-    return tokens, next_refill, depart
+    last_depart = jnp.where(act, depart, last_depart)
+    return tokens, next_refill, last_depart, depart
 
 
 def codel_offer_vec(state: LaneState, t_deliver, sojourn, active, codel_div):
@@ -328,11 +335,11 @@ def _process_slot(
     # ---- PACKET pops: down bucket + CoDel -> DELIVERY self-insert --------
     is_pkt = active & (kind == PACKET)
     bits = (size.astype(i64) + FRAME_OVERHEAD_BYTES) * 8
-    dn_tokens, dn_next, t_del = bucket_charge_vec(
-        s.dn_tokens, s.dn_next_refill, tb.dn_rate, tb.dn_burst, t, bits, is_pkt,
-        p.bucket_interval,
+    dn_tokens, dn_next, dn_last, t_del = bucket_charge_vec(
+        s.dn_tokens, s.dn_next_refill, s.dn_last_depart, tb.dn_rate, tb.dn_burst,
+        t, bits, is_pkt, p.bucket_interval,
     )
-    s = s._replace(dn_tokens=dn_tokens, dn_next_refill=dn_next)
+    s = s._replace(dn_tokens=dn_tokens, dn_next_refill=dn_next, dn_last_depart=dn_last)
     sojourn = t_del - t
     s, codel_drop = codel_offer_vec(s, t_del, sojourn, is_pkt, tb.codel_div)
     deliver = is_pkt & ~codel_drop
@@ -417,11 +424,11 @@ def _process_slot(
 
     # up bucket
     out_bits = (out_size.astype(i64) + FRAME_OVERHEAD_BYTES) * 8
-    up_tokens, up_next, t_dep = bucket_charge_vec(
-        s.up_tokens, s.up_next_refill, tb.up_rate, tb.up_burst, t, out_bits,
-        do_send, p.bucket_interval,
+    up_tokens, up_next, up_last, t_dep = bucket_charge_vec(
+        s.up_tokens, s.up_next_refill, s.up_last_depart, tb.up_rate, tb.up_burst,
+        t, out_bits, do_send, p.bucket_interval,
     )
-    s = s._replace(up_tokens=up_tokens, up_next_refill=up_next)
+    s = s._replace(up_tokens=up_tokens, up_next_refill=up_next, up_last_depart=up_last)
 
     # loss (bootstrap window is loss-free)
     u = rand_u32_lane(

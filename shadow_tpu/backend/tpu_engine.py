@@ -214,8 +214,10 @@ class TpuEngine:
             app_draws=jnp.asarray(z64),
             up_tokens=jnp.asarray(up_burst),
             up_next_refill=jnp.full(n, self._interval, dtype=jnp.int64),
+            up_last_depart=jnp.asarray(z64),
             dn_tokens=jnp.asarray(dn_burst),
             dn_next_refill=jnp.full(n, self._interval, dtype=jnp.int64),
+            dn_last_depart=jnp.asarray(z64),
             cd_first_above=jnp.asarray(z64),
             cd_drop_next=jnp.asarray(z64),
             cd_drop_count=jnp.zeros(n, dtype=jnp.int32),

@@ -123,7 +123,7 @@ def parse_bytes(value: str | int) -> int:
         return value
     num, unit = _split(value)
     if unit == "":
-        return int(num)
+        return round(num)
     if unit not in _BYTE_UNITS:
         raise UnitError(f"unknown size unit {unit!r} in {value!r}")
     scale = _BYTE_UNITS[unit]

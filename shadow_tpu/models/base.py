@@ -38,6 +38,10 @@ class HostApi(Protocol):
     def set_timer_relative(self, delta_ns: int) -> None:
         """Arm a timer ``delta_ns`` after the current time."""
 
+    def schedule_at(self, t_abs_ns: int, fn) -> None:
+        """Queue an exact-time local event calling ``fn(host)`` (may land
+        at the current instant; pops in event-key order)."""
+
     def resolve(self, hostname: str) -> int:
         """DNS: hostname -> host id (also accepts a numeric id string)."""
 

@@ -35,6 +35,7 @@ class TgenTcpClient:
         self._remaining = size
         self._sock = None
         self._done = False
+        self._established = False
 
     @classmethod
     def from_args(cls, args: list[str]) -> "TgenTcpClient":
@@ -62,8 +63,12 @@ class TgenTcpClient:
         if ps & PollState.ERROR:
             if not self._done:
                 self._done = True
-                api.count("tcp_refused")
+                # refused = error before the handshake ever completed;
+                # aborted = an established connection died mid-transfer
+                api.count("tcp_refused" if not self._established else "tcp_aborted")
+                sock.close()
             return
+        self._established = True
         while self._remaining > 0 and ps & PollState.WRITABLE:
             n = sock.send(bytes(min(self._remaining, CHUNK)))
             if n == 0:

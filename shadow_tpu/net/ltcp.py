@@ -1,0 +1,430 @@
+"""Lane TCP ("ltcp"): the fixed-size, segment-counting TCP law.
+
+The transport tier that runs **inside the TPU lane program** (SURVEY §7
+step 6: "fixed-size per-connection state records so TCP state can later
+live in HBM lanes").  This module is the *scalar* form of the law — the
+CPU-backend oracle that the vectorized twin in ``backend/lanes.py`` is
+diffed against, exactly like ``net/codel.py`` / ``net/token_bucket.py``.
+
+Relation to the reference: the full sans-I/O byte-stream TCP
+(``transport/tcp.py``, rebuilding src/lib/tcp + tcp_cong_reno.c) serves
+managed processes and byte-accurate workloads on the CPU backend; *this*
+tier trades byte granularity for a fixed-size integer state record per
+flow so that thousands of connections advance as masked vector arithmetic
+on device.  It is still a real TCP: 3-way handshake, cumulative ACKs,
+flow control by a fixed receive window, slow start, congestion avoidance,
+fast retransmit / NewReno fast recovery (tcp_cong_reno.c's laws in
+segment units), RFC 6298 RTO with exponential backoff and Karn's rule,
+and FIN teardown.  Simplifications (documented in docs/SEMANTICS.md):
+sequence numbers count MSS-sized *segments*, the receiver accepts only
+in-order segments (go-back-N; no SACK/reassembly buffer), every data
+segment is ACKed immediately (no delayed ACK), and the receive window is
+a constant.
+
+All arithmetic is integer; every decision is a pure function of the flow
+record — the vector form applies the same updates under masks.
+
+Sequence-unit space of a flow transferring ``segs`` data segments:
+
+    0            SYN            (client) / SYN-ACK (server)
+    1..segs      data           (client only; server's unit 1 is its FIN)
+    segs+1       FIN            (client)
+
+Wire segments carry ``(flags, seq, ack)``; ACKs are cumulative in the
+peer's unit space.  Control segments cost HDR_BYTES on the wire; data
+segment ``i`` costs ``HDR_BYTES + mss`` (the final one
+``HDR_BYTES + last_bytes``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..core.time import NEVER
+
+# -- wire flags -------------------------------------------------------------
+F_SYN = 1
+F_ACK = 2
+F_FIN = 4
+F_DATA = 8
+
+# -- states (one enum for both roles) ---------------------------------------
+CLOSED = 0  # client: not opened yet; server: LISTEN
+SYN_SENT = 1  # client sent SYN
+SYN_RCVD = 2  # server sent SYN-ACK
+ESTAB = 3
+FIN_WAIT = 4  # client sent FIN, waits for its ACK + server FIN
+LAST_ACK = 5  # server sent FIN, waits for final ACK
+DONE = 6
+
+# -- roles ------------------------------------------------------------------
+SENDER = 0  # active opener, streams data
+RECEIVER = 1  # passive opener, sinks data
+
+# -- congestion control constants (integer, fixed-point cwnd) ---------------
+FP = 1024  # cwnd fixed-point: FP units = 1 segment
+INIT_CWND_FP = 10 * FP  # RFC 6928 initial window, segment units
+INIT_SSTHRESH_FP = 1 << 30
+MIN_SSTHRESH_FP = 2 * FP
+DUP_THRESH = 3
+RWND_SEGS = 256  # constant advertised receive window
+MAX_CWND_FP = 2 * RWND_SEGS * FP  # growth past the window is pointless
+
+# -- RTO constants (RFC 6298, ns) ------------------------------------------
+RTO_INIT = 1_000_000_000  # 1 s
+RTO_MIN = 200_000_000  # 200 ms (Linux's floor)
+RTO_MAX = 60_000_000_000  # 60 s
+
+HDR_BYTES = 40  # IP (20) + TCP (20) wire overhead per segment
+
+
+@dataclasses.dataclass
+class FlowState:
+    """One TCP flow's fixed-size record (every field an integer — the
+    vector form stores each as an [N, F] array column)."""
+
+    role: int = SENDER
+    state: int = CLOSED
+    # transfer shape (static per flow)
+    segs: int = 0  # number of data segments (sender side)
+    mss: int = 1448
+    last_bytes: int = 1448  # payload of the final data segment
+    # sequence state (segment units)
+    snd_una: int = 0
+    snd_nxt: int = 0
+    rcv_nxt: int = 0
+    # congestion control
+    cwnd_fp: int = INIT_CWND_FP
+    ssthresh_fp: int = INIT_SSTHRESH_FP
+    dup_acks: int = 0
+    in_rec: bool = False  # fast recovery (until ack >= recover)
+    recover: int = 0  # snd_nxt at loss detection
+    max_sent: int = 0  # highest unit ever transmitted + 1 (retransmit marker)
+    # RTT estimation (RFC 6298; srtt < 0 = no sample yet)
+    srtt: int = -1
+    rttvar: int = 0
+    rto: int = RTO_INIT
+    rtt_seq: int = -1  # unit being timed (-1 = none; Karn's rule)
+    rtt_ts: int = 0
+    # retransmission timer
+    rto_deadline: int = NEVER  # when the pending data times out
+    rto_evt: int = NEVER  # time of the queued RTO event (dedup law)
+    # stats
+    tx_segs: int = 0
+    rx_segs: int = 0
+    rx_bytes: int = 0
+    retransmits: int = 0
+
+
+@dataclasses.dataclass
+class Emit:
+    """What one stimulus produces (the scalar form of the lane channels):
+    at most ONE outbound segment, plus pump/RTO local-event arms."""
+
+    send: Optional[tuple[int, int, int, int]] = None  # (flags, seq, ack, size)
+    arm_pump: bool = False  # queue a pump event at the current time
+    arm_rto: Optional[int] = None  # queue an RTO event at this time
+    completed: bool = False  # flow reached DONE on this stimulus
+
+
+# ---------------------------------------------------------------------------
+# law helpers (each maps to a masked vector expression in lanes.py)
+# ---------------------------------------------------------------------------
+
+
+def seg_wire_size(fs: FlowState, unit: int) -> int:
+    """Wire size of the segment carrying sequence unit ``unit``."""
+    if 1 <= unit <= fs.segs:
+        payload = fs.last_bytes if unit == fs.segs else fs.mss
+        return HDR_BYTES + payload
+    return HDR_BYTES  # SYN / FIN / pure control
+
+
+def seg_flags(fs: FlowState, unit: int) -> int:
+    """Flags of the segment carrying unit ``unit`` (role-dependent)."""
+    if unit == 0:
+        return F_SYN if fs.role == SENDER else (F_SYN | F_ACK)
+    if fs.role == SENDER and 1 <= unit <= fs.segs:
+        return F_DATA | F_ACK
+    return F_FIN | F_ACK  # sender unit segs+1, receiver unit 1
+
+
+def cwnd_segs(fs: FlowState) -> int:
+    return fs.cwnd_fp // FP
+
+
+def flight(fs: FlowState) -> int:
+    return fs.snd_nxt - fs.snd_una
+
+
+def can_send_new(fs: FlowState) -> bool:
+    """May this flow transmit its next new sequence unit right now?"""
+    if fs.role != SENDER or fs.state != ESTAB:
+        return False
+    if fs.snd_nxt > fs.segs + 1:  # everything (incl. FIN) already sent
+        return False
+    return flight(fs) < min(cwnd_segs(fs), RWND_SEGS)
+
+
+def _rtt_sample(fs: FlowState, now: int) -> None:
+    """RFC 6298 integer update from the timed unit's ACK."""
+    r = now - fs.rtt_ts
+    if r < 0:
+        r = 0
+    if fs.srtt < 0:
+        fs.srtt = r
+        fs.rttvar = r // 2
+    else:
+        delta = fs.srtt - r
+        if delta < 0:
+            delta = -delta
+        fs.rttvar = (3 * fs.rttvar + delta) // 4
+        fs.srtt = (7 * fs.srtt + r) // 8
+    rto = fs.srtt + max(4 * fs.rttvar, 1_000_000)  # 1 ms granularity floor
+    fs.rto = min(max(rto, RTO_MIN), RTO_MAX)
+
+
+def _restart_rto(fs: FlowState, now: int, em: Emit) -> None:
+    """(Re)start the retransmission timer for outstanding data.
+
+    Event dedup law: ``rto_evt`` is the time of the single *owning* queued
+    RTO event.  A new event is queued only when there is none, or when the
+    live deadline moved **earlier** than the owner (an RTT sample shrank
+    the RTO) — the superseded event becomes stale and is ignored by the
+    ownership check in :func:`on_rto_event`.  An owner that pops before
+    the live deadline re-arms itself at the then-current deadline."""
+    fs.rto_deadline = now + fs.rto
+    if fs.rto_evt == NEVER or fs.rto_deadline < fs.rto_evt:
+        fs.rto_evt = fs.rto_deadline
+        em.arm_rto = fs.rto_deadline
+
+
+def _emit_unit(fs: FlowState, unit: int, em: Emit, retransmit: bool) -> None:
+    em.send = (seg_flags(fs, unit), unit, fs.rcv_nxt, seg_wire_size(fs, unit))
+    fs.tx_segs += 1
+    if retransmit:
+        fs.retransmits += 1
+        if fs.rtt_seq >= 0 and unit <= fs.rtt_seq:
+            fs.rtt_seq = -1  # Karn: never time a retransmitted unit
+    elif fs.rtt_seq < 0:
+        fs.rtt_seq = unit
+    if unit + 1 > fs.max_sent:
+        fs.max_sent = unit + 1
+
+
+def _pull_back(fs: FlowState, now: int, em: Emit) -> None:
+    """Go-back-N loss response: rewind ``snd_nxt`` to the hole, retransmit
+    it, and let the pump re-stream everything after it (the receiver
+    discarded all out-of-order units anyway)."""
+    fs.snd_nxt = fs.snd_una + 1
+    if fs.role == SENDER and fs.state == FIN_WAIT:
+        fs.state = ESTAB  # the FIN will be re-sent when the stream re-walks
+    _emit_unit(fs, fs.snd_una, em, retransmit=True)
+    _restart_rto(fs, now, em)
+    if can_send_new(fs):
+        em.arm_pump = True
+
+
+# ---------------------------------------------------------------------------
+# stimulus handlers
+# ---------------------------------------------------------------------------
+
+
+def open_flow(fs: FlowState, now: int) -> Emit:
+    """Active open (client start): send SYN, arm the timer."""
+    em = Emit()
+    fs.state = SYN_SENT
+    fs.snd_nxt = 1
+    _emit_unit(fs, 0, em, retransmit=False)
+    fs.rtt_ts = now
+    _restart_rto(fs, now, em)
+    return em
+
+
+def on_pump(fs: FlowState, now: int) -> Emit:
+    """A transmission-opportunity event: send at most one unit (new data,
+    or a go-back-N re-stream unit below ``max_sent``) and re-arm if the
+    window still has room after it."""
+    em = Emit()
+    if not can_send_new(fs):
+        return em
+    unit = fs.snd_nxt
+    fs.snd_nxt += 1
+    retransmit = unit < fs.max_sent
+    if not retransmit and fs.rtt_seq < 0:
+        fs.rtt_ts = now
+    _emit_unit(fs, unit, em, retransmit=retransmit)
+    if unit == fs.segs + 1:
+        fs.state = FIN_WAIT
+    _restart_rto(fs, now, em)
+    if can_send_new(fs):
+        em.arm_pump = True
+    return em
+
+
+def on_rto_event(fs: FlowState, now: int) -> Emit:
+    """A queued RTO event fired.  Ownership law: only the event at time
+    ``rto_evt`` speaks for the timer (others were superseded by an earlier
+    re-arm).  Staleness law: if the live deadline moved later, re-arm
+    there; if no data is outstanding, lapse.  Processing always moves
+    ``rto_evt`` off ``now``, so a coincidentally-reused time cannot
+    double-fire."""
+    em = Emit()
+    if now != fs.rto_evt:
+        return em  # stale (superseded) event
+    fs.rto_evt = NEVER
+    if fs.rto_deadline == NEVER or flight(fs) == 0:
+        return em
+    if now < fs.rto_deadline:
+        fs.rto_evt = fs.rto_deadline
+        em.arm_rto = fs.rto_deadline
+        return em
+    # timeout: collapse the window, back off, go-back-N from the hole
+    fl_fp = flight(fs) * FP
+    fs.ssthresh_fp = max(fl_fp // 2, MIN_SSTHRESH_FP)
+    fs.cwnd_fp = FP
+    fs.dup_acks = 0
+    fs.in_rec = False
+    fs.rto = min(fs.rto * 2, RTO_MAX)
+    _pull_back(fs, now, em)
+    return em
+
+
+def on_segment(
+    fs: FlowState, now: int, flags: int, seq: int, ack: int, size: int = HDR_BYTES
+) -> Emit:
+    """An inbound wire segment for this flow.  ``size`` is the wire size
+    (engine delivery size); data payload is ``size - HDR_BYTES`` so neither
+    side needs the peer's transfer-shape tables."""
+    em = Emit()
+    if fs.state == DONE:
+        # dup FIN from a peer that missed our final ACK: re-ACK it
+        if fs.role == SENDER and flags & F_FIN:
+            em.send = (F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES)
+        return em
+
+    # -- passive open -------------------------------------------------------
+    if fs.role == RECEIVER and fs.state == CLOSED:
+        if not (flags & F_SYN) or flags & F_ACK:
+            return em  # not a connection attempt; ignore
+        fs.state = SYN_RCVD
+        fs.rcv_nxt = 1
+        fs.snd_nxt = 1
+        _emit_unit(fs, 0, em, retransmit=False)
+        fs.rtt_ts = now
+        _restart_rto(fs, now, em)
+        return em
+    if fs.role == RECEIVER and fs.state == SYN_RCVD and flags & F_SYN and not (flags & F_ACK):
+        # retransmitted SYN: our SYN-ACK was lost or is in flight; resend
+        _emit_unit(fs, 0, em, retransmit=True)
+        _restart_rto(fs, now, em)
+        return em
+
+    # -- ACK processing (every post-handshake segment carries one) ----------
+    if flags & F_ACK:
+        if ack > fs.snd_una:
+            acked = ack - fs.snd_una
+            fs.snd_una = ack
+            if fs.state == SYN_SENT:
+                fs.state = ESTAB
+                fs.rcv_nxt = 1  # the SYN-ACK consumed the peer's unit 0
+            elif fs.state == SYN_RCVD:
+                fs.state = ESTAB
+            if fs.in_rec:
+                if ack >= fs.recover:  # full ack: leave recovery, deflate
+                    fs.cwnd_fp = fs.ssthresh_fp
+                    fs.in_rec = False
+                    fs.dup_acks = 0
+                # partial ack: stay in recovery, the pump is re-streaming
+            else:
+                fs.dup_acks = 0
+                if fs.cwnd_fp < fs.ssthresh_fp:  # slow start (byte counting)
+                    fs.cwnd_fp += acked * FP
+                else:  # congestion avoidance, +1/cwnd per ACK
+                    fs.cwnd_fp += max(1, (FP * FP) // fs.cwnd_fp)
+                fs.cwnd_fp = min(fs.cwnd_fp, MAX_CWND_FP)
+            if fs.rtt_seq >= 0 and ack > fs.rtt_seq:
+                _rtt_sample(fs, now)
+                fs.rtt_seq = -1
+            if flight(fs) > 0:
+                _restart_rto(fs, now, em)
+            else:
+                fs.rto_deadline = NEVER
+        elif ack == fs.snd_una and flight(fs) > 0 and not (flags & (F_DATA | F_SYN | F_FIN)):
+            # pure duplicate ACK
+            if fs.in_rec:
+                fs.cwnd_fp += FP  # fast-recovery inflation
+            else:
+                fs.dup_acks += 1
+                if fs.dup_acks == DUP_THRESH:
+                    fs.in_rec = True
+                    fs.recover = fs.snd_nxt
+                    fs.ssthresh_fp = max(flight(fs) * FP // 2, MIN_SSTHRESH_FP)
+                    fs.cwnd_fp = fs.ssthresh_fp + DUP_THRESH * FP
+                    _pull_back(fs, now, em)
+
+    # -- sender-side teardown ----------------------------------------------
+    if fs.role == SENDER:
+        if flags & F_FIN and fs.snd_una == fs.segs + 2:
+            # server's FIN (its unit 1), and everything of ours (incl. our
+            # FIN) is acked — by this segment or earlier
+            fs.rcv_nxt = 2
+            em.send = (F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES)
+            fs.state = DONE
+            fs.rto_deadline = NEVER
+            em.completed = True
+        elif fs.state == ESTAB and em.send is None and can_send_new(fs):
+            # the ACK opened the window: send one unit now, pump the rest
+            pump = on_pump(fs, now)
+            em.send = pump.send
+            em.arm_pump = pump.arm_pump
+            if pump.arm_rto is not None:
+                em.arm_rto = pump.arm_rto
+        return em
+
+    # -- receiver-side data path -------------------------------------------
+    if fs.state in (SYN_RCVD, ESTAB) and flags & F_SYN and flags & F_ACK:
+        return em  # stray SYN-ACK (we are the receiver); ignore
+    if fs.state == ESTAB or fs.state == SYN_RCVD:
+        if flags & F_DATA:
+            if seq == fs.rcv_nxt:
+                fs.rcv_nxt += 1
+                fs.rx_segs += 1
+                fs.rx_bytes += size - HDR_BYTES
+            # ACK everything (in-order advance or duplicate for OOO)
+            em.send = (F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES)
+        elif flags & F_FIN:
+            if seq == fs.rcv_nxt:
+                # client's FIN in order: consume it, answer with our FIN+ACK
+                fs.rcv_nxt += 1
+                unit = fs.snd_nxt
+                fs.snd_nxt += 1
+                if fs.rtt_seq < 0:
+                    fs.rtt_ts = now
+                _emit_unit(fs, unit, em, retransmit=False)
+                fs.state = LAST_ACK
+                _restart_rto(fs, now, em)
+            else:
+                em.send = (F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES)
+    elif fs.state == LAST_ACK:
+        if fs.snd_una >= 2:
+            # the final ACK arrived (processed above): teardown complete
+            fs.state = DONE
+            fs.rto_deadline = NEVER
+            em.completed = True
+        elif (flags & (F_DATA | F_FIN)) and seq < fs.rcv_nxt:
+            # stale retransmission: the peer missed our FIN+ACK (or its
+            # cumulative ack); resend it so the flow can't deadlock
+            _emit_unit(fs, fs.snd_una, em, retransmit=True)
+            _restart_rto(fs, now, em)
+    return em
+
+
+def segs_for_size(size_bytes: int, mss: int) -> tuple[int, int]:
+    """Split a transfer size into (segments, last_segment_bytes)."""
+    if size_bytes <= 0:
+        return 0, mss
+    segs = -(-size_bytes // mss)
+    last = size_bytes - (segs - 1) * mss
+    return segs, last

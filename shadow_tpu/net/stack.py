@@ -66,6 +66,9 @@ class SimTcpSocket:
         self.tcp = tcp
         self.on_event: Optional[Callable[["SimTcpSocket", int], None]] = None
         self._armed_deadline: Optional[int] = None
+        # peer host id, resolved once (connect/accept); every segment of a
+        # connection goes to the same host — no per-segment DNS lookups
+        self.dst_host: Optional[int] = None
 
     # -- app API -----------------------------------------------------------
 
@@ -154,6 +157,7 @@ class HostNetStack:
         iss = self.host.rand_u32()
         tcp.connect(local, (dst_ip, dst_port), iss=iss, now=self.host.now)
         sock = SimTcpSocket(self, tcp)
+        sock.dst_host = dst_host
         self.tcp_conns[tcp.four_tuple()] = sock
         self.flush_socket(sock)
         return sock
@@ -201,6 +205,7 @@ class HostNetStack:
                 self.host.count("tcp_backlog_drops")
                 return
             sock = SimTcpSocket(self, child)
+            sock.dst_host = self._host_for_ip(hdr.src_ip)
             self._embryonic[child.four_tuple()] = sock
             self.flush_socket(sock)
             return
@@ -231,9 +236,12 @@ class HostNetStack:
 
     # -- egress ------------------------------------------------------------
 
-    def _transmit(self, hdr: TcpHeader, data: bytes) -> None:
+    def _transmit(
+        self, hdr: TcpHeader, data: bytes, dst: Optional[int] = None
+    ) -> None:
         seg = TcpSegment(hdr, data)
-        dst = self._host_for_ip(hdr.dst_ip)
+        if dst is None:  # only the unmatched-segment RST path resolves
+            dst = self._host_for_ip(hdr.dst_ip)
         if dst is None:
             self.host.count("tcp_no_route_drops")
             return
@@ -256,7 +264,7 @@ class HostNetStack:
             if out is None:
                 break
             hdr, data = out
-            self._transmit(hdr, data)
+            self._transmit(hdr, data, sock.dst_host)
         self._rearm_timer(sock)
         if tcp.is_closed():
             self.tcp_conns.pop(sock.key, None)

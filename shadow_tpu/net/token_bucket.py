@@ -35,13 +35,15 @@ def bucket_params(bits_per_sec: int, interval_ns: int = DEFAULT_INTERVAL_NS) -> 
 
 @dataclasses.dataclass
 class TokenBucket:
-    """State: (tokens, next_refill).  ``rate == 0`` means unlimited."""
+    """State: (tokens, next_refill, last_depart).  ``rate == 0`` means
+    unlimited."""
 
     rate: int  # bits added per interval
     burst: int  # max tokens
     interval: int = DEFAULT_INTERVAL_NS
     tokens: int = -1  # set to burst in __post_init__
     next_refill: int = -1
+    last_depart: int = 0
 
     def __post_init__(self) -> None:
         if self.tokens < 0:
@@ -51,19 +53,27 @@ class TokenBucket:
 
     def charge(self, t: int, bits: int) -> int:
         """Charge ``bits`` at time ``t`` (non-decreasing across calls);
-        returns the departure time."""
+        returns the departure time.
+
+        FIFO law: the charge clock is ``max(t, last_depart)`` — a packet
+        that queued for a future refill moves the whole line behind it,
+        so leftover tokens earned *at* that refill cannot let a later
+        packet depart before an earlier one (departures are monotone)."""
         if self.rate == 0:
             return t
+        t = max(t, self.last_depart)
         if t >= self.next_refill:
             k = (t - self.next_refill) // self.interval + 1
             self.tokens = min(self.burst, self.tokens + k * self.rate)
             self.next_refill += k * self.interval
         if self.tokens >= bits:
             self.tokens -= bits
+            self.last_depart = t
             return t
         need = bits - self.tokens
         w = -(-need // self.rate)  # ceil
         depart = self.next_refill + (w - 1) * self.interval
         self.tokens = max(0, min(self.burst, self.tokens + w * self.rate) - bits)
         self.next_refill += w * self.interval
+        self.last_depart = depart
         return depart
