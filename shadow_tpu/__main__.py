@@ -70,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="write the canonical sorted event log (determinism-diff artifact)",
     )
+    p.add_argument(
+        "--determinism-check",
+        action="store_true",
+        help="run the simulation twice and fail unless both runs produce "
+        "bit-identical event orderings and counters (the reference's "
+        "determinism test, src/test/determinism/, as a CLI mode)",
+    )
     return p
 
 
@@ -115,6 +122,17 @@ def main(argv: list[str] | None = None) -> int:
     if ns.show_config:
         print(json.dumps(dataclasses.asdict(cfg), indent=2, default=str))
         return 0
+
+    if ns.determinism_check:
+        from shadow_tpu.engine.determinism import determinism_check
+
+        try:
+            report = determinism_check(cfg)
+        except Exception as e:
+            print(f"simulation failed: {e}", file=sys.stderr)
+            return 1
+        print(report.describe(), file=sys.stderr)
+        return 0 if report.identical else 1
 
     sim = Simulation(cfg)
     try:

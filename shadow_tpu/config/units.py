@@ -37,6 +37,10 @@ _TIME_UNITS = {
 
 _SI = {"": 1, "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12}
 _IEC = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40}
+# "k" is the canonical lowercase SI kilo ("300kB"); accept it everywhere K is
+for _d in (_SI, _IEC):
+    for _k in [k for k in _d if k.startswith("K")]:
+        _d["k" + _k[1:]] = _d[_k]
 
 
 def _bit_units() -> dict[str, int]:

@@ -68,7 +68,12 @@ class TgenTcpClient:
                 api.count("tcp_refused" if not self._established else "tcp_aborted")
                 sock.close()
             return
-        self._established = True
+        if ps & PollState.WRITABLE:
+            # only a completed handshake makes the socket writable; a
+            # timer event in SYN_SENT (e.g. a SYN-retransmit) must not
+            # mark the flow established or a later failure would count
+            # as tcp_aborted instead of tcp_refused
+            self._established = True
         while self._remaining > 0 and ps & PollState.WRITABLE:
             n = sock.send(bytes(min(self._remaining, CHUNK)))
             if n == 0:

@@ -274,7 +274,7 @@ def on_rto_event(fs: FlowState, now: int) -> Emit:
     if now != fs.rto_evt:
         return em  # stale (superseded) event
     fs.rto_evt = NEVER
-    if fs.rto_deadline == NEVER or flight(fs) == 0:
+    if fs.rto_deadline == NEVER or flight(fs) <= 0:
         return em
     if now < fs.rto_deadline:
         fs.rto_evt = fs.rto_deadline
@@ -326,6 +326,12 @@ def on_segment(
         if ack > fs.snd_una:
             acked = ack - fs.snd_una
             fs.snd_una = ack
+            if fs.snd_nxt < fs.snd_una:
+                # a delayed ACK (sent before a spurious RTO's go-back-N
+                # rewind) may cover units above the rewound snd_nxt; clamp
+                # so flight() can't go negative and the pump can't
+                # re-stream units the receiver already acknowledged
+                fs.snd_nxt = fs.snd_una
             if fs.state == SYN_SENT:
                 fs.state = ESTAB
                 fs.rcv_nxt = 1  # the SYN-ACK consumed the peer's unit 0

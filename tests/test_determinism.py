@@ -1,0 +1,117 @@
+"""Run-twice determinism: the reference's regression gate
+(src/test/determinism/CMakeLists.txt) — same config, two fresh runs,
+bit-identical event orderings and counters required.
+"""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.determinism import compare_results, determinism_check
+
+REPO = Path(__file__).resolve().parents[1]
+
+PHOLD = """
+general: {stop_time: 400ms, seed: 13, heartbeat_interval: null}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "2 ms" ]
+        edge [ source 0 target 1 latency "5 ms" packet_loss 0.1 ]
+        edge [ source 1 target 1 latency "2 ms" ]
+      ]
+hosts:
+  a: {network_node_id: 0, processes: [{path: phold, args: [--messages, "4"]}]}
+  b: {network_node_id: 1, processes: [{path: phold, args: [--messages, "4"]}]}
+  c: {network_node_id: 1, processes: [{path: phold, args: [--messages, "3"]}]}
+"""
+
+
+def test_phold_cpu_run_twice_identical():
+    report = determinism_check(ConfigOptions.from_yaml(PHOLD))
+    assert report.identical, report.describe()
+    assert report.records > 50
+    assert "PASSED" in report.describe()
+
+
+def test_phold_tpu_run_twice_identical():
+    cfg = ConfigOptions.from_yaml(PHOLD)
+    cfg.experimental.network_backend = "tpu"
+    report = determinism_check(cfg)
+    assert report.identical, report.describe()
+
+
+def test_seed_changes_the_run():
+    cfg1 = ConfigOptions.from_yaml(PHOLD)
+    cfg2 = ConfigOptions.from_yaml(PHOLD)
+    cfg2.general.seed = 14
+    from shadow_tpu.backend.cpu_engine import CpuEngine
+
+    r1 = CpuEngine(cfg1).run()
+    r2 = CpuEngine(cfg2).run()
+    report = compare_results(r1, r2)
+    assert not report.identical
+    assert "FAILED" in report.describe()
+
+
+def test_parallelism_does_not_change_the_run():
+    # the reference's determinism1 runs with --parallelism 2; ordering must
+    # not depend on the worker count
+    cfg1 = ConfigOptions.from_yaml(PHOLD)
+    cfg2 = ConfigOptions.from_yaml(PHOLD)
+    cfg2.general.parallelism = 2
+    from shadow_tpu.backend.cpu_engine import CpuEngine
+
+    report = compare_results(CpuEngine(cfg1).run(), CpuEngine(cfg2).run())
+    assert report.identical, report.describe()
+
+
+def test_cli_determinism_check(tmp_path):
+    cfg_path = tmp_path / "phold.yaml"
+    cfg_path.write_text(PHOLD)
+    proc = subprocess.run(
+        ["python", "-m", "shadow_tpu", str(cfg_path), "--determinism-check",
+         "--data-directory", str(tmp_path / "data")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "determinism check PASSED" in proc.stderr
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+    )
+
+
+def test_managed_native_run_twice_identical(native_build, tmp_path):
+    build = REPO / "native" / "build"
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: 2s, seed: 21, data_directory: {tmp_path / 'data'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  cli:
+    network_node_id: 0
+    processes:
+      - path: {build / 'pingpong'}
+        args: [client, 11.0.0.2, "9000", "4", "100"]
+  srv:
+    network_node_id: 0
+    processes:
+      - path: {build / 'pingpong'}
+        args: [server, "9000", "4"]
+"""
+    )
+    report = determinism_check(cfg)
+    assert report.identical, report.describe()
