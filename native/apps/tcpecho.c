@@ -1,0 +1,220 @@
+/* tcpecho: TCP workload plugin for shim tests (the fork-free analog of the
+ * reference's socket test binaries, src/test/socket/).
+ *
+ * Modes:
+ *   server <port> <nconns>
+ *     epoll-driven echo server: accepts nconns connections, echoes every
+ *     byte until peer EOF, then exits.  Exercises listen/accept4/epoll/
+ *     nonblocking reads.
+ *   client <ip> <port> <rounds> <size> <gap_ms>
+ *     blocking client: connect, then rounds x (write size bytes, read the
+ *     echo back fully, sleep gap_ms).
+ *   nbclient <ip> <port>
+ *     nonblocking connect + poll + SO_ERROR check, then one 64-byte echo.
+ *     Exercises EINPROGRESS/POLLOUT/getsockopt.
+ *
+ * Prints one summary line to stdout; the test asserts on it and on
+ * determinism of the whole run.
+ */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static void die(const char *what) {
+    fprintf(stderr, "tcpecho: %s: %s\n", what, strerror(errno));
+    exit(1);
+}
+
+static void msleep(long ms) {
+    struct timespec ts = {ms / 1000, (ms % 1000) * 1000000L};
+    nanosleep(&ts, NULL);
+}
+
+static uint64_t now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (uint64_t)ts.tv_sec * 1000u + (uint64_t)(ts.tv_nsec / 1000000);
+}
+
+/* read exactly n bytes (blocking fd) */
+static int read_full(int fd, char *buf, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+        ssize_t r = read(fd, buf + got, n - got);
+        if (r <= 0) return -1;
+        got += (size_t)r;
+    }
+    return 0;
+}
+
+static int run_server(int port, int nconns) {
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) die("socket");
+    struct sockaddr_in sin = {0};
+    sin.sin_family = AF_INET;
+    sin.sin_addr.s_addr = INADDR_ANY;
+    sin.sin_port = htons((uint16_t)port);
+    if (bind(lfd, (struct sockaddr *)&sin, sizeof(sin)) != 0) die("bind");
+    if (listen(lfd, 16) != 0) die("listen");
+
+    int ep = epoll_create1(0);
+    if (ep < 0) die("epoll_create1");
+    struct epoll_event ev = {0};
+    ev.events = EPOLLIN;
+    ev.data.fd = lfd;
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev) != 0) die("epoll_ctl add lfd");
+
+    long total_bytes = 0;
+    int accepted = 0, closed = 0;
+    char buf[8192];
+    while (closed < nconns) {
+        struct epoll_event events[16];
+        int n = epoll_wait(ep, events, 16, 30000);
+        if (n < 0) die("epoll_wait");
+        if (n == 0) {
+            fprintf(stderr, "tcpecho: server timed out\n");
+            return 1;
+        }
+        for (int i = 0; i < n; i++) {
+            int fd = events[i].data.fd;
+            if (fd == lfd) {
+                struct sockaddr_in peer;
+                socklen_t plen = sizeof(peer);
+                int cfd = accept4(lfd, (struct sockaddr *)&peer, &plen, 0);
+                if (cfd < 0) die("accept4");
+                accepted++;
+                struct epoll_event cev = {0};
+                cev.events = EPOLLIN;
+                cev.data.fd = cfd;
+                if (epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &cev) != 0)
+                    die("epoll_ctl add cfd");
+                continue;
+            }
+            ssize_t r = recv(fd, buf, sizeof(buf), MSG_DONTWAIT);
+            if (r > 0) {
+                total_bytes += r;
+                ssize_t off = 0;
+                while (off < r) {
+                    ssize_t w = write(fd, buf + off, (size_t)(r - off));
+                    if (w <= 0) die("write");
+                    off += w;
+                }
+            } else if (r == 0 || (r < 0 && errno != EAGAIN)) {
+                epoll_ctl(ep, EPOLL_CTL_DEL, fd, NULL);
+                close(fd);
+                closed++;
+            }
+        }
+    }
+    close(lfd);
+    printf("server done conns=%d bytes=%ld t=%llu\n", accepted, total_bytes,
+           (unsigned long long)now_ms());
+    return 0;
+}
+
+static int run_client(const char *ip, int port, int rounds, int size,
+                      int gap_ms) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) die("socket");
+    struct sockaddr_in sin = {0};
+    sin.sin_family = AF_INET;
+    sin.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, ip, &sin.sin_addr) != 1) die("inet_pton");
+    if (connect(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
+        printf("client connect errno=%d\n", errno);
+        return 0; /* refused-connection runs assert on this line */
+    }
+    char *buf = malloc((size_t)size);
+    char *echo = malloc((size_t)size);
+    memset(buf, 0xA5, (size_t)size);
+    if (write(fd, buf, 0) != 0) die("zero-length write");
+    long total = 0;
+    for (int i = 0; i < rounds; i++) {
+        ssize_t off = 0;
+        while (off < size) {
+            ssize_t w = write(fd, buf + off, (size_t)(size - off));
+            if (w <= 0) die("write");
+            off += w;
+        }
+        if (read_full(fd, echo, (size_t)size) != 0) die("read echo");
+        if (memcmp(buf, echo, (size_t)size) != 0) die("echo mismatch");
+        total += size;
+        if (gap_ms > 0) msleep(gap_ms);
+    }
+    shutdown(fd, SHUT_WR);
+    /* drain until EOF so the server sees our FIN before we close */
+    while (read(fd, echo, (size_t)size) > 0) {
+    }
+    close(fd);
+    printf("client done rounds=%d bytes=%ld t=%llu\n", rounds, total,
+           (unsigned long long)now_ms());
+    free(buf);
+    free(echo);
+    return 0;
+}
+
+static int run_nbclient(const char *ip, int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) die("socket");
+    if (fcntl(fd, F_SETFL, O_NONBLOCK) != 0) die("fcntl");
+    struct sockaddr_in sin = {0};
+    sin.sin_family = AF_INET;
+    sin.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, ip, &sin.sin_addr) != 1) die("inet_pton");
+    int rc = connect(fd, (struct sockaddr *)&sin, sizeof(sin));
+    if (rc == 0) {
+        printf("nbclient connected immediately?\n");
+        return 1;
+    }
+    if (errno != EINPROGRESS) die("connect (expected EINPROGRESS)");
+    struct pollfd pfd = {fd, POLLOUT, 0};
+    int pr = poll(&pfd, 1, 10000);
+    if (pr != 1) die("poll for connect");
+    int err = -1;
+    socklen_t elen = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0)
+        die("getsockopt");
+    if (err != 0) {
+        printf("nbclient connect err=%d\n", err);
+        return 0;
+    }
+    /* back to blocking for the echo */
+    if (fcntl(fd, F_SETFL, 0) != 0) die("fcntl clear");
+    char buf[64];
+    memset(buf, 0x5A, sizeof(buf));
+    if (write(fd, buf, sizeof(buf)) != (ssize_t)sizeof(buf)) die("write");
+    char echo[64];
+    if (read_full(fd, echo, sizeof(echo)) != 0) die("read");
+    if (memcmp(buf, echo, sizeof(echo)) != 0) die("mismatch");
+    shutdown(fd, SHUT_WR);
+    while (read(fd, echo, sizeof(echo)) > 0) {
+    }
+    close(fd);
+    printf("nbclient done bytes=64 t=%llu\n", (unsigned long long)now_ms());
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    if (argc >= 4 && strcmp(argv[1], "server") == 0)
+        return run_server(atoi(argv[2]), atoi(argv[3]));
+    if (argc >= 7 && strcmp(argv[1], "client") == 0)
+        return run_client(argv[2], atoi(argv[3]), atoi(argv[4]), atoi(argv[5]),
+                          atoi(argv[6]));
+    if (argc >= 4 && strcmp(argv[1], "nbclient") == 0)
+        return run_nbclient(argv[2], atoi(argv[3]));
+    fprintf(stderr,
+            "usage: tcpecho server <port> <nconns> | "
+            "client <ip> <port> <rounds> <size> <gap_ms> | "
+            "nbclient <ip> <port>\n");
+    return 2;
+}

@@ -8,6 +8,13 @@
  * single biggest perf win (~50ns clock reads vs ~10us trapped syscalls,
  * MyTest/SUMMARY.md:71-75).
  *
+ * Virtual fds are REAL fd numbers: the shim reserves a kernel fd (dup of
+ * /dev/null) for every simulated socket and registers that number with the
+ * manager, so simulated fds never collide with the plugin's real fds and
+ * stay below FD_SETSIZE for select().  This mirrors the reference's
+ * ownership of the plugin fd table (descriptor_table.rs), done the
+ * LD_PRELOAD way.
+ *
  * Layout rules: fixed-width types only, no pointers (the region is mapped
  * at different addresses in each process), explicit padding; the Python
  * side mirrors this struct byte-for-byte in shadow_tpu/native/abi.py and
@@ -18,29 +25,47 @@
 
 #include <stdint.h>
 
-#define SHIM_ABI_MAGIC 0x53485457534d4831ull /* "SHTWSMH1" */
+#define SHIM_ABI_MAGIC 0x53485457534d4832ull /* "SHTWSMH2" */
 #define SHIM_PAYLOAD_MAX 65536
 
-/* plugin -> shadow ops */
+/* plugin -> shadow ops.  Unless noted, replies carry ret = result or
+ * -errno.  "nb" args request EAGAIN instead of parking the plugin. */
 enum {
     SHIM_OP_NONE = 0,
     SHIM_OP_START = 1,     /* shim initialized, waiting for go */
     SHIM_OP_EXIT = 2,      /* args[0] = exit code */
     SHIM_OP_NANOSLEEP = 3, /* args[0] = ns */
-    SHIM_OP_SOCKET = 4,    /* args[0] = domain, args[1] = type */
+    SHIM_OP_SOCKET = 4,    /* args[0]=domain args[1]=type args[2]=reserved fd */
     SHIM_OP_BIND = 5,      /* args[0] = fd, args[1] = port (host order) */
-    SHIM_OP_SENDTO = 6,    /* args[0]=fd args[1]=dst_ip(BE u32) args[2]=dst_port; payload */
-    SHIM_OP_RECVFROM = 7,  /* args[0] = fd, args[1] = max_len; reply payload + args */
+    SHIM_OP_SENDTO = 6,    /* args[0]=fd args[1]=dst_ip(BE u32) args[2]=dst_port
+                              args[3]=nb; payload = data */
+    SHIM_OP_RECVFROM = 7,  /* args[0]=fd args[1]=max_len args[2]=nb;
+                              reply payload + args[1]=src ip args[2]=src port */
     SHIM_OP_CLOSE = 8,     /* args[0] = fd */
-    SHIM_OP_CONNECT = 9,   /* args[0]=fd args[1]=ip(BE) args[2]=port */
+    SHIM_OP_CONNECT = 9,   /* args[0]=fd args[1]=ip(BE) args[2]=port args[3]=nb */
     SHIM_OP_GETSOCKNAME = 10, /* args[0]=fd; reply args[1]=ip args[2]=port */
+    SHIM_OP_LISTEN = 11,   /* args[0]=fd args[1]=backlog */
+    SHIM_OP_ACCEPT = 12,   /* args[0]=fd args[1]=nb args[2]=reserved child fd;
+                              reply ret=child fd, args[1]=peer ip args[2]=port */
+    SHIM_OP_SHUTDOWN = 13, /* args[0]=fd args[1]=how */
+    SHIM_OP_GETPEERNAME = 14, /* args[0]=fd; reply args[1]=ip args[2]=port */
+    SHIM_OP_SOCKERR = 15,  /* args[0]=fd; reply args[1]=pending socket errno */
+    SHIM_OP_POLL = 16,     /* args[0]=nfds args[1]=timeout ns (-1 = infinite);
+                              payload = nfds * shim_pollfd;
+                              reply ret=nready, payload = nfds * u32 revents */
 };
 
-/* shadow -> plugin reply status */
-enum {
-    SHIM_REPLY_OK = 0,
-    SHIM_REPLY_ERRNO = 1, /* ret = -errno */
-};
+/* poll event bits (mirror Linux poll.h values) */
+#define SHIM_POLLIN 0x0001
+#define SHIM_POLLOUT 0x0004
+#define SHIM_POLLERR 0x0008
+#define SHIM_POLLHUP 0x0010
+#define SHIM_POLLNVAL 0x0020
+
+typedef struct {
+    int32_t fd;
+    uint32_t events;
+} shim_pollfd;
 
 /* One direction of the duplex channel.  `turn` is the futex word:
  * 0 = empty (receiver sleeps), 1 = message ready (sender wrote). */

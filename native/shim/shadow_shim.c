@@ -6,19 +6,25 @@
  *
  *   - time (clock_gettime/gettimeofday/time) is serviced *locally* from the
  *     shared-memory sim clock, no channel hop (shim/shim_sys.c:24-37);
- *   - sleeping and UDP socket I/O round-trip to the manager over a pair of
- *     futex-word channels in shared memory (the IPCData equivalent,
- *     shadow-shim-helper-rs/src/ipc.rs:14);
+ *   - sleeping and socket I/O (UDP datagrams and TCP streams) round-trip to
+ *     the manager over a pair of futex-word channels in shared memory (the
+ *     IPCData equivalent, shadow-shim-helper-rs/src/ipc.rs:14);
+ *   - readiness (poll/select/epoll) over simulated fds is evaluated by the
+ *     manager against the simulated transport state (SHIM_OP_POLL);
  *   - getrandom / /dev/urandom-free entropy is deterministic splitmix64
  *     keyed per process (preload-openssl/src/rng.c's determinism goal).
+ *
+ * Simulated sockets occupy REAL fd numbers: each is backed by a reserved
+ * kernel fd (dup of /dev/null), so simulated fds never collide with the
+ * plugin's own files and stay below FD_SETSIZE — the LD_PRELOAD analog of
+ * the reference owning the plugin's descriptor table
+ * (descriptor/descriptor_table.rs).
  *
  * Interposition here is symbol-level (LD_PRELOAD overrides the PLT), the
  * fast path the reference prefers over seccomp for the same reason
  * (preload-libc/: "faster than seccomp"); the seccomp SIGSYS backstop for
  * raw-syscall binaries is future work.  Static binaries are rejected by
  * the manager, as in the reference (src/test/static-bin).
- *
- * Virtual fds live at >= SHIM_FD_BASE so real fds pass through untouched.
  */
 #define _GNU_SOURCE
 #include <arpa/inet.h>
@@ -28,13 +34,17 @@
 #include <limits.h>
 #include <linux/futex.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <stdarg.h>
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/epoll.h>
+#include <sys/ioctl.h>
 #include <sys/mman.h>
 #include <sys/random.h>
+#include <sys/select.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
@@ -44,22 +54,97 @@
 
 #include "../include/shadow_shim_abi.h"
 
-#define SHIM_FD_BASE 10000
+#define SHIM_MAX_FDS 4096
 
 static shim_shmem *g_shm = NULL;
 static int g_ready = 0;
+
+/* per-fd shim state: kind + O_NONBLOCK, indexed by the real fd number */
+enum { VK_NONE = 0, VK_SOCKET = 1 };
+static uint8_t vfd_kind[SHIM_MAX_FDS];
+static uint8_t vfd_nonblock[SHIM_MAX_FDS];
+
+/* per-epfd registration of simulated fds (real fds still ride the real
+ * epoll object; mixing both in one wait services the simulated side) */
+typedef struct {
+    int fd;
+    uint32_t events;
+    uint64_t data;
+} epoll_reg;
+#define EPOLL_MAX_REGS 1024
+static epoll_reg *epoll_regs[SHIM_MAX_FDS]; /* array per epfd, lazy alloc */
+static int epoll_nregs[SHIM_MAX_FDS];
+static uint8_t epoll_has_real[SHIM_MAX_FDS]; /* real fds also registered */
+
+/* a closing fd leaves every epoll interest list (Linux auto-deregisters);
+ * a closing epfd drops its whole registration table */
+static void epoll_forget_fd(int fd) {
+    if (fd < 0 || fd >= SHIM_MAX_FDS) return;
+    epoll_nregs[fd] = 0;
+    epoll_has_real[fd] = 0;
+    for (int ep = 0; ep < SHIM_MAX_FDS; ep++) {
+        epoll_reg *regs = epoll_regs[ep];
+        int n = epoll_nregs[ep];
+        for (int i = 0; i < n; i++) {
+            if (regs[i].fd == fd) {
+                regs[i] = regs[n - 1];
+                epoll_nregs[ep] = --n;
+                i--;
+            }
+        }
+    }
+}
 
 /* real libc entry points (resolved once; interposed wrappers fall through
  * for fds we don't own) */
 static int (*real_socket)(int, int, int);
 static int (*real_bind)(int, const struct sockaddr *, socklen_t);
 static int (*real_connect)(int, const struct sockaddr *, socklen_t);
+static int (*real_listen)(int, int);
+static int (*real_accept4)(int, struct sockaddr *, socklen_t *, int);
 static ssize_t (*real_sendto)(int, const void *, size_t, int,
                               const struct sockaddr *, socklen_t);
 static ssize_t (*real_recvfrom)(int, void *, size_t, int, struct sockaddr *,
                                 socklen_t *);
 static int (*real_close)(int);
+static int (*real_shutdown)(int, int);
 static int (*real_getsockname)(int, struct sockaddr *, socklen_t *);
+static int (*real_getpeername)(int, struct sockaddr *, socklen_t *);
+static int (*real_setsockopt)(int, int, int, const void *, socklen_t);
+static int (*real_getsockopt)(int, int, int, void *, socklen_t *);
+static ssize_t (*real_read)(int, void *, size_t);
+static ssize_t (*real_write)(int, const void *, size_t);
+static int (*real_fcntl)(int, int, ...);
+static int (*real_ioctl)(int, unsigned long, ...);
+static int (*real_poll)(struct pollfd *, nfds_t, int);
+static int (*real_select)(int, fd_set *, fd_set *, fd_set *, struct timeval *);
+static int (*real_epoll_ctl)(int, int, int, struct epoll_event *);
+static int (*real_epoll_wait)(int, struct epoll_event *, int, int);
+
+static void resolve_reals(void) {
+    if (real_socket) return;
+    real_socket = dlsym(RTLD_NEXT, "socket");
+    real_bind = dlsym(RTLD_NEXT, "bind");
+    real_connect = dlsym(RTLD_NEXT, "connect");
+    real_listen = dlsym(RTLD_NEXT, "listen");
+    real_accept4 = dlsym(RTLD_NEXT, "accept4");
+    real_sendto = dlsym(RTLD_NEXT, "sendto");
+    real_recvfrom = dlsym(RTLD_NEXT, "recvfrom");
+    real_close = dlsym(RTLD_NEXT, "close");
+    real_shutdown = dlsym(RTLD_NEXT, "shutdown");
+    real_getsockname = dlsym(RTLD_NEXT, "getsockname");
+    real_getpeername = dlsym(RTLD_NEXT, "getpeername");
+    real_setsockopt = dlsym(RTLD_NEXT, "setsockopt");
+    real_getsockopt = dlsym(RTLD_NEXT, "getsockopt");
+    real_read = dlsym(RTLD_NEXT, "read");
+    real_write = dlsym(RTLD_NEXT, "write");
+    real_fcntl = dlsym(RTLD_NEXT, "fcntl");
+    real_ioctl = dlsym(RTLD_NEXT, "ioctl");
+    real_poll = dlsym(RTLD_NEXT, "poll");
+    real_select = dlsym(RTLD_NEXT, "select");
+    real_epoll_ctl = dlsym(RTLD_NEXT, "epoll_ctl");
+    real_epoll_wait = dlsym(RTLD_NEXT, "epoll_wait");
+}
 
 /* ---------------------------------------------------------------- futex */
 
@@ -107,6 +192,15 @@ static int64_t shim_call(uint32_t op, const int64_t args[6], const void *out,
     return rx->ret;
 }
 
+/* return-value helper: negative ret carries -errno */
+static int64_t ret_errno(int64_t ret) {
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    return ret;
+}
+
 /* ------------------------------------------------------------ init/exit */
 
 static void shim_abort(const char *why) {
@@ -117,17 +211,17 @@ static void shim_abort(const char *why) {
     _exit(127);
 }
 
+static void shim_warn(const char *what) {
+    const char *msg = "shadow_shim: warning: ";
+    (void)!real_write(2, msg, strlen(msg));
+    (void)!real_write(2, what, strlen(what));
+    (void)!real_write(2, "\n", 1);
+}
+
 __attribute__((constructor)) static void shim_init(void) {
     const char *path = getenv("SHADOW_TPU_SHM");
+    resolve_reals();
     if (!path) return; /* not under the simulator: become a no-op */
-
-    real_socket = dlsym(RTLD_NEXT, "socket");
-    real_bind = dlsym(RTLD_NEXT, "bind");
-    real_connect = dlsym(RTLD_NEXT, "connect");
-    real_sendto = dlsym(RTLD_NEXT, "sendto");
-    real_recvfrom = dlsym(RTLD_NEXT, "recvfrom");
-    real_close = dlsym(RTLD_NEXT, "close");
-    real_getsockname = dlsym(RTLD_NEXT, "getsockname");
 
     int fd = open(path, O_RDWR);
     if (fd < 0) shim_abort("cannot open SHADOW_TPU_SHM");
@@ -156,6 +250,39 @@ __attribute__((destructor)) static void shim_fini(void) {
     for (int i = 0; i < 6; i++) tx->args[i] = args[i];
     tx->payload_len = 0;
     msg_publish(tx); /* no reply: the process is on its way out */
+}
+
+/* ----------------------------------------------------- virtual fd table */
+
+static int is_vfd(int fd) {
+    /* also the lazy-init hook: wrappers can be reached from other libraries'
+     * constructors before our own constructor resolved the real symbols */
+    if (!real_socket) resolve_reals();
+    return g_ready && fd >= 0 && fd < SHIM_MAX_FDS && vfd_kind[fd] == VK_SOCKET;
+}
+
+/* Reserve a real kernel fd slot for a simulated socket so the number can't
+ * collide with the plugin's own fds. */
+static int reserve_fd(void) {
+    int fd = open("/dev/null", O_RDONLY);
+    if (fd < 0) return -1;
+    if (fd >= SHIM_MAX_FDS) {
+        real_close(fd);
+        errno = EMFILE;
+        return -1;
+    }
+    return fd;
+}
+
+static void vfd_register(int fd, int nonblock) {
+    vfd_kind[fd] = VK_SOCKET;
+    vfd_nonblock[fd] = (uint8_t)(nonblock != 0);
+}
+
+static void vfd_release(int fd) {
+    vfd_kind[fd] = VK_NONE;
+    vfd_nonblock[fd] = 0;
+    real_close(fd); /* free the /dev/null reservation */
 }
 
 /* --------------------------------------------------------------- time */
@@ -213,11 +340,8 @@ int nanosleep(const struct timespec *req, struct timespec *rem) {
 }
 
 int usleep(useconds_t usec) {
-    if (!g_ready) {
-        struct timespec ts = {usec / 1000000, (long)(usec % 1000000) * 1000};
-        return syscall(SYS_nanosleep, &ts, NULL);
-    }
     struct timespec ts = {usec / 1000000, (long)(usec % 1000000) * 1000};
+    if (!g_ready) return syscall(SYS_nanosleep, &ts, NULL);
     return nanosleep(&ts, NULL);
 }
 
@@ -253,94 +377,116 @@ ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
 
 /* ------------------------------------------------------------- sockets */
 
-static int is_virtual_fd(int fd) { return g_ready && fd >= SHIM_FD_BASE; }
+static int addr_to_ip_port(const struct sockaddr *addr, socklen_t len,
+                           uint32_t *ip, uint16_t *port) {
+    if (!addr || len < sizeof(struct sockaddr_in) ||
+        addr->sa_family != AF_INET) {
+        errno = EINVAL;
+        return -1;
+    }
+    const struct sockaddr_in *sin = (const struct sockaddr_in *)addr;
+    *ip = sin->sin_addr.s_addr;
+    *port = ntohs(sin->sin_port);
+    return 0;
+}
+
+static void fill_sockaddr(struct sockaddr *addr, socklen_t *alen, uint32_t ip,
+                          uint16_t port) {
+    if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in *sin = (struct sockaddr_in *)addr;
+        memset(sin, 0, sizeof(*sin));
+        sin->sin_family = AF_INET;
+        sin->sin_addr.s_addr = ip;
+        sin->sin_port = htons(port);
+        *alen = sizeof(struct sockaddr_in);
+    }
+}
 
 int socket(int domain, int type, int protocol) {
+    if (!real_socket) resolve_reals();
     int base_type = type & ~(SOCK_NONBLOCK | SOCK_CLOEXEC);
-    if (!g_ready || domain != AF_INET || base_type != SOCK_DGRAM)
+    if (!g_ready || domain != AF_INET ||
+        (base_type != SOCK_DGRAM && base_type != SOCK_STREAM))
         return real_socket(domain, type, protocol);
-    int64_t args[6] = {domain, base_type, 0, 0, 0, 0};
+    int fd = reserve_fd();
+    if (fd < 0) return -1;
+    int64_t args[6] = {domain, base_type, fd, 0, 0, 0};
     int64_t ret = shim_call(SHIM_OP_SOCKET, args, NULL, 0, NULL, NULL, NULL);
     if (ret < 0) {
+        real_close(fd);
         errno = (int)-ret;
         return -1;
     }
-    return (int)ret; /* manager hands out fds >= SHIM_FD_BASE */
+    vfd_register(fd, (type & SOCK_NONBLOCK) != 0);
+    return fd;
 }
 
 int bind(int fd, const struct sockaddr *addr, socklen_t len) {
-    if (!is_virtual_fd(fd)) return real_bind(fd, addr, len);
-    if (!addr || len < sizeof(struct sockaddr_in) ||
-        addr->sa_family != AF_INET) {
-        errno = EINVAL;
-        return -1;
-    }
-    const struct sockaddr_in *sin = (const struct sockaddr_in *)addr;
-    int64_t args[6] = {fd, ntohs(sin->sin_port), 0, 0, 0, 0};
-    int64_t ret = shim_call(SHIM_OP_BIND, args, NULL, 0, NULL, NULL, NULL);
-    if (ret < 0) {
-        errno = (int)-ret;
-        return -1;
-    }
-    return 0;
+    if (!is_vfd(fd)) return real_bind(fd, addr, len);
+    uint32_t ip;
+    uint16_t port;
+    if (addr_to_ip_port(addr, len, &ip, &port) != 0) return -1;
+    int64_t args[6] = {fd, port, 0, 0, 0, 0};
+    return (int)ret_errno(
+        shim_call(SHIM_OP_BIND, args, NULL, 0, NULL, NULL, NULL));
 }
 
 int connect(int fd, const struct sockaddr *addr, socklen_t len) {
-    if (!is_virtual_fd(fd)) return real_connect(fd, addr, len);
-    if (!addr || len < sizeof(struct sockaddr_in) ||
-        addr->sa_family != AF_INET) {
-        errno = EINVAL;
-        return -1;
-    }
-    const struct sockaddr_in *sin = (const struct sockaddr_in *)addr;
-    int64_t args[6] = {fd, (int64_t)(uint32_t)sin->sin_addr.s_addr,
-                       ntohs(sin->sin_port), 0, 0, 0};
-    int64_t ret = shim_call(SHIM_OP_CONNECT, args, NULL, 0, NULL, NULL, NULL);
+    if (!is_vfd(fd)) return real_connect(fd, addr, len);
+    uint32_t ip;
+    uint16_t port;
+    if (addr_to_ip_port(addr, len, &ip, &port) != 0) return -1;
+    int64_t args[6] = {fd, (int64_t)ip, port, vfd_nonblock[fd], 0, 0};
+    return (int)ret_errno(
+        shim_call(SHIM_OP_CONNECT, args, NULL, 0, NULL, NULL, NULL));
+}
+
+int listen(int fd, int backlog) {
+    if (!is_vfd(fd)) return real_listen(fd, backlog);
+    int64_t args[6] = {fd, backlog, 0, 0, 0, 0};
+    return (int)ret_errno(
+        shim_call(SHIM_OP_LISTEN, args, NULL, 0, NULL, NULL, NULL));
+}
+
+int accept4(int fd, struct sockaddr *addr, socklen_t *alen, int flags) {
+    if (!is_vfd(fd)) return real_accept4(fd, addr, alen, flags);
+    int child = reserve_fd();
+    if (child < 0) return -1;
+    int64_t args[6] = {fd, vfd_nonblock[fd], child, 0, 0, 0};
+    int64_t reply[6];
+    int64_t ret = shim_call(SHIM_OP_ACCEPT, args, NULL, 0, NULL, NULL, reply);
     if (ret < 0) {
+        real_close(child);
         errno = (int)-ret;
         return -1;
     }
-    return 0;
+    vfd_register(child, (flags & SOCK_NONBLOCK) != 0);
+    fill_sockaddr(addr, alen, (uint32_t)reply[1], (uint16_t)reply[2]);
+    return child;
 }
 
-ssize_t sendto(int fd, const void *buf, size_t n, int flags,
-               const struct sockaddr *addr, socklen_t len) {
-    if (!is_virtual_fd(fd)) return real_sendto(fd, buf, n, flags, addr, len);
-    uint32_t ip = 0;
-    uint16_t port = 0;
-    if (addr) {
-        if (len < sizeof(struct sockaddr_in) || addr->sa_family != AF_INET) {
-            errno = EINVAL;
-            return -1;
-        }
-        const struct sockaddr_in *sin = (const struct sockaddr_in *)addr;
-        ip = sin->sin_addr.s_addr;
-        port = ntohs(sin->sin_port);
+int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
+    if (!is_vfd(fd)) {
+        static int (*real_accept)(int, struct sockaddr *, socklen_t *);
+        if (!real_accept) real_accept = dlsym(RTLD_NEXT, "accept");
+        return real_accept(fd, addr, alen);
     }
+    return accept4(fd, addr, alen, 0);
+}
+
+static ssize_t vfd_sendto(int fd, const void *buf, size_t n, int flags,
+                          uint32_t ip, uint16_t port) {
     if (n > SHIM_PAYLOAD_MAX) n = SHIM_PAYLOAD_MAX;
-    int64_t args[6] = {fd, (int64_t)ip, port, 0, 0, 0};
-    int64_t ret = shim_call(SHIM_OP_SENDTO, args, buf, (uint32_t)n, NULL,
-                            NULL, NULL);
-    if (ret < 0) {
-        errno = (int)-ret;
-        return -1;
-    }
-    return (ssize_t)ret;
+    int nb = vfd_nonblock[fd] || (flags & MSG_DONTWAIT);
+    int64_t args[6] = {fd, (int64_t)ip, port, nb, 0, 0};
+    return (ssize_t)ret_errno(
+        shim_call(SHIM_OP_SENDTO, args, buf, (uint32_t)n, NULL, NULL, NULL));
 }
 
-ssize_t send(int fd, const void *buf, size_t n, int flags) {
-    if (!is_virtual_fd(fd)) {
-        static ssize_t (*real_send)(int, const void *, size_t, int);
-        if (!real_send) real_send = dlsym(RTLD_NEXT, "send");
-        return real_send(fd, buf, n, flags);
-    }
-    return sendto(fd, buf, n, flags, NULL, 0);
-}
-
-ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
-                 struct sockaddr *addr, socklen_t *alen) {
-    if (!is_virtual_fd(fd)) return real_recvfrom(fd, buf, n, flags, addr, alen);
-    int64_t args[6] = {fd, (int64_t)n, 0, 0, 0, 0};
+static ssize_t vfd_recvfrom(int fd, void *buf, size_t n, int flags,
+                            struct sockaddr *addr, socklen_t *alen) {
+    int nb = vfd_nonblock[fd] || (flags & MSG_DONTWAIT);
+    int64_t args[6] = {fd, (int64_t)n, nb, 0, 0, 0};
     int64_t reply[6];
     uint32_t got = (uint32_t)(n > SHIM_PAYLOAD_MAX ? SHIM_PAYLOAD_MAX : n);
     int64_t ret = shim_call(SHIM_OP_RECVFROM, args, NULL, 0, buf, &got, reply);
@@ -348,54 +494,426 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
         errno = (int)-ret;
         return -1;
     }
-    if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
-        struct sockaddr_in *sin = (struct sockaddr_in *)addr;
-        memset(sin, 0, sizeof(*sin));
-        sin->sin_family = AF_INET;
-        sin->sin_addr.s_addr = (uint32_t)reply[1]; /* BE ip */
-        sin->sin_port = htons((uint16_t)reply[2]);
-        *alen = sizeof(struct sockaddr_in);
-    }
+    fill_sockaddr(addr, alen, (uint32_t)reply[1], (uint16_t)reply[2]);
     return (ssize_t)ret;
 }
 
+ssize_t sendto(int fd, const void *buf, size_t n, int flags,
+               const struct sockaddr *addr, socklen_t len) {
+    if (!is_vfd(fd)) return real_sendto(fd, buf, n, flags, addr, len);
+    uint32_t ip = 0;
+    uint16_t port = 0;
+    if (addr && addr_to_ip_port(addr, len, &ip, &port) != 0) return -1;
+    return vfd_sendto(fd, buf, n, flags, ip, port);
+}
+
+ssize_t send(int fd, const void *buf, size_t n, int flags) {
+    if (!is_vfd(fd)) {
+        static ssize_t (*real_send)(int, const void *, size_t, int);
+        if (!real_send) real_send = dlsym(RTLD_NEXT, "send");
+        return real_send(fd, buf, n, flags);
+    }
+    return vfd_sendto(fd, buf, n, flags, 0, 0);
+}
+
+ssize_t write(int fd, const void *buf, size_t n) {
+    if (!is_vfd(fd)) return real_write(fd, buf, n);
+    return vfd_sendto(fd, buf, n, 0, 0, 0);
+}
+
+ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
+                 struct sockaddr *addr, socklen_t *alen) {
+    if (!is_vfd(fd)) return real_recvfrom(fd, buf, n, flags, addr, alen);
+    return vfd_recvfrom(fd, buf, n, flags, addr, alen);
+}
+
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
-    if (!is_virtual_fd(fd)) {
+    if (!is_vfd(fd)) {
         static ssize_t (*real_recv)(int, void *, size_t, int);
         if (!real_recv) real_recv = dlsym(RTLD_NEXT, "recv");
         return real_recv(fd, buf, n, flags);
     }
-    return recvfrom(fd, buf, n, flags, NULL, NULL);
+    return vfd_recvfrom(fd, buf, n, flags, NULL, NULL);
 }
 
-int getsockname(int fd, struct sockaddr *addr, socklen_t *alen) {
-    if (!is_virtual_fd(fd)) return real_getsockname(fd, addr, alen);
-    int64_t args[6] = {fd, 0, 0, 0, 0, 0};
-    int64_t reply[6];
-    int64_t ret =
-        shim_call(SHIM_OP_GETSOCKNAME, args, NULL, 0, NULL, NULL, reply);
-    if (ret < 0) {
-        errno = (int)-ret;
-        return -1;
-    }
-    if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
-        struct sockaddr_in *sin = (struct sockaddr_in *)addr;
-        memset(sin, 0, sizeof(*sin));
-        sin->sin_family = AF_INET;
-        sin->sin_addr.s_addr = (uint32_t)reply[1];
-        sin->sin_port = htons((uint16_t)reply[2]);
-        *alen = sizeof(struct sockaddr_in);
-    }
-    return 0;
+ssize_t read(int fd, void *buf, size_t n) {
+    if (!is_vfd(fd)) return real_read(fd, buf, n);
+    return vfd_recvfrom(fd, buf, n, 0, NULL, NULL);
+}
+
+int shutdown(int fd, int how) {
+    if (!is_vfd(fd)) return real_shutdown(fd, how);
+    int64_t args[6] = {fd, how, 0, 0, 0, 0};
+    return (int)ret_errno(
+        shim_call(SHIM_OP_SHUTDOWN, args, NULL, 0, NULL, NULL, NULL));
 }
 
 int close(int fd) {
-    if (!is_virtual_fd(fd)) return real_close(fd);
+    if (!is_vfd(fd)) {
+        if (g_ready) epoll_forget_fd(fd); /* fd may be an epfd */
+        return real_close(fd);
+    }
     int64_t args[6] = {fd, 0, 0, 0, 0, 0};
     int64_t ret = shim_call(SHIM_OP_CLOSE, args, NULL, 0, NULL, NULL, NULL);
+    vfd_release(fd);
+    epoll_forget_fd(fd);
+    return (int)ret_errno(ret);
+}
+
+static int name_common(int fd, struct sockaddr *addr, socklen_t *alen,
+                       uint32_t op) {
+    int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+    int64_t reply[6];
+    int64_t ret = shim_call(op, args, NULL, 0, NULL, NULL, reply);
     if (ret < 0) {
         errno = (int)-ret;
         return -1;
     }
+    fill_sockaddr(addr, alen, (uint32_t)reply[1], (uint16_t)reply[2]);
     return 0;
+}
+
+int getsockname(int fd, struct sockaddr *addr, socklen_t *alen) {
+    if (!is_vfd(fd)) return real_getsockname(fd, addr, alen);
+    return name_common(fd, addr, alen, SHIM_OP_GETSOCKNAME);
+}
+
+int getpeername(int fd, struct sockaddr *addr, socklen_t *alen) {
+    if (!is_vfd(fd)) return real_getpeername(fd, addr, alen);
+    return name_common(fd, addr, alen, SHIM_OP_GETPEERNAME);
+}
+
+int setsockopt(int fd, int level, int optname, const void *optval,
+               socklen_t optlen) {
+    if (!is_vfd(fd)) return real_setsockopt(fd, level, optname, optval, optlen);
+    (void)level;
+    (void)optname;
+    (void)optval;
+    (void)optlen;
+    return 0; /* accept and ignore: buffers/REUSEADDR/NODELAY are simulated */
+}
+
+int getsockopt(int fd, int level, int optname, void *optval, socklen_t *optlen) {
+    if (!is_vfd(fd)) return real_getsockopt(fd, level, optname, optval, optlen);
+    if (level == SOL_SOCKET && optname == SO_ERROR) {
+        int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+        int64_t reply[6];
+        int64_t ret =
+            shim_call(SHIM_OP_SOCKERR, args, NULL, 0, NULL, NULL, reply);
+        if (ret < 0) {
+            errno = (int)-ret;
+            return -1;
+        }
+        if (optval && optlen && *optlen >= sizeof(int)) {
+            *(int *)optval = (int)reply[1];
+            *optlen = sizeof(int);
+        }
+        return 0;
+    }
+    if (optval && optlen && *optlen >= sizeof(int)) {
+        *(int *)optval = 0;
+        *optlen = sizeof(int);
+    }
+    return 0;
+}
+
+int fcntl(int fd, int cmd, ...) {
+    va_list ap;
+    va_start(ap, cmd);
+    void *arg = va_arg(ap, void *);
+    va_end(ap);
+    if (!is_vfd(fd)) return real_fcntl(fd, cmd, arg);
+    switch (cmd) {
+        case F_GETFL:
+            return O_RDWR | (vfd_nonblock[fd] ? O_NONBLOCK : 0);
+        case F_SETFL:
+            vfd_nonblock[fd] = (((intptr_t)arg) & O_NONBLOCK) != 0;
+            return 0;
+        case F_GETFD:
+            return 0;
+        case F_SETFD:
+            return 0;
+        default:
+            errno = EINVAL;
+            return -1;
+    }
+}
+
+int ioctl(int fd, unsigned long req, ...) {
+    va_list ap;
+    va_start(ap, req);
+    void *arg = va_arg(ap, void *);
+    va_end(ap);
+    if (!is_vfd(fd)) return real_ioctl(fd, req, arg);
+    if (req == FIONBIO) {
+        vfd_nonblock[fd] = arg && *(int *)arg != 0;
+        return 0;
+    }
+    errno = EINVAL;
+    return -1;
+}
+
+/* ----------------------------------------------------------- readiness */
+
+/* One manager round-trip evaluating readiness of simulated fds; parks the
+ * plugin until an fd is ready or the (simulated) timeout elapses. */
+static int shim_poll_call(shim_pollfd *entries, int n, int64_t timeout_ns,
+                          uint32_t *revents_out) {
+    int64_t args[6] = {n, timeout_ns, 0, 0, 0, 0};
+    uint32_t in_len = (uint32_t)(n * sizeof(uint32_t));
+    int64_t ret = shim_call(SHIM_OP_POLL, args, entries,
+                            (uint32_t)(n * sizeof(shim_pollfd)), revents_out,
+                            &in_len, NULL);
+    return (int)ret_errno(ret);
+}
+
+static int poll_ns(struct pollfd *fds, nfds_t nfds, int64_t timeout_ns) {
+    if (!real_socket) resolve_reals();
+    int any_virtual = 0, any_real = 0;
+    for (nfds_t i = 0; i < nfds; i++) {
+        if (is_vfd(fds[i].fd))
+            any_virtual = 1;
+        else
+            any_real = 1;
+    }
+    if (!any_virtual) {
+        int timeout_ms =
+            timeout_ns < 0 ? -1 : (int)((timeout_ns + 999999) / 1000000);
+        return real_poll(fds, nfds, timeout_ms);
+    }
+    if (any_real) {
+        static int warned;
+        if (!warned++)
+            shim_warn("poll() mixing real and simulated fds: real fds "
+                      "report no events");
+    }
+    if (nfds > 1024) {
+        errno = EINVAL;
+        return -1;
+    }
+    shim_pollfd entries[1024];
+    uint32_t revents[1024];
+    int n = 0;
+    for (nfds_t i = 0; i < nfds; i++) {
+        fds[i].revents = 0;
+        if (!is_vfd(fds[i].fd)) continue;
+        entries[n].fd = fds[i].fd;
+        entries[n].events = (uint32_t)fds[i].events;
+        n++;
+    }
+    int ready = shim_poll_call(entries, n, timeout_ns, revents);
+    if (ready < 0) return -1;
+    int j = 0, total = 0;
+    for (nfds_t i = 0; i < nfds; i++) {
+        if (!is_vfd(fds[i].fd)) continue;
+        fds[i].revents = (short)revents[j++];
+        if (fds[i].revents) total++;
+    }
+    return total;
+}
+
+int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+    if (!real_socket) resolve_reals();
+    if (!g_ready) return real_poll(fds, nfds, timeout);
+    return poll_ns(fds, nfds,
+                   timeout < 0 ? -1 : (int64_t)timeout * 1000000ll);
+}
+
+int ppoll(struct pollfd *fds, nfds_t nfds, const struct timespec *ts,
+          const sigset_t *mask) {
+    (void)mask;
+    if (!g_ready) {
+        static int (*rp)(struct pollfd *, nfds_t, const struct timespec *,
+                         const sigset_t *);
+        if (!rp) rp = dlsym(RTLD_NEXT, "ppoll");
+        return rp(fds, nfds, ts, mask);
+    }
+    /* full ns precision: a 0.5 ms wait must advance simulated time, not
+     * degrade into a same-instant spin */
+    int64_t timeout_ns =
+        ts ? (int64_t)ts->tv_sec * 1000000000ll + ts->tv_nsec : -1;
+    return poll_ns(fds, nfds, timeout_ns);
+}
+
+int select(int nfds, fd_set *rd, fd_set *wr, fd_set *ex, struct timeval *tv) {
+    if (!real_socket) resolve_reals();
+    if (!g_ready) return real_select(nfds, rd, wr, ex, tv);
+    int any_virtual = 0, any_real = 0;
+    for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++) {
+        int in_any = (rd && FD_ISSET(fd, rd)) || (wr && FD_ISSET(fd, wr)) ||
+                     (ex && FD_ISSET(fd, ex));
+        if (!in_any) continue;
+        if (is_vfd(fd))
+            any_virtual = 1;
+        else
+            any_real = 1;
+    }
+    if (!any_virtual) return real_select(nfds, rd, wr, ex, tv);
+    if (any_real) {
+        static int warned;
+        if (!warned++)
+            shim_warn("select() mixing real and simulated fds: real fds "
+                      "report no events");
+    }
+    shim_pollfd entries[1024];
+    uint32_t revents[1024];
+    int n = 0;
+    for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++) {
+        if (!is_vfd(fd)) continue;
+        if (n >= 1024) {
+            errno = EINVAL;
+            return -1;
+        }
+        uint32_t ev = 0;
+        if (rd && FD_ISSET(fd, rd)) ev |= SHIM_POLLIN;
+        if (wr && FD_ISSET(fd, wr)) ev |= SHIM_POLLOUT;
+        if (ex && FD_ISSET(fd, ex)) ev |= SHIM_POLLERR;
+        if (!ev) continue;
+        entries[n].fd = fd;
+        entries[n].events = ev;
+        n++;
+    }
+    int64_t timeout_ns =
+        tv ? (int64_t)tv->tv_sec * 1000000000ll + (int64_t)tv->tv_usec * 1000ll
+           : -1;
+    int ready = shim_poll_call(entries, n, timeout_ns, revents);
+    if (ready < 0) return -1;
+    if (rd) FD_ZERO(rd);
+    if (wr) FD_ZERO(wr);
+    if (ex) FD_ZERO(ex);
+    int total = 0;
+    for (int i = 0; i < n; i++) {
+        uint32_t rev = revents[i];
+        int fd = entries[i].fd;
+        /* select semantics: error conditions mark the fd readable+writable */
+        if (rd && (rev & (SHIM_POLLIN | SHIM_POLLERR | SHIM_POLLHUP)) &&
+            (entries[i].events & SHIM_POLLIN)) {
+            FD_SET(fd, rd);
+            total++;
+        }
+        if (wr && (rev & (SHIM_POLLOUT | SHIM_POLLERR)) &&
+            (entries[i].events & SHIM_POLLOUT)) {
+            FD_SET(fd, wr);
+            total++;
+        }
+        if (ex && (rev & SHIM_POLLERR) && (entries[i].events & SHIM_POLLERR)) {
+            FD_SET(fd, ex);
+            total++;
+        }
+    }
+    return total;
+}
+
+/* ------------------------------------------------------------- epoll */
+
+int epoll_ctl(int epfd, int op, int fd, struct epoll_event *event) {
+    if (!real_socket) resolve_reals();
+    if (!g_ready || !is_vfd(fd)) {
+        if (g_ready && op == EPOLL_CTL_ADD && epfd >= 0 && epfd < SHIM_MAX_FDS)
+            epoll_has_real[epfd] = 1;
+        return real_epoll_ctl(epfd, op, fd, event);
+    }
+    if (epfd < 0 || epfd >= SHIM_MAX_FDS) {
+        errno = EBADF;
+        return -1;
+    }
+    if (!epoll_regs[epfd]) {
+        epoll_regs[epfd] = calloc(EPOLL_MAX_REGS, sizeof(epoll_reg));
+        if (!epoll_regs[epfd]) {
+            errno = ENOMEM;
+            return -1;
+        }
+    }
+    epoll_reg *regs = epoll_regs[epfd];
+    int n = epoll_nregs[epfd];
+    int idx = -1;
+    for (int i = 0; i < n; i++)
+        if (regs[i].fd == fd) idx = i;
+    switch (op) {
+        case EPOLL_CTL_ADD:
+            if (idx >= 0) {
+                errno = EEXIST;
+                return -1;
+            }
+            if (n >= EPOLL_MAX_REGS) {
+                errno = ENOSPC;
+                return -1;
+            }
+            regs[n].fd = fd;
+            regs[n].events = event->events;
+            regs[n].data = event->data.u64;
+            epoll_nregs[epfd] = n + 1;
+            return 0;
+        case EPOLL_CTL_MOD:
+            if (idx < 0) {
+                errno = ENOENT;
+                return -1;
+            }
+            regs[idx].events = event->events;
+            regs[idx].data = event->data.u64;
+            return 0;
+        case EPOLL_CTL_DEL:
+            if (idx < 0) {
+                errno = ENOENT;
+                return -1;
+            }
+            regs[idx] = regs[n - 1];
+            epoll_nregs[epfd] = n - 1;
+            return 0;
+        default:
+            errno = EINVAL;
+            return -1;
+    }
+}
+
+int epoll_wait(int epfd, struct epoll_event *events, int maxevents,
+               int timeout) {
+    if (!real_socket) resolve_reals();
+    if (!g_ready) return real_epoll_wait(epfd, events, maxevents, timeout);
+    int n = (epfd >= 0 && epfd < SHIM_MAX_FDS) ? epoll_nregs[epfd] : 0;
+    if (n == 0) return real_epoll_wait(epfd, events, maxevents, timeout);
+    if (epoll_has_real[epfd]) {
+        static int warned;
+        if (!warned++)
+            shim_warn("epoll mixing real and simulated fds: real fds "
+                      "report no events");
+    }
+    epoll_reg *regs = epoll_regs[epfd];
+    static shim_pollfd entries[EPOLL_MAX_REGS]; /* too big for the stack */
+    static uint32_t revents[EPOLL_MAX_REGS];
+    for (int i = 0; i < n; i++) {
+        entries[i].fd = regs[i].fd;
+        uint32_t ev = 0;
+        if (regs[i].events & EPOLLIN) ev |= SHIM_POLLIN;
+        if (regs[i].events & EPOLLOUT) ev |= SHIM_POLLOUT;
+        entries[i].events = ev;
+    }
+    int64_t timeout_ns = timeout < 0 ? -1 : (int64_t)timeout * 1000000ll;
+    int ready = shim_poll_call(entries, n, timeout_ns, revents);
+    if (ready < 0) return -1;
+    int out = 0;
+    for (int i = 0; i < n && out < maxevents; i++) {
+        if (!revents[i]) continue;
+        uint32_t ev = 0;
+        if (revents[i] & SHIM_POLLIN) ev |= EPOLLIN;
+        if (revents[i] & SHIM_POLLOUT) ev |= EPOLLOUT;
+        if (revents[i] & SHIM_POLLERR) ev |= EPOLLERR;
+        if (revents[i] & SHIM_POLLHUP) ev |= EPOLLHUP;
+        events[out].events = ev;
+        events[out].data.u64 = regs[i].data;
+        out++;
+    }
+    return out;
+}
+
+int epoll_pwait(int epfd, struct epoll_event *events, int maxevents,
+                int timeout, const sigset_t *mask) {
+    (void)mask;
+    if (!g_ready) {
+        static int (*rp)(int, struct epoll_event *, int, int,
+                         const sigset_t *);
+        if (!rp) rp = dlsym(RTLD_NEXT, "epoll_pwait");
+        return rp(epfd, events, maxevents, timeout, mask);
+    }
+    return epoll_wait(epfd, events, maxevents, timeout);
 }

@@ -11,7 +11,7 @@ import ctypes.util
 import mmap
 import time
 
-SHIM_ABI_MAGIC = 0x53485457534D4831
+SHIM_ABI_MAGIC = 0x53485457534D4832
 SHIM_PAYLOAD_MAX = 65536
 
 # ops
@@ -25,13 +25,26 @@ OP_RECVFROM = 7
 OP_CLOSE = 8
 OP_CONNECT = 9
 OP_GETSOCKNAME = 10
+OP_LISTEN = 11
+OP_ACCEPT = 12
+OP_SHUTDOWN = 13
+OP_GETPEERNAME = 14
+OP_SOCKERR = 15
+OP_POLL = 16
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
     6: "sendto", 7: "recvfrom", 8: "close", 9: "connect", 10: "getsockname",
+    11: "listen", 12: "accept", 13: "shutdown", 14: "getpeername",
+    15: "sockerr", 16: "poll",
 }
 
-SHIM_FD_BASE = 10000
+# poll bits (mirror Linux poll.h, shared with shim_pollfd)
+POLLIN = 0x0001
+POLLOUT = 0x0004
+POLLERR = 0x0008
+POLLHUP = 0x0010
+POLLNVAL = 0x0020
 
 
 class ShimMsg(ctypes.Structure):

@@ -7,6 +7,16 @@ into the discrete-event simulation — the plugin only runs while the
 simulation has handed it the turn, time only advances at event boundaries,
 and all of its network I/O flows through the simulated packet path.
 
+Sockets cover UDP datagrams and TCP streams: UDP rides the host-level port
+table (the NetworkInterface association analog, interface.rs:118-163), TCP
+rides the host's simulated stack (net/stack.py over transport/tcp.py), so a
+real binary's connect/accept/send/recv exercise the same handshake,
+congestion control, and loss recovery as the built-in models.  Readiness
+(poll/select/epoll in the shim, SHIM_OP_POLL here) is evaluated against
+simulated transport state; blocking calls park the plugin until a
+simulation event completes them — the SyscallReturn::Block + condition
+discipline of the reference (handler/mod.rs, syscall/condition.rs).
+
 A ManagedApp is a normal engine app model (on_start/on_timer/on_delivery),
 so managed processes and built-in models coexist on the same simulated
 network.  CPU backend only: the lane backend rejects them via
@@ -26,12 +36,21 @@ from typing import Optional
 
 from ..core import time as stime
 from ..models.base import HostApi
+from ..transport.tcp import PollState
 from . import abi
 
 log = logging.getLogger("shadow_tpu.native")
 
 UDP_HEADER_BYTES = 28  # IP (20) + UDP (8): wire size = payload + header
 EPHEMERAL_PORT_START = 49152
+
+# errno values the manager hands back over the channel (Linux numbers via
+# the stdlib so the table can't drift)
+from errno import (  # noqa: E402
+    EADDRINUSE, EAGAIN, EALREADY, EBADF, ECONNREFUSED, ECONNRESET,
+    EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINVAL, EISCONN, ENOSYS,
+    ENOTCONN, EPIPE, ETIMEDOUT,
+)
 
 
 def default_shim_path() -> Path:
@@ -67,15 +86,21 @@ def require_dynamic_elf(path: str) -> None:
 
 
 class _VSocket:
-    """One virtual UDP socket of a managed process."""
+    """One virtual socket of a managed process (fd number chosen by the
+    shim — a reserved real kernel fd, so it can't collide in the plugin)."""
 
-    __slots__ = ("vfd", "port", "default_dst", "queue")
+    __slots__ = ("vfd", "kind", "port", "default_dst", "queue", "sim",
+                 "listener", "accept_q")
 
-    def __init__(self, vfd: int) -> None:
+    def __init__(self, vfd: int, kind: str) -> None:
         self.vfd = vfd
+        self.kind = kind  # "udp" | "tcp" | "listen"
         self.port: Optional[int] = None
         self.default_dst: Optional[tuple[int, int]] = None  # (ip_be, port)
-        self.queue: list[tuple[int, int, bytes]] = []  # (src_ip_be, src_port, data)
+        self.queue: list[tuple[int, int, bytes]] = []  # udp: (src_ip_be, src_port, data)
+        self.sim = None  # SimTcpSocket (tcp)
+        self.listener = None  # SimTcpListener (listen)
+        self.accept_q: list = []  # SimTcpSockets awaiting accept()
 
 
 class ManagedApp:
@@ -87,13 +112,16 @@ class ManagedApp:
         self.proc: Optional[subprocess.Popen] = None
         self.chan: Optional[abi.ShmChannel] = None
         self.sockets: dict[int, _VSocket] = {}
-        self._next_vfd = abi.SHIM_FD_BASE
-        self._sleeping = False
-        # (vfd, caller buffer length) while parked in recvfrom
-        self._recv_blocked: Optional[tuple[int, int]] = None
+        # one parked call at a time (the protocol strictly alternates):
+        # ("sleep", deadline) | ("recvfrom", vfd, max_len) | ("recv", vfd, n)
+        # | ("send", vfd, data) | ("connect", vfd) | ("accept", vfd, child_fd)
+        # | ("poll", entries, deadline|None)
+        self._blocked: Optional[tuple] = None
         self.finished = False
         self.exit_code: Optional[int] = None
         self._stdout_file = None
+        self._strace_file = None
+        self._strace_mode = "off"
         self._api = None  # host handle, set at on_start (needed for teardown)
 
     # -- host-level port namespace (shared across sibling processes) -------
@@ -127,6 +155,9 @@ class ManagedApp:
         shm_path = host_dir / f"{stem}.shm"
         self.chan = abi.ShmChannel(str(shm_path), seed=self._proc_seed(api))
         self.chan.set_clock(stime.sim_to_emu(api.now))
+        self._strace_mode = self._cfg_strace_mode(api)
+        if self._strace_mode != "off":
+            self._strace_file = open(host_dir / f"{stem}.strace", "w")
 
         env = dict(os.environ)
         env.update(self.environment)
@@ -143,6 +174,7 @@ class ManagedApp:
         hosts_file = getattr(api, "hosts_file_path", None)
         if hosts_file is not None:
             env["SHADOW_TPU_HOSTS_FILE"] = str(hosts_file)
+        env["SHADOW_TPU_HOSTNAME"] = api.hostname
         self._stdout_file = open(host_dir / f"{stem}.stdout", "wb")
         self.proc = subprocess.Popen(
             self.argv,
@@ -156,16 +188,28 @@ class ManagedApp:
         self._service(api)
 
     def on_timer(self, api: HostApi, t: int) -> None:
-        if self.finished or not self._sleeping:
+        pass  # deadlines ride schedule_at closures, not the model timer
+
+    def _deadline_fired(self, api, deadline: int) -> None:
+        if self.finished or self._blocked is None:
             return
-        self._sleeping = False
-        self._resume(api)
-        self._service(api)
+        kind = self._blocked[0]
+        if kind == "sleep" and self._blocked[1] == deadline:
+            self._blocked = None
+            self._reply(api, "nanosleep", 0)
+            self._service(api)
+        elif kind == "poll" and self._blocked[2] == deadline:
+            entries = self._blocked[1]
+            self._blocked = None
+            self._reply_poll(api, entries)  # whatever is ready now (maybe 0)
+            self._service(api)
 
     def on_delivery(
         self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None
     ) -> None:
-        if payload is None:
+        """A UDP datagram arrived on the host (TCP segments go to the host
+        stack directly and surface through socket callbacks instead)."""
+        if payload is None or not isinstance(payload, tuple) or len(payload) != 3:
             return
         src_port, dst_port, data = payload
         owner = self._host_ports(api).get(dst_port)
@@ -180,33 +224,35 @@ class ManagedApp:
         src_ip_be = _ip_to_be(api.ip_of(src))
         self.sockets[vfd].queue.append((src_ip_be, src_port, data))
         api.count("udp_rx_bytes", len(data))
-        if self._recv_blocked is not None and self._recv_blocked[0] == vfd:
-            _, max_len = self._recv_blocked
-            self._recv_blocked = None
-            self._reply_recv(api, vfd, max_len)
-            self._service(api)
+        self._socket_activity(api, vfd)
 
     # -- channel servicing -------------------------------------------------
 
     def _alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
 
-    def _resume(self, api: HostApi) -> None:
-        """Hand the turn back to the plugin at the current sim time."""
+    def _reply(self, api: HostApi, opname: str, ret: int, args=None,
+               payload: bytes = b"") -> None:
+        """Send a reply (advancing the plugin's clock to sim-now) and write
+        the strace line — the single exit point of every serviced call."""
         self.chan.set_clock(stime.sim_to_emu(api.now))
-        self.chan.reply(0)
+        self.chan.reply(ret, args=args, payload=payload)
+        if self._strace_file is not None:
+            self._trace_line(api, opname, ret)
 
-    def _reply_recv(self, api: HostApi, vfd: int, max_len: int) -> None:
-        src_ip_be, src_port, data = self.sockets[vfd].queue.pop(0)
-        # UDP truncation semantics: excess bytes of the datagram are
-        # discarded and the caller sees the truncated length
-        data = data[: max(max_len, 0)]
-        self.chan.set_clock(stime.sim_to_emu(api.now))
-        self.chan.reply(len(data), args=[0, src_ip_be, src_port], payload=data)
+    def _trace_line(self, api, opname: str, ret: int) -> None:
+        err = f" {_errno_name(-ret)}" if ret < 0 else ""
+        if self._strace_mode == "deterministic":
+            self._strace_file.write(f"{opname} = {ret}{err}\n")
+        else:
+            self._strace_file.write(
+                f"[{stime.fmt(api.now)}] {opname} = {ret}{err}\n"
+            )
 
     def _service(self, api: HostApi) -> None:
-        """Run the plugin until it blocks (sleep/recv) or exits — the analog
-        of ManagedThread::resume's event loop (managed_thread.rs:187-325)."""
+        """Run the plugin until it blocks (sleep/recv/accept/poll/...) or
+        exits — the analog of ManagedThread::resume's event loop
+        (managed_thread.rs:187-325)."""
         while True:
             try:
                 self.chan.wait_recv(self._alive)
@@ -216,152 +262,573 @@ class ManagedApp:
             req = self.chan.req
             op = req.op
             if op == abi.OP_START:
-                self._resume(api)
+                self._reply(api, "start", 0)
             elif op == abi.OP_EXIT:
                 self._finish(api, unexpected=False)
                 return
             elif op == abi.OP_NANOSLEEP:
                 ns = req.args[0]
                 if ns <= 0:
-                    self._resume(api)
+                    self._reply(api, "nanosleep", 0)
                 else:
-                    self._sleeping = True
-                    api.set_timer(api.now + ns)
-                    return  # plugin stays parked until the timer fires
+                    deadline = api.now + ns
+                    self._park(api, ("sleep", deadline), deadline)
+                    return
             elif op == abi.OP_SOCKET:
-                vfd = self._next_vfd
-                self._next_vfd += 1
-                self.sockets[vfd] = _VSocket(vfd)
-                self.chan.reply(vfd)
+                self._op_socket(api, req)
             elif op == abi.OP_BIND:
                 self._op_bind(api, req)
             elif op == abi.OP_CONNECT:
-                self._op_connect(api, req)
+                if not self._op_connect(api, req):
+                    return  # parked
+            elif op == abi.OP_LISTEN:
+                self._op_listen(api, req)
+            elif op == abi.OP_ACCEPT:
+                if not self._op_accept(api, req):
+                    return
             elif op == abi.OP_SENDTO:
-                self._op_sendto(api, req)
+                if not self._op_sendto(api, req):
+                    return
             elif op == abi.OP_RECVFROM:
-                vfd = req.args[0]
-                max_len = int(req.args[1])
-                sock = self.sockets.get(vfd)
-                if sock is None:
-                    self.chan.reply(-9)  # EBADF
-                elif sock.queue:
-                    self._reply_recv(api, vfd, max_len)
-                else:
-                    self._recv_blocked = (vfd, max_len)
-                    return  # parked until a delivery arrives
+                if not self._op_recvfrom(api, req):
+                    return
+            elif op == abi.OP_POLL:
+                if not self._op_poll(api, req):
+                    return
+            elif op == abi.OP_SHUTDOWN:
+                self._op_shutdown(api, req)
             elif op == abi.OP_GETSOCKNAME:
                 self._op_getsockname(api, req)
+            elif op == abi.OP_GETPEERNAME:
+                self._op_getpeername(api, req)
+            elif op == abi.OP_SOCKERR:
+                self._op_sockerr(api, req)
             elif op == abi.OP_CLOSE:
-                vfd = req.args[0]
-                sock = self.sockets.pop(vfd, None)
-                if sock is not None and sock.port is not None:
-                    self._host_ports(api).pop(sock.port, None)
-                self.chan.reply(0 if sock else -9)
+                self._op_close(api, req)
             else:
                 log.warning("unknown shim op %d from %s", op, self.argv[0])
-                self.chan.reply(-38)  # ENOSYS
+                self._reply(api, f"op{op}", -ENOSYS)
 
-    # -- ops ---------------------------------------------------------------
+    def _park(self, api: HostApi, blocked: tuple, deadline: Optional[int]) -> None:
+        """Leave the plugin waiting on its channel; a simulation event (or
+        the deadline) completes the call later."""
+        self._blocked = blocked
+        if deadline is not None:
+            api.schedule_at(
+                max(deadline, api.now + 1),
+                lambda h, d=deadline: self._deadline_fired(h, d),
+            )
+
+    # -- socket ops --------------------------------------------------------
+
+    SOCK_STREAM = 1
+    SOCK_DGRAM = 2
+
+    def _op_socket(self, api: HostApi, req) -> None:
+        base_type, vfd = int(req.args[1]), int(req.args[2])
+        kind = "tcp" if base_type == self.SOCK_STREAM else "udp"
+        self.sockets[vfd] = _VSocket(vfd, kind)
+        self._reply(api, f"socket[{kind}]", 0)
 
     def _op_bind(self, api: HostApi, req) -> None:
         vfd, port = req.args[0], int(req.args[1])
         sock = self.sockets.get(vfd)
         if sock is None:
-            self.chan.reply(-9)
+            self._reply(api, "bind", -EBADF)
             return
-        ports = self._host_ports(api)
-        if port == 0:
-            port = self._alloc_port(api)
-        elif port in ports:
-            self.chan.reply(-98)  # EADDRINUSE
+        if sock.kind == "udp":
+            ports = self._host_ports(api)
+            if port == 0:
+                port = self._alloc_port(api)
+            elif port in ports:
+                self._reply(api, "bind", -EADDRINUSE)
+                return
+            sock.port = port
+            ports[port] = (self, vfd)
+        else:
+            if port in api.net.tcp_listeners:
+                self._reply(api, "bind", -EADDRINUSE)
+                return
+            sock.port = port or None
+        self._reply(api, "bind", 0)
+
+    def _op_listen(self, api: HostApi, req) -> None:
+        vfd, backlog = req.args[0], int(req.args[1])
+        sock = self.sockets.get(vfd)
+        if sock is None or sock.kind == "udp":
+            self._reply(api, "listen", -EBADF if sock is None else -EINVAL)
             return
+        if sock.kind == "listen":
+            self._reply(api, "listen", 0)  # already listening
+            return
+        port = sock.port or api.net._alloc_port()
+        try:
+            lst = api.net.listen(port, backlog=max(backlog, 1))
+        except OSError:
+            self._reply(api, "listen", -EADDRINUSE)
+            return
+        sock.kind = "listen"
         sock.port = port
-        ports[port] = (self, vfd)
-        self.chan.reply(0)
+        sock.listener = lst
+        lst.on_accept = lambda child, now, v=vfd: self._tcp_accept(api, v, child)
+        self._reply(api, "listen", 0)
 
-    def _op_connect(self, api: HostApi, req) -> None:
+    def _op_connect(self, api: HostApi, req) -> bool:
         vfd = req.args[0]
         sock = self.sockets.get(vfd)
         if sock is None:
-            self.chan.reply(-9)
-            return
-        sock.default_dst = (int(req.args[1]) & 0xFFFFFFFF, int(req.args[2]))
-        self.chan.reply(0)
+            self._reply(api, "connect", -EBADF)
+            return True
+        ip_be = int(req.args[1]) & 0xFFFFFFFF
+        port = int(req.args[2])
+        nonblock = bool(req.args[3])
+        if sock.kind == "udp":
+            sock.default_dst = (ip_be, port)
+            self._reply(api, "connect", 0)
+            return True
+        if sock.sim is not None:  # repeated connect on the same socket
+            ps = sock.sim.poll()
+            if ps & PollState.ERROR:
+                ret = -(_tcp_errno(sock.sim.tcp) or ECONNREFUSED)
+            elif ps & PollState.WRITABLE:
+                ret = -EISCONN
+            else:
+                ret = -EALREADY
+            self._reply(api, "connect", ret)
+            return True
+        dst = api.net._host_for_ip(_shim_ip_to_u32be(ip_be))
+        if dst is None:
+            self._reply(api, "connect", -EHOSTUNREACH)
+            return True
+        sock.sim = api.net.connect(dst, port, src_port=sock.port)
+        sock.sim.on_event = lambda s, now, v=vfd: self._tcp_event(api, v)
+        api.count("managed_tcp_connects")
+        if nonblock:
+            self._reply(api, "connect", -EINPROGRESS)
+            return True
+        self._park(api, ("connect", vfd), None)
+        return False
 
-    def _op_getsockname(self, api: HostApi, req) -> None:
-        sock = self.sockets.get(req.args[0])
-        if sock is None:
-            self.chan.reply(-9)
-            return
-        ip_be = _ip_to_be(api.ip_of(api.host_id))
-        self.chan.reply(0, args=[0, ip_be, sock.port or 0])
+    def _op_accept(self, api: HostApi, req) -> bool:
+        vfd = req.args[0]
+        nonblock = bool(req.args[1])
+        child_fd = int(req.args[2])
+        sock = self.sockets.get(vfd)
+        if sock is None or sock.kind != "listen":
+            self._reply(api, "accept", -EBADF if sock is None else -EINVAL)
+            return True
+        if sock.accept_q:
+            self._complete_accept(api, vfd, child_fd)
+            return True
+        if nonblock:
+            self._reply(api, "accept", -EAGAIN)
+            return True
+        self._park(api, ("accept", vfd, child_fd), None)
+        return False
 
-    def _op_sendto(self, api: HostApi, req) -> None:
+    def _complete_accept(self, api: HostApi, vfd: int, child_fd: int) -> None:
+        sock = self.sockets[vfd]
+        child_sim = sock.accept_q.pop(0)
+        child = _VSocket(child_fd, "tcp")
+        child.sim = child_sim
+        child.port = child_sim.tcp.local_port
+        self.sockets[child_fd] = child
+        child_sim.on_event = lambda s, now, v=child_fd: self._tcp_event(api, v)
+        peer_ip = _u32be_to_shim_ip(child_sim.tcp.remote_ip)
+        api.count("managed_tcp_accepts")
+        self._reply(api, "accept", child_fd,
+                    args=[0, peer_ip, child_sim.tcp.remote_port])
+
+    def _op_sendto(self, api: HostApi, req) -> bool:
         vfd = req.args[0]
         sock = self.sockets.get(vfd)
         if sock is None:
-            self.chan.reply(-9)
-            return
+            self._reply(api, "sendto", -EBADF)
+            return True
+        data = self.chan.req_payload()
+        if sock.kind == "udp":
+            self._udp_send(api, sock, req, data)
+            return True
+        if sock.kind == "listen" or sock.sim is None:
+            self._reply(api, "sendto", -ENOTCONN)
+            return True
+        nonblock = bool(req.args[3])
+        return self._stream_send(api, vfd, data, nonblock)
+
+    def _stream_send(self, api: HostApi, vfd: int, data: bytes,
+                     nonblock: bool) -> bool:
+        sock = self.sockets[vfd]
+        if not data:  # POSIX: zero-length stream send returns 0 immediately
+            self._reply(api, "send", 0)
+            return True
+        ps = sock.sim.poll()
+        if ps & PollState.ERROR:
+            self._reply(api, "send", -(_tcp_errno(sock.sim.tcp) or ECONNRESET))
+            return True
+        if ps & PollState.SEND_CLOSED:
+            self._reply(api, "send", -EPIPE)
+            return True
+        n = sock.sim.send(data)
+        if n > 0:
+            api.count("managed_tcp_tx_bytes", n)
+            self._reply(api, "send", n)
+            return True
+        if nonblock:
+            self._reply(api, "send", -EAGAIN)
+            return True
+        self._park(api, ("send", vfd, data), None)
+        return False
+
+    def _udp_send(self, api: HostApi, sock: _VSocket, req, data: bytes) -> None:
         ip_be = int(req.args[1]) & 0xFFFFFFFF
         port = int(req.args[2])
         if ip_be == 0 and port == 0:
             if sock.default_dst is None:
-                self.chan.reply(-89)  # EDESTADDRREQ
+                self._reply(api, "sendto", -EDESTADDRREQ)
                 return
             ip_be, port = sock.default_dst
-        data = self.chan.req_payload()
         dst = api.resolve(_be_to_ip(ip_be))
         if sock.port is None:  # auto-bind an ephemeral source port
             sock.port = self._alloc_port(api)
-            self._host_ports(api)[sock.port] = (self, vfd)
+            self._host_ports(api)[sock.port] = (self, sock.vfd)
         api.send(dst, len(data) + UDP_HEADER_BYTES, payload=(sock.port, port, data))
         api.count("udp_tx_bytes", len(data))
-        self.chan.reply(len(data))
+        self._reply(api, "sendto", len(data))
+
+    def _op_recvfrom(self, api: HostApi, req) -> bool:
+        vfd = req.args[0]
+        # the channel can carry at most SHIM_PAYLOAD_MAX bytes per reply; a
+        # larger ret than payload would make the caller read garbage, so the
+        # stream consumes at most one payload per call (the caller loops)
+        max_len = min(int(req.args[1]), abi.SHIM_PAYLOAD_MAX)
+        nonblock = bool(req.args[2])
+        sock = self.sockets.get(vfd)
+        if sock is None:
+            self._reply(api, "recvfrom", -EBADF)
+            return True
+        if sock.kind == "udp":
+            if sock.queue:
+                self._reply_udp_recv(api, vfd, max_len)
+                return True
+            if nonblock:
+                self._reply(api, "recvfrom", -EAGAIN)
+                return True
+            self._park(api, ("recvfrom", vfd, max_len), None)
+            return False
+        if sock.kind == "listen" or sock.sim is None:
+            self._reply(api, "recvfrom", -ENOTCONN)
+            return True
+        return self._stream_recv(api, vfd, max_len, nonblock)
+
+    def _stream_recv(self, api: HostApi, vfd: int, max_len: int,
+                     nonblock: bool) -> bool:
+        sock = self.sockets[vfd]
+        if max_len <= 0:  # POSIX: zero-length stream recv returns 0
+            self._reply(api, "recv", 0)
+            return True
+        data = sock.sim.recv(max_len)
+        if data:
+            api.count("managed_tcp_rx_bytes", len(data))
+            peer_ip = _u32be_to_shim_ip(sock.sim.tcp.remote_ip)
+            self._reply(api, "recv", len(data),
+                        args=[0, peer_ip, sock.sim.tcp.remote_port],
+                        payload=data)
+            return True
+        ps = sock.sim.poll()
+        if ps & PollState.ERROR:
+            self._reply(api, "recv", -(_tcp_errno(sock.sim.tcp) or ECONNRESET))
+            return True
+        if sock.sim.tcp.at_eof() or ps & PollState.RECV_CLOSED:
+            self._reply(api, "recv", 0)  # orderly EOF
+            return True
+        if nonblock:
+            self._reply(api, "recv", -EAGAIN)
+            return True
+        self._park(api, ("recv", vfd, max_len), None)
+        return False
+
+    def _reply_udp_recv(self, api: HostApi, vfd: int, max_len: int) -> None:
+        src_ip_be, src_port, data = self.sockets[vfd].queue.pop(0)
+        # UDP truncation semantics: excess bytes of the datagram are
+        # discarded and the caller sees the truncated length
+        data = data[: max(max_len, 0)]
+        self._reply(api, "recvfrom", len(data),
+                    args=[0, src_ip_be, src_port], payload=data)
+
+    def _op_shutdown(self, api: HostApi, req) -> None:
+        vfd, how = req.args[0], int(req.args[1])
+        sock = self.sockets.get(vfd)
+        if sock is None:
+            self._reply(api, "shutdown", -EBADF)
+            return
+        if sock.kind == "tcp" and sock.sim is not None and how in (1, 2):
+            sock.sim.close()  # SHUT_WR / SHUT_RDWR: send our FIN
+        self._reply(api, "shutdown", 0)
+
+    def _op_getsockname(self, api: HostApi, req) -> None:
+        sock = self.sockets.get(req.args[0])
+        if sock is None:
+            self._reply(api, "getsockname", -EBADF)
+            return
+        ip_be = _ip_to_be(api.ip_of(api.host_id))
+        port = sock.port or 0
+        if sock.kind == "tcp" and sock.sim is not None:
+            port = sock.sim.tcp.local_port
+        self._reply(api, "getsockname", 0, args=[0, ip_be, port])
+
+    def _op_getpeername(self, api: HostApi, req) -> None:
+        sock = self.sockets.get(req.args[0])
+        if sock is None:
+            self._reply(api, "getpeername", -EBADF)
+            return
+        if sock.kind == "tcp" and sock.sim is not None:
+            self._reply(api, "getpeername", 0,
+                        args=[0, _u32be_to_shim_ip(sock.sim.tcp.remote_ip),
+                              sock.sim.tcp.remote_port])
+        elif sock.kind == "udp" and sock.default_dst is not None:
+            self._reply(api, "getpeername", 0,
+                        args=[0, sock.default_dst[0], sock.default_dst[1]])
+        else:
+            self._reply(api, "getpeername", -ENOTCONN)
+
+    def _op_sockerr(self, api: HostApi, req) -> None:
+        sock = self.sockets.get(req.args[0])
+        if sock is None:
+            self._reply(api, "sockerr", -EBADF)
+            return
+        err = 0
+        if sock.kind == "tcp" and sock.sim is not None:
+            err = _tcp_errno(sock.sim.tcp)
+        self._reply(api, "sockerr", 0, args=[0, err])
+
+    def _op_close(self, api: HostApi, req) -> None:
+        vfd = req.args[0]
+        sock = self.sockets.pop(vfd, None)
+        if sock is None:
+            self._reply(api, "close", -EBADF)
+            return
+        self._teardown_vsocket(api, sock)
+        self._reply(api, "close", 0)
+
+    def _teardown_vsocket(self, api, sock: _VSocket) -> None:
+        if sock.kind == "udp":
+            if sock.port is not None:
+                self._host_ports(api).pop(sock.port, None)
+        elif sock.kind == "tcp":
+            if sock.sim is not None:
+                sock.sim.on_event = None
+                if not sock.sim.tcp.is_closed():
+                    sock.sim.close()
+        elif sock.kind == "listen":
+            if sock.listener is not None:
+                sock.listener.on_accept = None
+                sock.listener.close()
+            for child in sock.accept_q:  # unaccepted children are reset
+                child.close()
+            sock.accept_q.clear()
+
+    # -- readiness (SHIM_OP_POLL) ------------------------------------------
+
+    def _op_poll(self, api: HostApi, req) -> bool:
+        n = int(req.args[0])
+        timeout_ns = int(req.args[1])
+        raw = self.chan.req_payload()
+        entries = [
+            struct.unpack_from("<iI", raw, i * 8) for i in range(min(n, len(raw) // 8))
+        ]
+        if any(self._readiness(api, fd, ev) for fd, ev in entries) or timeout_ns == 0:
+            self._reply_poll(api, entries)
+            return True
+        deadline = None if timeout_ns < 0 else api.now + timeout_ns
+        self._park(api, ("poll", entries, deadline), deadline)
+        return False
+
+    def _readiness(self, api: HostApi, vfd: int, events: int) -> int:
+        """revents for one fd: current simulated readiness masked by the
+        request (plus the always-reported error bits)."""
+        sock = self.sockets.get(vfd)
+        if sock is None:
+            return abi.POLLNVAL
+        ready = 0
+        if sock.kind == "udp":
+            if sock.queue:
+                ready |= abi.POLLIN
+            ready |= abi.POLLOUT
+        elif sock.kind == "listen":
+            if sock.accept_q:
+                ready |= abi.POLLIN
+        elif sock.kind == "tcp" and sock.sim is None:
+            ready |= abi.POLLOUT | abi.POLLHUP  # unconnected stream socket
+        elif sock.sim is not None:
+            ps = sock.sim.poll()
+            if ps & PollState.READABLE or sock.sim.tcp.at_eof():
+                ready |= abi.POLLIN
+            if ps & PollState.WRITABLE:
+                ready |= abi.POLLOUT
+            if ps & PollState.ERROR:
+                ready |= abi.POLLERR | abi.POLLIN | abi.POLLOUT
+            if ps & PollState.RECV_CLOSED and ps & PollState.SEND_CLOSED:
+                ready |= abi.POLLHUP
+        return ready & (events | abi.POLLERR | abi.POLLHUP | abi.POLLNVAL)
+
+    def _reply_poll(self, api: HostApi, entries) -> None:
+        revents = [self._readiness(api, fd, ev) for fd, ev in entries]
+        payload = b"".join(struct.pack("<I", r) for r in revents)
+        nready = sum(1 for r in revents if r)
+        self._reply(api, "poll", nready, payload=payload)
+
+    # -- simulation-event wakeups ------------------------------------------
+
+    def _tcp_event(self, api: HostApi, vfd: int) -> None:
+        """State change on a connected TCP socket (data, window, FIN, RST)."""
+        if self.finished:
+            return
+        self._socket_activity(api, vfd)
+
+    def _tcp_accept(self, api: HostApi, vfd: int, child_sim) -> None:
+        """A new established child landed on a listener."""
+        if self.finished:
+            child_sim.close()
+            return
+        sock = self.sockets.get(vfd)
+        if sock is None:
+            child_sim.close()
+            return
+        sock.accept_q.append(child_sim)
+        self._socket_activity(api, vfd)
+
+    def _socket_activity(self, api: HostApi, vfd: int) -> None:
+        """Try to complete the parked call after an event touching vfd."""
+        b = self._blocked
+        if b is None or self.finished:
+            return
+        kind = b[0]
+        if kind == "recvfrom" and b[1] == vfd:
+            sock = self.sockets.get(vfd)
+            if sock is not None and sock.queue:
+                self._blocked = None
+                self._reply_udp_recv(api, vfd, b[2])
+                self._service(api)
+        elif kind == "recv" and b[1] == vfd:
+            sock = self.sockets.get(vfd)
+            if sock is None or sock.sim is None:
+                return
+            data = sock.sim.recv(max(b[2], 0))
+            ps = sock.sim.poll()
+            if data:
+                self._blocked = None
+                api.count("managed_tcp_rx_bytes", len(data))
+                peer_ip = _u32be_to_shim_ip(sock.sim.tcp.remote_ip)
+                self._reply(api, "recv", len(data),
+                            args=[0, peer_ip, sock.sim.tcp.remote_port],
+                            payload=data)
+                self._service(api)
+            elif ps & PollState.ERROR:
+                self._blocked = None
+                self._reply(api, "recv", -(_tcp_errno(sock.sim.tcp) or ECONNRESET))
+                self._service(api)
+            elif sock.sim.tcp.at_eof() or ps & PollState.RECV_CLOSED:
+                self._blocked = None
+                self._reply(api, "recv", 0)
+                self._service(api)
+        elif kind == "send" and b[1] == vfd:
+            sock = self.sockets.get(vfd)
+            if sock is None or sock.sim is None:
+                return
+            ps = sock.sim.poll()
+            if ps & PollState.ERROR:
+                self._blocked = None
+                self._reply(api, "send", -(_tcp_errno(sock.sim.tcp) or ECONNRESET))
+                self._service(api)
+                return
+            if ps & PollState.SEND_CLOSED:
+                self._blocked = None
+                self._reply(api, "send", -EPIPE)
+                self._service(api)
+                return
+            n = sock.sim.send(b[2])
+            if n > 0:
+                self._blocked = None
+                api.count("managed_tcp_tx_bytes", n)
+                self._reply(api, "send", n)
+                self._service(api)
+        elif kind == "connect" and b[1] == vfd:
+            sock = self.sockets.get(vfd)
+            if sock is None or sock.sim is None:
+                return
+            ps = sock.sim.poll()
+            if ps & PollState.ERROR:
+                self._blocked = None
+                self._reply(api, "connect", -(_tcp_errno(sock.sim.tcp) or ECONNREFUSED))
+                self._service(api)
+            elif ps & PollState.WRITABLE:
+                self._blocked = None
+                self._reply(api, "connect", 0)
+                self._service(api)
+        elif kind == "accept" and b[1] == vfd:
+            sock = self.sockets.get(vfd)
+            if sock is not None and sock.accept_q:
+                child_fd = b[2]
+                self._blocked = None
+                self._complete_accept(api, vfd, child_fd)
+                self._service(api)
+        elif kind == "poll":
+            entries = b[1]
+            if any(self._readiness(api, fd, ev) for fd, ev in entries):
+                self._blocked = None
+                self._reply_poll(api, entries)
+                self._service(api)
 
     # -- lifecycle ---------------------------------------------------------
 
     def _finish(self, api: HostApi, unexpected: bool) -> None:
         self.finished = True
-        ports = self._host_ports(api)
-        for port, (app, _vfd) in list(ports.items()):
-            if app is self:
-                del ports[port]
+        self._blocked = None
+        self._release_ports(api)
         if self.proc is not None:
             try:
                 self.exit_code = self.proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
                 self.exit_code = self.proc.wait()
-        if self._stdout_file:
-            self._stdout_file.close()
-            self._stdout_file = None
-        if self.chan is not None:
-            self.chan.close()
-            self.chan = None
+        self._close_files()
         api.count("managed_exit_unexpected" if unexpected else "managed_exit_clean")
         if unexpected:
             log.warning("%s died without exit handshake", self.argv[0])
 
     def shutdown(self) -> None:
         """End-of-simulation teardown: a plugin still parked (blocked in
-        recvfrom past stop_time — the typical long-lived server shape) is
-        killed and reaped so no orphan OS process outlives the run.  The
-        engine calls this for every app when the simulation ends."""
+        recv/accept/poll past stop_time — the typical long-lived server
+        shape) is killed and reaped so no orphan OS process outlives the
+        run.  The engine calls this for every app when the simulation
+        ends."""
         if self.finished or self.proc is None:
             return
         self.finished = True
         self.proc.kill()
         self.exit_code = self.proc.wait()
         if self._api is not None:
-            ports = self._host_ports(self._api)
-            for port, (app, _vfd) in list(ports.items()):
-                if app is self:
-                    del ports[port]
+            self._release_ports(self._api)
             self._api.count("managed_killed_at_stop")
+        self._close_files()
+
+    def _release_ports(self, api) -> None:
+        ports = self._host_ports(api)
+        for port, (app, _vfd) in list(ports.items()):
+            if app is self:
+                del ports[port]
+        for sock in list(self.sockets.values()):
+            if sock.kind in ("tcp", "listen"):
+                self._teardown_vsocket(api, sock)
+        self.sockets.clear()
+
+    def _close_files(self) -> None:
         if self._stdout_file:
             self._stdout_file.close()
             self._stdout_file = None
+        if self._strace_file:
+            self._strace_file.close()
+            self._strace_file = None
         if self.chan is not None:
             self.chan.close()
             self.chan = None
@@ -374,6 +841,31 @@ class ManagedApp:
 
         return host_seed(api.master_seed, api.host_id)
 
+    @staticmethod
+    def _cfg_strace_mode(api) -> str:
+        engine = getattr(api, "engine", None)
+        if engine is None:
+            return "off"
+        return engine.cfg.experimental.strace_logging_mode
+
+
+def _errno_name(err: int) -> str:
+    import errno as _errno
+
+    return _errno.errorcode.get(err, f"E{err}")
+
+
+def _tcp_errno(tcp) -> int:
+    """Pending socket error as an errno (SO_ERROR / failure replies)."""
+    from ..transport.tcp import TcpError
+
+    return {
+        TcpError.NONE: 0,
+        TcpError.RESET: ECONNRESET,
+        TcpError.TIMED_OUT: ETIMEDOUT,
+        TcpError.REFUSED: ECONNREFUSED,
+    }[tcp.error]
+
 
 def _ip_to_be(ip: str) -> int:
     return int.from_bytes(pysocket.inet_aton(ip), "little")
@@ -381,3 +873,12 @@ def _ip_to_be(ip: str) -> int:
 
 def _be_to_ip(ip_be: int) -> str:
     return pysocket.inet_ntoa(ip_be.to_bytes(4, "little"))
+
+
+def _u32be_to_shim_ip(ip_u32: int) -> int:
+    """stack-side big-endian u32 -> the shim's raw-s_addr integer."""
+    return int.from_bytes(ip_u32.to_bytes(4, "big"), "little")
+
+
+def _shim_ip_to_u32be(ip_be: int) -> int:
+    return int.from_bytes(ip_be.to_bytes(4, "little"), "big")

@@ -1,0 +1,191 @@
+"""Real binaries speaking TCP through the simulated stack.
+
+The stream-socket slice of the reference's defining capability: an
+unmodified C program's connect/accept/read/write/epoll/poll run against the
+simulated TCP implementation (handshake, congestion control, loss
+recovery), with deterministic results.  Mirrors the reference's dual-target
+socket tests (src/test/socket/) on the shadow side.
+"""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.determinism import determinism_check
+from shadow_tpu.engine.sim import Simulation
+
+REPO = Path(__file__).resolve().parents[1]
+BUILD = REPO / "native" / "build"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_build():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+    )
+    assert (BUILD / "tcpecho").exists()
+
+
+def _yaml(tmp_path, server_args, client_specs, stop="10s", loss=""):
+    """One server host + N client hosts on a 2-node graph."""
+    clients = "\n".join(
+        f"""
+  cli{i}:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'tcpecho'}
+        args: [{args}]
+        start_time: {start}
+"""
+        for i, (args, start) in enumerate(client_specs)
+    )
+    return f"""
+general: {{stop_time: {stop}, seed: 33, data_directory: {tmp_path / 'data'}, heartbeat_interval: null}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+        edge [ source 0 target 1 latency "10 ms" {loss} ]
+        edge [ source 1 target 1 latency "1 ms" ]
+      ]
+hosts:
+{clients}
+  srv:
+    network_node_id: 1
+    processes:
+      - path: {BUILD / 'tcpecho'}
+        args: [{server_args}]
+"""
+
+
+def _read(tmp_path, host, idx=0):
+    stem = "tcpecho" if idx == 0 else f"tcpecho.{idx}"
+    return (tmp_path / "data" / "hosts" / host / f"{stem}.stdout").read_text()
+
+
+# client hosts sort before srv: cli0=11.0.0.1, srv is last
+
+
+def _srv_ip(n_clients):
+    return f"11.0.0.{n_clients + 1}"
+
+
+def test_single_echo_client(tmp_path):
+    cfg = ConfigOptions.from_yaml(
+        _yaml(
+            tmp_path,
+            "server, '7000', '1'",
+            [(f"client, {_srv_ip(1)}, '7000', '5', '2000', '10'", "100ms")],
+        )
+    )
+    result = Simulation(cfg).run()
+    assert "client done rounds=5 bytes=10000" in _read(tmp_path, "cli0")
+    assert "server done conns=1 bytes=10000" in _read(tmp_path, "srv")
+    assert result.counters["managed_tcp_connects"] == 1
+    assert result.counters["managed_tcp_accepts"] == 1
+    assert result.counters["managed_tcp_rx_bytes"] >= 20000  # both directions
+
+
+def test_three_concurrent_clients(tmp_path):
+    specs = [
+        (f"client, {_srv_ip(3)}, '7000', '3', '1500', '5'", f"{100 + 30 * i}ms")
+        for i in range(3)
+    ]
+    cfg = ConfigOptions.from_yaml(_yaml(tmp_path, "server, '7000', '3'", specs))
+    Simulation(cfg).run()
+    for i in range(3):
+        assert "client done rounds=3 bytes=4500" in _read(tmp_path, f"cli{i}")
+    assert "server done conns=3 bytes=13500" in _read(tmp_path, "srv")
+
+
+def test_echo_over_lossy_link(tmp_path):
+    # 5% loss: handshake + stream must survive via retransmission
+    cfg = ConfigOptions.from_yaml(
+        _yaml(
+            tmp_path,
+            "server, '7000', '1'",
+            [(f"client, {_srv_ip(1)}, '7000', '4', '4000', '20'", "100ms")],
+            stop="60s",
+            loss="packet_loss 0.05",
+        )
+    )
+    result = Simulation(cfg).run()
+    assert "client done rounds=4 bytes=16000" in _read(tmp_path, "cli0")
+    assert result.counters.get("managed_tcp_connects") == 1
+
+
+def test_connection_refused(tmp_path):
+    # no listener on port 9999: the SYN gets an RST back
+    cfg = ConfigOptions.from_yaml(
+        _yaml(
+            tmp_path,
+            "server, '7000', '1'",
+            [
+                (f"client, {_srv_ip(2)}, '9999', '1', '100', '0'", "100ms"),
+                (f"client, {_srv_ip(2)}, '7000', '2', '600', '0'", "200ms"),
+            ],
+        )
+    )
+    Simulation(cfg).run()
+    assert "client connect errno=111" in _read(tmp_path, "cli0")  # ECONNREFUSED
+    assert "client done rounds=2 bytes=1200" in _read(tmp_path, "cli1")
+
+
+def test_nonblocking_connect_poll_soerror(tmp_path):
+    cfg = ConfigOptions.from_yaml(
+        _yaml(
+            tmp_path,
+            "server, '7000', '1'",
+            [(f"nbclient, {_srv_ip(1)}, '7000'", "100ms")],
+        )
+    )
+    Simulation(cfg).run()
+    assert "nbclient done bytes=64" in _read(tmp_path, "cli0")
+
+
+def test_tcp_run_twice_identical(tmp_path):
+    cfg = ConfigOptions.from_yaml(
+        _yaml(
+            tmp_path,
+            "server, '7000', '2'",
+            [
+                (f"client, {_srv_ip(2)}, '7000', '3', '2500', '7'", "100ms"),
+                (f"client, {_srv_ip(2)}, '7000', '2', '900', '3'", "150ms"),
+            ],
+        )
+    )
+    report = determinism_check(cfg)
+    assert report.identical, report.describe()
+    assert report.records > 40
+
+
+def test_strace_logging(tmp_path):
+    yaml = _yaml(
+        tmp_path,
+        "server, '7000', '1'",
+        [(f"client, {_srv_ip(1)}, '7000', '2', '500', '5'", "100ms")],
+    )
+    cfg = ConfigOptions.from_yaml(yaml)
+    cfg.experimental.strace_logging_mode = "deterministic"
+    Simulation(cfg).run()
+    trace = (tmp_path / "data" / "hosts" / "cli0" / "tcpecho.strace").read_text()
+    assert "socket[tcp] = 0" in trace
+    assert "connect = 0" in trace
+    assert "recv = " in trace
+    srv_trace = (tmp_path / "data" / "hosts" / "srv" / "tcpecho.strace").read_text()
+    assert "accept = " in srv_trace
+    assert "poll = " in srv_trace  # epoll_wait rides OP_POLL
+    # deterministic mode: identical across runs (no wall-clock content)
+    cfg2 = ConfigOptions.from_yaml(yaml)
+    cfg2.experimental.strace_logging_mode = "deterministic"
+    Simulation(cfg2).run()
+    assert trace == (
+        tmp_path / "data" / "hosts" / "cli0" / "tcpecho.strace"
+    ).read_text()
