@@ -20,6 +20,8 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <netdb.h>
+#include <sys/ioctl.h>
 #include <poll.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -203,6 +205,79 @@ static int run_nbclient(const char *ip, int port) {
     return 0;
 }
 
+/* one big blocking write (> the 64 KiB channel payload), echo read back
+ * with MSG_WAITALL, FIONREAD probe, and a poll-as-sleep — the POSIX
+ * semantics corners of the stream path */
+static int run_bigclient(const char *ip, int port, int size) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) die("socket");
+    struct sockaddr_in sin = {0};
+    sin.sin_family = AF_INET;
+    sin.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, ip, &sin.sin_addr) != 1) die("inet_pton");
+    if (connect(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) die("connect");
+    char *buf = malloc((size_t)size);
+    char *echo = malloc((size_t)size);
+    for (int i = 0; i < size; i++) buf[i] = (char)(i * 7);
+    uint64_t t0 = now_ms();
+    ssize_t w = write(fd, buf, (size_t)size); /* blocking: must queue ALL */
+    if (w != (ssize_t)size) {
+        printf("bigclient short write %zd of %d\n", w, size);
+        return 1;
+    }
+    poll(NULL, 0, 50); /* poll-as-sleep: must advance SIMULATED time */
+    uint64_t t1 = now_ms();
+    int avail = -1;
+    if (ioctl(fd, FIONREAD, &avail) != 0) die("FIONREAD");
+    ssize_t r = recv(fd, echo, (size_t)size, MSG_WAITALL);
+    if (r != (ssize_t)size) {
+        printf("bigclient short waitall read %zd of %d\n", r, size);
+        return 1;
+    }
+    if (memcmp(buf, echo, (size_t)size) != 0) die("echo mismatch");
+    shutdown(fd, SHUT_WR);
+    while (read(fd, echo, (size_t)size) > 0) {
+    }
+    close(fd);
+    printf("bigclient done bytes=%d slept_ms=%llu avail_gt0=%d\n", size,
+           (unsigned long long)(t1 - t0), avail > 0);
+    free(buf);
+    free(echo);
+    return 0;
+}
+
+/* resolve the server by NAME through the simulated resolver, then one echo */
+static int run_rclient(const char *hostname, const char *port_str) {
+    char me[256] = "?";
+    gethostname(me, sizeof(me));
+    struct addrinfo hints = {0}, *res = NULL;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    int rc = getaddrinfo(hostname, port_str, &hints, &res);
+    if (rc != 0) {
+        printf("rclient resolve %s failed rc=%d\n", hostname, rc);
+        return 0;
+    }
+    char ipbuf[64];
+    struct sockaddr_in *sin = (struct sockaddr_in *)res->ai_addr;
+    inet_ntop(AF_INET, &sin->sin_addr, ipbuf, sizeof(ipbuf));
+    int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) die("socket");
+    if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) die("connect");
+    freeaddrinfo(res);
+    char buf[128];
+    memset(buf, 0x42, sizeof(buf));
+    if (write(fd, buf, sizeof(buf)) != (ssize_t)sizeof(buf)) die("write");
+    char echo[128];
+    if (read_full(fd, echo, sizeof(echo)) != 0) die("read");
+    shutdown(fd, SHUT_WR);
+    while (read(fd, echo, sizeof(echo)) > 0) {
+    }
+    close(fd);
+    printf("rclient %s resolved %s=%s echoed=128\n", me, hostname, ipbuf);
+    return 0;
+}
+
 int main(int argc, char **argv) {
     setvbuf(stdout, NULL, _IONBF, 0);
     if (argc >= 4 && strcmp(argv[1], "server") == 0)
@@ -212,6 +287,10 @@ int main(int argc, char **argv) {
                           atoi(argv[6]));
     if (argc >= 4 && strcmp(argv[1], "nbclient") == 0)
         return run_nbclient(argv[2], atoi(argv[3]));
+    if (argc >= 4 && strcmp(argv[1], "rclient") == 0)
+        return run_rclient(argv[2], argv[3]);
+    if (argc >= 5 && strcmp(argv[1], "bigclient") == 0)
+        return run_bigclient(argv[2], atoi(argv[3]), atoi(argv[4]));
     fprintf(stderr,
             "usage: tcpecho server <port> <nconns> | "
             "client <ip> <port> <rounds> <size> <gap_ms> | "

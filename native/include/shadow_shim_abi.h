@@ -8,8 +8,8 @@
  * single biggest perf win (~50ns clock reads vs ~10us trapped syscalls,
  * MyTest/SUMMARY.md:71-75).
  *
- * Virtual fds are REAL fd numbers: the shim reserves a kernel fd (dup of
- * /dev/null) for every simulated socket and registers that number with the
+ * Virtual fds are REAL fd numbers: the shim reserves a kernel fd (an O_PATH
+ * handle on /dev/null) for every simulated socket and registers that number with the
  * manager, so simulated fds never collide with the plugin's real fds and
  * stay below FD_SETSIZE for select().  This mirrors the reference's
  * ownership of the plugin fd table (descriptor_table.rs), done the
@@ -25,7 +25,7 @@
 
 #include <stdint.h>
 
-#define SHIM_ABI_MAGIC 0x53485457534d4832ull /* "SHTWSMH2" */
+#define SHIM_ABI_MAGIC 0x53485457534d4833ull /* "SHTWSMH3" */
 #define SHIM_PAYLOAD_MAX 65536
 
 /* plugin -> shadow ops.  Unless noted, replies carry ret = result or
@@ -53,6 +53,7 @@ enum {
     SHIM_OP_POLL = 16,     /* args[0]=nfds args[1]=timeout ns (-1 = infinite);
                               payload = nfds * shim_pollfd;
                               reply ret=nready, payload = nfds * u32 revents */
+    SHIM_OP_FIONREAD = 17, /* args[0]=fd; reply args[1]=readable bytes */
 };
 
 /* poll event bits (mirror Linux poll.h values) */
@@ -85,6 +86,8 @@ typedef struct {
     uint64_t sim_clock_ns;     /* emulated wall clock, ns since Unix epoch */
     uint64_t rng_seed;         /* per-process deterministic RNG key */
     uint64_t rng_counter;      /* splitmix64 counter (shim-local draws) */
+    uint64_t sock_sndbuf;      /* configured socket buffer sizes, so */
+    uint64_t sock_rcvbuf;      /* getsockopt answers match the simulation */
     shim_msg to_shadow;        /* plugin -> manager */
     shim_msg to_shim;          /* manager -> plugin */
 } shim_shmem;

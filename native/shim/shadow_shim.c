@@ -63,6 +63,8 @@ static int g_ready = 0;
 enum { VK_NONE = 0, VK_SOCKET = 1 };
 static uint8_t vfd_kind[SHIM_MAX_FDS];
 static uint8_t vfd_nonblock[SHIM_MAX_FDS];
+static uint8_t vfd_stream[SHIM_MAX_FDS]; /* SOCK_STREAM (vs SOCK_DGRAM) */
+static uint8_t vfd_listening[SHIM_MAX_FDS];
 
 /* per-epfd registration of simulated fds (real fds still ride the real
  * epoll object; mixing both in one wait services the simulated side) */
@@ -264,7 +266,10 @@ static int is_vfd(int fd) {
 /* Reserve a real kernel fd slot for a simulated socket so the number can't
  * collide with the plugin's own fds. */
 static int reserve_fd(void) {
-    int fd = open("/dev/null", O_RDONLY);
+    /* O_PATH: every uninterposed data syscall on the reservation (readv,
+     * recvmsg, a dup...) fails loudly with EBADF instead of reading
+     * /dev/null's silent EOF */
+    int fd = open("/dev/null", O_PATH);
     if (fd < 0) return -1;
     if (fd >= SHIM_MAX_FDS) {
         real_close(fd);
@@ -274,14 +279,18 @@ static int reserve_fd(void) {
     return fd;
 }
 
-static void vfd_register(int fd, int nonblock) {
+static void vfd_register(int fd, int nonblock, int stream) {
     vfd_kind[fd] = VK_SOCKET;
     vfd_nonblock[fd] = (uint8_t)(nonblock != 0);
+    vfd_stream[fd] = (uint8_t)(stream != 0);
+    vfd_listening[fd] = 0;
 }
 
 static void vfd_release(int fd) {
     vfd_kind[fd] = VK_NONE;
     vfd_nonblock[fd] = 0;
+    vfd_stream[fd] = 0;
+    vfd_listening[fd] = 0;
     real_close(fd); /* free the /dev/null reservation */
 }
 
@@ -417,7 +426,8 @@ int socket(int domain, int type, int protocol) {
         errno = (int)-ret;
         return -1;
     }
-    vfd_register(fd, (type & SOCK_NONBLOCK) != 0);
+    vfd_register(fd, (type & SOCK_NONBLOCK) != 0,
+                 base_type == SOCK_STREAM);
     return fd;
 }
 
@@ -444,8 +454,9 @@ int connect(int fd, const struct sockaddr *addr, socklen_t len) {
 int listen(int fd, int backlog) {
     if (!is_vfd(fd)) return real_listen(fd, backlog);
     int64_t args[6] = {fd, backlog, 0, 0, 0, 0};
-    return (int)ret_errno(
-        shim_call(SHIM_OP_LISTEN, args, NULL, 0, NULL, NULL, NULL));
+    int64_t ret = shim_call(SHIM_OP_LISTEN, args, NULL, 0, NULL, NULL, NULL);
+    if (ret == 0) vfd_listening[fd] = 1;
+    return (int)ret_errno(ret);
 }
 
 int accept4(int fd, struct sockaddr *addr, socklen_t *alen, int flags) {
@@ -460,7 +471,7 @@ int accept4(int fd, struct sockaddr *addr, socklen_t *alen, int flags) {
         errno = (int)-ret;
         return -1;
     }
-    vfd_register(child, (flags & SOCK_NONBLOCK) != 0);
+    vfd_register(child, (flags & SOCK_NONBLOCK) != 0, 1);
     fill_sockaddr(addr, alen, (uint32_t)reply[1], (uint16_t)reply[2]);
     return child;
 }
@@ -476,26 +487,65 @@ int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
 
 static ssize_t vfd_sendto(int fd, const void *buf, size_t n, int flags,
                           uint32_t ip, uint16_t port) {
-    if (n > SHIM_PAYLOAD_MAX) n = SHIM_PAYLOAD_MAX;
     int nb = vfd_nonblock[fd] || (flags & MSG_DONTWAIT);
-    int64_t args[6] = {fd, (int64_t)ip, port, nb, 0, 0};
-    return (ssize_t)ret_errno(
-        shim_call(SHIM_OP_SENDTO, args, buf, (uint32_t)n, NULL, NULL, NULL));
+    if (!vfd_stream[fd]) {
+        if (n > SHIM_PAYLOAD_MAX) { /* larger than any one datagram */
+            errno = EMSGSIZE;
+            return -1;
+        }
+        int64_t args[6] = {fd, (int64_t)ip, port, nb, 0, 0};
+        return (ssize_t)ret_errno(shim_call(SHIM_OP_SENDTO, args, buf,
+                                            (uint32_t)n, NULL, NULL, NULL));
+    }
+    /* stream: the channel carries 64 KiB per hop; loop so a blocking
+     * write(fd, buf, len) queues all len bytes like real Linux */
+    size_t off = 0;
+    do {
+        size_t chunk = n - off;
+        if (chunk > SHIM_PAYLOAD_MAX) chunk = SHIM_PAYLOAD_MAX;
+        int64_t args[6] = {fd, (int64_t)ip, port, nb, 0, 0};
+        int64_t ret = shim_call(SHIM_OP_SENDTO, args, (const char *)buf + off,
+                                (uint32_t)chunk, NULL, NULL, NULL);
+        if (ret < 0) {
+            if (off > 0) return (ssize_t)off; /* partial before the error */
+            errno = (int)-ret;
+            return -1;
+        }
+        off += (size_t)ret;
+        if (nb && (size_t)ret < chunk) break; /* buffer full: partial is fine */
+    } while (off < n);
+    return (ssize_t)off;
 }
 
 static ssize_t vfd_recvfrom(int fd, void *buf, size_t n, int flags,
                             struct sockaddr *addr, socklen_t *alen) {
-    int nb = vfd_nonblock[fd] || (flags & MSG_DONTWAIT);
-    int64_t args[6] = {fd, (int64_t)n, nb, 0, 0, 0};
-    int64_t reply[6];
-    uint32_t got = (uint32_t)(n > SHIM_PAYLOAD_MAX ? SHIM_PAYLOAD_MAX : n);
-    int64_t ret = shim_call(SHIM_OP_RECVFROM, args, NULL, 0, buf, &got, reply);
-    if (ret < 0) {
-        errno = (int)-ret;
+    if (flags & MSG_PEEK) {
+        /* honest failure beats silently consuming the peeked bytes */
+        errno = EINVAL;
         return -1;
     }
-    fill_sockaddr(addr, alen, (uint32_t)reply[1], (uint16_t)reply[2]);
-    return (ssize_t)ret;
+    int nb = vfd_nonblock[fd] || (flags & MSG_DONTWAIT);
+    int waitall = vfd_stream[fd] && (flags & MSG_WAITALL) && !nb;
+    size_t off = 0;
+    for (;;) {
+        size_t want = n - off;
+        if (want > SHIM_PAYLOAD_MAX) want = SHIM_PAYLOAD_MAX;
+        int64_t args[6] = {fd, (int64_t)want, nb, 0, 0, 0};
+        int64_t reply[6];
+        uint32_t got = (uint32_t)want;
+        int64_t ret = shim_call(SHIM_OP_RECVFROM, args, NULL, 0,
+                                (char *)buf + off, &got, reply);
+        if (ret < 0) {
+            if (off > 0) return (ssize_t)off;
+            errno = (int)-ret;
+            return -1;
+        }
+        if (off == 0) fill_sockaddr(addr, alen, (uint32_t)reply[1],
+                                    (uint16_t)reply[2]);
+        off += (size_t)ret;
+        if (ret == 0 || off >= n || !waitall) break;
+    }
+    return (ssize_t)off;
 }
 
 ssize_t sendto(int fd, const void *buf, size_t n, int flags,
@@ -610,8 +660,30 @@ int getsockopt(int fd, int level, int optname, void *optval, socklen_t *optlen) 
         }
         return 0;
     }
+    int value;
+    if (level == SOL_SOCKET) {
+        switch (optname) {
+            case SO_SNDBUF: value = (int)g_shm->sock_sndbuf; break;
+            case SO_RCVBUF: value = (int)g_shm->sock_rcvbuf; break;
+            case SO_TYPE:
+                value = vfd_stream[fd] ? SOCK_STREAM : SOCK_DGRAM;
+                break;
+            case SO_ACCEPTCONN: value = vfd_listening[fd]; break;
+            case SO_REUSEADDR:
+            case SO_KEEPALIVE:
+            case SO_BROADCAST: value = 0; break;
+            default:
+                errno = ENOPROTOOPT;
+                return -1;
+        }
+    } else if (level == IPPROTO_TCP) {
+        value = 0; /* TCP_NODELAY etc: accepted as off */
+    } else {
+        errno = ENOPROTOOPT;
+        return -1;
+    }
     if (optval && optlen && *optlen >= sizeof(int)) {
-        *(int *)optval = 0;
+        *(int *)optval = value;
         *optlen = sizeof(int);
     }
     return 0;
@@ -649,6 +721,18 @@ int ioctl(int fd, unsigned long req, ...) {
         vfd_nonblock[fd] = arg && *(int *)arg != 0;
         return 0;
     }
+    if (req == FIONREAD) {
+        int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+        int64_t reply[6];
+        int64_t ret =
+            shim_call(SHIM_OP_FIONREAD, args, NULL, 0, NULL, NULL, reply);
+        if (ret < 0) {
+            errno = (int)-ret;
+            return -1;
+        }
+        if (arg) *(int *)arg = (int)reply[1];
+        return 0;
+    }
     errno = EINVAL;
     return -1;
 }
@@ -677,9 +761,20 @@ static int poll_ns(struct pollfd *fds, nfds_t nfds, int64_t timeout_ns) {
             any_real = 1;
     }
     if (!any_virtual) {
-        int timeout_ms =
-            timeout_ns < 0 ? -1 : (int)((timeout_ns + 999999) / 1000000);
-        return real_poll(fds, nfds, timeout_ms);
+        if (timeout_ns < 0) /* intentional forever-block on real fds */
+            return real_poll(fds, nfds, -1);
+        /* poll-as-sleep (nfds==0) or real-only sets with a timeout: park
+         * in SIMULATED time so the rest of the simulation keeps running */
+        if (any_real) {
+            static int warned;
+            if (!warned++)
+                shim_warn("timed poll() on real fds sleeps in simulated "
+                          "time; real fds report no events");
+        }
+        for (nfds_t i = 0; i < nfds; i++) fds[i].revents = 0;
+        uint32_t rv;
+        int ready = shim_poll_call(NULL, 0, timeout_ns, &rv);
+        return ready < 0 ? -1 : 0;
     }
     if (any_real) {
         static int warned;
@@ -748,7 +843,24 @@ int select(int nfds, fd_set *rd, fd_set *wr, fd_set *ex, struct timeval *tv) {
         else
             any_real = 1;
     }
-    if (!any_virtual) return real_select(nfds, rd, wr, ex, tv);
+    if (!any_virtual) {
+        int64_t tns = tv ? (int64_t)tv->tv_sec * 1000000000ll +
+                               (int64_t)tv->tv_usec * 1000ll
+                         : -1;
+        if (tns < 0) return real_select(nfds, rd, wr, ex, tv);
+        if (any_real) {
+            static int warned2;
+            if (!warned2++)
+                shim_warn("timed select() on real fds sleeps in simulated "
+                          "time; real fds report no events");
+        }
+        if (rd) FD_ZERO(rd);
+        if (wr) FD_ZERO(wr);
+        if (ex) FD_ZERO(ex);
+        uint32_t rv;
+        int ready = shim_poll_call(NULL, 0, tns, &rv);
+        return ready < 0 ? -1 : 0;
+    }
     if (any_real) {
         static int warned;
         if (!warned++)
@@ -871,7 +983,17 @@ int epoll_wait(int epfd, struct epoll_event *events, int maxevents,
     if (!real_socket) resolve_reals();
     if (!g_ready) return real_epoll_wait(epfd, events, maxevents, timeout);
     int n = (epfd >= 0 && epfd < SHIM_MAX_FDS) ? epoll_nregs[epfd] : 0;
-    if (n == 0) return real_epoll_wait(epfd, events, maxevents, timeout);
+    if (n == 0) {
+        /* no simulated registrations: epolls carrying real fds keep real
+         * semantics; an EMPTY epoll with a timeout is a sleep and must
+         * advance simulated time */
+        if (timeout < 0 || epfd < 0 || epfd >= SHIM_MAX_FDS ||
+            epoll_has_real[epfd])
+            return real_epoll_wait(epfd, events, maxevents, timeout);
+        uint32_t rv;
+        int ready = shim_poll_call(NULL, 0, (int64_t)timeout * 1000000ll, &rv);
+        return ready < 0 ? -1 : 0;
+    }
     if (epoll_has_real[epfd]) {
         static int warned;
         if (!warned++)
@@ -916,4 +1038,138 @@ int epoll_pwait(int epfd, struct epoll_event *events, int maxevents,
         return rp(epfd, events, maxevents, timeout, mask);
     }
     return epoll_wait(epfd, events, maxevents, timeout);
+}
+
+/* ----------------------------------------------------- name resolution */
+
+/* getaddrinfo against the simulation's hosts file — the reference
+ * implements getaddrinfo in its libc preload against shadow's DNS
+ * (preload-libc shim_api_addrinfo.c, dns.rs:130-190).  The manager passes
+ * the /etc/hosts-style file in SHADOW_TPU_HOSTS_FILE; lookups are local
+ * (no channel hop) and deterministic.  Numeric-only service strings. */
+#include <netdb.h>
+
+static int hosts_lookup(const char *name, uint32_t *ip_out) {
+    const char *path = getenv("SHADOW_TPU_HOSTS_FILE");
+    if (!path) return -1;
+    FILE *f = fopen(path, "re");
+    if (!f) return -1;
+    char line[512];
+    int found = -1;
+    while (fgets(line, sizeof(line), f)) {
+        char ip[64], host[256];
+        if (sscanf(line, "%63s %255s", ip, host) != 2) continue;
+        if (strcmp(host, name) != 0) continue;
+        struct in_addr a;
+        if (inet_pton(AF_INET, ip, &a) == 1) {
+            *ip_out = a.s_addr;
+            found = 0;
+        }
+        break;
+    }
+    fclose(f);
+    return found;
+}
+
+int getaddrinfo(const char *node, const char *service,
+                const struct addrinfo *hints, struct addrinfo **res) {
+    if (!real_socket) resolve_reals();
+    static int (*real_gai)(const char *, const char *,
+                           const struct addrinfo *, struct addrinfo **);
+    if (!real_gai) real_gai = dlsym(RTLD_NEXT, "getaddrinfo");
+    if (!g_ready) return real_gai(node, service, hints, res);
+
+    if (hints && hints->ai_family != AF_UNSPEC && hints->ai_family != AF_INET)
+        return EAI_FAMILY; /* the simulated internet is IPv4 */
+
+    uint32_t ip;
+    if (node == NULL) {
+        ip = (hints && (hints->ai_flags & AI_PASSIVE)) ? INADDR_ANY
+                                                       : htonl(INADDR_LOOPBACK);
+    } else {
+        struct in_addr a;
+        if (inet_pton(AF_INET, node, &a) == 1) {
+            ip = a.s_addr;
+        } else if (hints && (hints->ai_flags & AI_NUMERICHOST)) {
+            return EAI_NONAME;
+        } else if (hosts_lookup(node, &ip) != 0) {
+            return EAI_NONAME;
+        }
+    }
+    long port = 0;
+    if (service) {
+        char *end;
+        port = strtol(service, &end, 10);
+        if (*end != '\0' || port < 0 || port > 65535) return EAI_SERVICE;
+    }
+
+    int socktype = hints && hints->ai_socktype ? hints->ai_socktype : SOCK_STREAM;
+    struct addrinfo *ai = calloc(1, sizeof(*ai) + sizeof(struct sockaddr_in));
+    if (!ai) return EAI_MEMORY;
+    struct sockaddr_in *sin = (struct sockaddr_in *)(ai + 1);
+    sin->sin_family = AF_INET;
+    sin->sin_addr.s_addr = ip;
+    sin->sin_port = htons((uint16_t)port);
+    ai->ai_family = AF_INET;
+    ai->ai_socktype = socktype;
+    ai->ai_protocol = socktype == SOCK_DGRAM ? IPPROTO_UDP : IPPROTO_TCP;
+    ai->ai_addrlen = sizeof(struct sockaddr_in);
+    ai->ai_addr = (struct sockaddr *)sin;
+    *res = ai;
+    return 0;
+}
+
+void freeaddrinfo(struct addrinfo *res) {
+    if (!g_ready) {
+        static void (*real_fai)(struct addrinfo *);
+        if (!real_fai) real_fai = dlsym(RTLD_NEXT, "freeaddrinfo");
+        real_fai(res);
+        return;
+    }
+    while (res) {
+        struct addrinfo *next = res->ai_next;
+        free(res); /* sockaddr is co-allocated */
+        res = next;
+    }
+}
+
+struct hostent *gethostbyname(const char *name) {
+    if (!real_socket) resolve_reals();
+    static struct hostent *(*real_ghn)(const char *);
+    if (!real_ghn) real_ghn = dlsym(RTLD_NEXT, "gethostbyname");
+    if (!g_ready) return real_ghn(name);
+
+    static struct in_addr addr;
+    static char *addr_list[2];
+    static char hname[256];
+    static struct hostent he;
+    uint32_t ip;
+    struct in_addr a;
+    if (inet_pton(AF_INET, name, &a) == 1) {
+        ip = a.s_addr;
+    } else if (hosts_lookup(name, &ip) != 0) {
+        h_errno = HOST_NOT_FOUND;
+        return NULL;
+    }
+    addr.s_addr = ip;
+    addr_list[0] = (char *)&addr;
+    addr_list[1] = NULL;
+    snprintf(hname, sizeof(hname), "%s", name);
+    he.h_name = hname;
+    he.h_aliases = addr_list + 1; /* empty list */
+    he.h_addrtype = AF_INET;
+    he.h_length = sizeof(struct in_addr);
+    he.h_addr_list = addr_list;
+    return &he;
+}
+
+/* the local hostname is the simulated one */
+int gethostname(char *name, size_t len) {
+    if (!real_socket) resolve_reals();
+    static int (*real_ghname)(char *, size_t);
+    if (!real_ghname) real_ghname = dlsym(RTLD_NEXT, "gethostname");
+    const char *simname = getenv("SHADOW_TPU_HOSTNAME");
+    if (!g_ready || !simname) return real_ghname(name, len);
+    snprintf(name, len, "%s", simname);
+    return 0;
 }

@@ -11,7 +11,7 @@ import ctypes.util
 import mmap
 import time
 
-SHIM_ABI_MAGIC = 0x53485457534D4832
+SHIM_ABI_MAGIC = 0x53485457534D4833
 SHIM_PAYLOAD_MAX = 65536
 
 # ops
@@ -31,12 +31,13 @@ OP_SHUTDOWN = 13
 OP_GETPEERNAME = 14
 OP_SOCKERR = 15
 OP_POLL = 16
+OP_FIONREAD = 17
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
     6: "sendto", 7: "recvfrom", 8: "close", 9: "connect", 10: "getsockname",
     11: "listen", 12: "accept", 13: "shutdown", 14: "getpeername",
-    15: "sockerr", 16: "poll",
+    15: "sockerr", 16: "poll", 17: "fionread",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)
@@ -66,6 +67,8 @@ class ShimShmem(ctypes.Structure):
         ("sim_clock_ns", ctypes.c_uint64),
         ("rng_seed", ctypes.c_uint64),
         ("rng_counter", ctypes.c_uint64),
+        ("sock_sndbuf", ctypes.c_uint64),
+        ("sock_rcvbuf", ctypes.c_uint64),
         ("to_shadow", ShimMsg),
         ("to_shim", ShimMsg),
     ]
@@ -104,7 +107,8 @@ def futex_wake(addr: int) -> None:
 class ShmChannel:
     """Manager-side view of one plugin's shared-memory block."""
 
-    def __init__(self, path: str, seed: int) -> None:
+    def __init__(self, path: str, seed: int, sndbuf: int = 131072,
+                 rcvbuf: int = 174760) -> None:
         size = ctypes.sizeof(ShimShmem)
         with open(path, "wb") as f:
             f.truncate(size)
@@ -115,6 +119,8 @@ class ShmChannel:
         self.shm.abi_size = size
         self.shm.rng_seed = seed & ((1 << 64) - 1)
         self.shm.rng_counter = 0
+        self.shm.sock_sndbuf = sndbuf
+        self.shm.sock_rcvbuf = rcvbuf
 
     def close(self) -> None:
         # ctypes views derived from from_buffer pin the mmap's export flag

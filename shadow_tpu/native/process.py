@@ -153,7 +153,14 @@ class ManagedApp:
         idx = getattr(api, "apps", [self]).index(self)
         stem = f"{Path(self.argv[0]).name}.{idx}" if idx else Path(self.argv[0]).name
         shm_path = host_dir / f"{stem}.shm"
-        self.chan = abi.ShmChannel(str(shm_path), seed=self._proc_seed(api))
+        exp = getattr(getattr(api, "engine", None), "cfg", None)
+        exp = exp.experimental if exp is not None else None
+        self.chan = abi.ShmChannel(
+            str(shm_path),
+            seed=self._proc_seed(api),
+            sndbuf=exp.socket_send_buffer if exp else 131072,
+            rcvbuf=exp.socket_recv_buffer if exp else 174760,
+        )
         self.chan.set_clock(stime.sim_to_emu(api.now))
         self._strace_mode = self._cfg_strace_mode(api)
         if self._strace_mode != "off":
@@ -303,6 +310,8 @@ class ManagedApp:
                 self._op_getpeername(api, req)
             elif op == abi.OP_SOCKERR:
                 self._op_sockerr(api, req)
+            elif op == abi.OP_FIONREAD:
+                self._op_fionread(api, req)
             elif op == abi.OP_CLOSE:
                 self._op_close(api, req)
             else:
@@ -469,14 +478,17 @@ class ManagedApp:
             self._reply(api, "send", -EPIPE)
             return True
         n = sock.sim.send(data)
-        if n > 0:
+        if n:
             api.count("managed_tcp_tx_bytes", n)
+        if n == len(data):
             self._reply(api, "send", n)
             return True
         if nonblock:
-            self._reply(api, "send", -EAGAIN)
+            # nonblocking: partial is a valid return; nothing queued = EAGAIN
+            self._reply(api, "send", n if n > 0 else -EAGAIN)
             return True
-        self._park(api, ("send", vfd, data), None)
+        # blocking send returns only once the whole chunk is queued
+        self._park(api, ("send", vfd, data[n:], len(data)), None)
         return False
 
     def _udp_send(self, api: HostApi, sock: _VSocket, req, data: bytes) -> None:
@@ -561,8 +573,13 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "shutdown", -EBADF)
             return
-        if sock.kind == "tcp" and sock.sim is not None and how in (1, 2):
-            sock.sim.close()  # SHUT_WR / SHUT_RDWR: send our FIN
+        if sock.kind != "tcp" or sock.sim is None:
+            self._reply(api, "shutdown", -ENOTCONN)
+            return
+        if how in (0, 2):  # SHUT_RD / SHUT_RDWR: further reads return EOF
+            sock.sim.tcp.shutdown_recv()
+        if how in (1, 2):  # SHUT_WR / SHUT_RDWR: send our FIN
+            sock.sim.close()
         self._reply(api, "shutdown", 0)
 
     def _op_getsockname(self, api: HostApi, req) -> None:
@@ -600,6 +617,19 @@ class ManagedApp:
         if sock.kind == "tcp" and sock.sim is not None:
             err = _tcp_errno(sock.sim.tcp)
         self._reply(api, "sockerr", 0, args=[0, err])
+
+    def _op_fionread(self, api: HostApi, req) -> None:
+        sock = self.sockets.get(req.args[0])
+        if sock is None:
+            self._reply(api, "fionread", -EBADF)
+            return
+        if sock.kind == "udp":
+            n = len(sock.queue[0][2]) if sock.queue else 0
+        elif sock.kind == "tcp" and sock.sim is not None:
+            n = sock.sim.tcp.available()
+        else:
+            n = 0
+        self._reply(api, "fionread", 0, args=[0, n])
 
     def _op_close(self, api: HostApi, req) -> None:
         vfd = req.args[0]
@@ -747,11 +777,15 @@ class ManagedApp:
                 self._service(api)
                 return
             n = sock.sim.send(b[2])
-            if n > 0:
-                self._blocked = None
+            if n:
                 api.count("managed_tcp_tx_bytes", n)
-                self._reply(api, "send", n)
+            rest = b[2][n:]
+            if not rest:  # whole chunk queued: report the full length
+                self._blocked = None
+                self._reply(api, "send", b[3])
                 self._service(api)
+            elif n:
+                self._blocked = ("send", vfd, rest, b[3])
         elif kind == "connect" and b[1] == vfd:
             sock = self.sockets.get(vfd)
             if sock is None or sock.sim is None:

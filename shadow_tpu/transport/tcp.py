@@ -285,6 +285,10 @@ class TcpState:
             self._snd_buf.extend(data[:take])
         return take
 
+    def available(self) -> int:
+        """Bytes recv() would return right now (FIONREAD)."""
+        return len(self._rcv_buf)
+
     def recv(self, max_len: int) -> bytes:
         """Drain in-order received bytes (empty = would block or EOF;
         distinguish via poll())."""

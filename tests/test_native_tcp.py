@@ -166,6 +166,43 @@ def test_tcp_run_twice_identical(tmp_path):
     assert report.records > 40
 
 
+def test_resolver_client(tmp_path):
+    # connect by HOSTNAME: the shim's getaddrinfo resolves "srv" against
+    # the simulation's hosts file; gethostname reports the simulated name
+    cfg = ConfigOptions.from_yaml(
+        _yaml(
+            tmp_path,
+            "server, '7000', '1'",
+            [("rclient, srv, '7000'", "100ms")],
+        )
+    )
+    Simulation(cfg).run()
+    out = _read(tmp_path, "cli0")
+    assert f"rclient cli0 resolved srv={_srv_ip(1)} echoed=128" in out
+
+
+def test_big_write_waitall_fionread_sleep(tmp_path):
+    # one blocking write() larger than the 64 KiB channel payload must
+    # report the full count; MSG_WAITALL must assemble the whole echo;
+    # poll(NULL,0,50) must advance simulated (not wall) time; FIONREAD > 0
+    cfg = ConfigOptions.from_yaml(
+        _yaml(
+            tmp_path,
+            "server, '7000', '1'",
+            [(f"bigclient, {_srv_ip(1)}, '7000', '150000'", "100ms")],
+            stop="30s",
+        )
+    )
+    result = Simulation(cfg).run()
+    out = _read(tmp_path, "cli0")
+    assert "bigclient done bytes=150000" in out
+    assert "slept_ms=" in out
+    slept = int(out.split("slept_ms=")[1].split()[0])
+    assert slept >= 50  # the sleep advanced simulated time
+    assert "avail_gt0=1" in out
+    assert result.counters["managed_tcp_tx_bytes"] >= 300000
+
+
 def test_strace_logging(tmp_path):
     yaml = _yaml(
         tmp_path,
