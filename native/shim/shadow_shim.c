@@ -663,10 +663,27 @@ int getsockopt(int fd, int level, int optname, void *optval, socklen_t *optlen) 
     int value;
     if (level == SOL_SOCKET) {
         switch (optname) {
+            case SO_LINGER:   /* struct-valued: zeroed = disabled/none */
+            case SO_RCVTIMEO:
+            case SO_SNDTIMEO: {
+                if (optval && optlen) {
+                    size_t want = optname == SO_LINGER
+                                      ? sizeof(struct linger)
+                                      : sizeof(struct timeval);
+                    size_t n = *optlen < want ? *optlen : want;
+                    memset(optval, 0, n);
+                    *optlen = (socklen_t)n;
+                }
+                return 0;
+            }
             case SO_SNDBUF: value = (int)g_shm->sock_sndbuf; break;
             case SO_RCVBUF: value = (int)g_shm->sock_rcvbuf; break;
             case SO_TYPE:
                 value = vfd_stream[fd] ? SOCK_STREAM : SOCK_DGRAM;
+                break;
+            case SO_DOMAIN: value = AF_INET; break;
+            case SO_PROTOCOL:
+                value = vfd_stream[fd] ? IPPROTO_TCP : IPPROTO_UDP;
                 break;
             case SO_ACCEPTCONN: value = vfd_listening[fd]; break;
             case SO_REUSEADDR:
@@ -763,6 +780,8 @@ static int poll_ns(struct pollfd *fds, nfds_t nfds, int64_t timeout_ns) {
     if (!any_virtual) {
         if (timeout_ns < 0) /* intentional forever-block on real fds */
             return real_poll(fds, nfds, -1);
+        if (timeout_ns == 0) /* non-blocking probe: no wall block possible */
+            return real_poll(fds, nfds, 0);
         /* poll-as-sleep (nfds==0) or real-only sets with a timeout: park
          * in SIMULATED time so the rest of the simulation keeps running */
         if (any_real) {
@@ -847,7 +866,7 @@ int select(int nfds, fd_set *rd, fd_set *wr, fd_set *ex, struct timeval *tv) {
         int64_t tns = tv ? (int64_t)tv->tv_sec * 1000000000ll +
                                (int64_t)tv->tv_usec * 1000ll
                          : -1;
-        if (tns < 0) return real_select(nfds, rd, wr, ex, tv);
+        if (tns <= 0) return real_select(nfds, rd, wr, ex, tv);
         if (any_real) {
             static int warned2;
             if (!warned2++)
@@ -1104,7 +1123,11 @@ int getaddrinfo(const char *node, const char *service,
     }
 
     int socktype = hints && hints->ai_socktype ? hints->ai_socktype : SOCK_STREAM;
-    struct addrinfo *ai = calloc(1, sizeof(*ai) + sizeof(struct sockaddr_in));
+    const char *canon = node ? node : "localhost";
+    size_t canon_len =
+        (hints && (hints->ai_flags & AI_CANONNAME)) ? strlen(canon) + 1 : 0;
+    struct addrinfo *ai =
+        calloc(1, sizeof(*ai) + sizeof(struct sockaddr_in) + canon_len);
     if (!ai) return EAI_MEMORY;
     struct sockaddr_in *sin = (struct sockaddr_in *)(ai + 1);
     sin->sin_family = AF_INET;
@@ -1115,6 +1138,11 @@ int getaddrinfo(const char *node, const char *service,
     ai->ai_protocol = socktype == SOCK_DGRAM ? IPPROTO_UDP : IPPROTO_TCP;
     ai->ai_addrlen = sizeof(struct sockaddr_in);
     ai->ai_addr = (struct sockaddr *)sin;
+    if (canon_len) {
+        char *cn = (char *)(sin + 1);
+        memcpy(cn, canon, canon_len);
+        ai->ai_canonname = cn;
+    }
     *res = ai;
     return 0;
 }

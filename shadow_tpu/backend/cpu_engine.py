@@ -89,6 +89,7 @@ class Host:
         self.up_bucket = TokenBucket(rate=up_rate, burst=up_burst)
         self.down_bucket = TokenBucket(rate=dn_rate, burst=dn_burst)
         self.codel = CoDel()
+        self.pcap = None  # PcapWriter when HostOptions.pcap_enabled
         self.send_seq = 0  # per-host packet counter (RNG counter + FIFO prio)
         self.local_seq = 0  # per-host local-event counter
         self.app_draws = 0  # APP_STREAM counter
@@ -234,6 +235,12 @@ class CpuEngine:
             self.runahead,
         ) = build_world(cfg)
         self.node_index = self.routing.host_node_index
+        # dynamic runahead (runahead.rs:44-118): the window may widen to the
+        # smallest latency actually used so far (>= the static minimum);
+        # packets record their path latency as they are sent
+        self.dynamic_runahead = cfg.experimental.use_dynamic_runahead
+        self._min_used_lat: Optional[int] = None
+        self._runahead_floor = max(cfg.experimental.runahead or 0, 1)
         self.hosts = [
             Host(hid, hopt.hostname, self, int(bw_up_arr[hid]), int(bw_dn_arr[hid]))
             for hid, hopt in enumerate(cfg.hosts)
@@ -248,6 +255,21 @@ class CpuEngine:
                 host.push_local(
                     p.start_time, Task(lambda h, a=app: _start_app(h, a), label="start")
                 )
+
+        # per-host pcap capture (interface.rs:45-75; host option
+        # pcap_enabled, configuration.rs:602-612)
+        if any(h.pcap_enabled for h in cfg.hosts):
+            from pathlib import Path as _Path
+
+            from ..utils.pcap import PcapWriter
+
+            for hid, hopt in enumerate(cfg.hosts):
+                if hopt.pcap_enabled:
+                    self.hosts[hid].pcap = PcapWriter(
+                        _Path(cfg.general.data_directory)
+                        / "hosts" / hopt.hostname / "eth0.pcap",
+                        snaplen=hopt.pcap_capture_size,
+                    )
 
         # managed (real-binary) processes resolve simulated names through an
         # /etc/hosts-style file (the reference passes plugins a memfd hosts
@@ -287,8 +309,18 @@ class CpuEngine:
         bits = (size_bytes + FRAME_OVERHEAD_BYTES) * 8
         t_dep = src_host.up_bucket.charge(t, bits)
 
+        if src_host.pcap is not None:  # outbound capture at departure
+            src_host.pcap.capture(
+                stime.sim_to_emu(t_dep), self.ips.by_host[s],
+                self.ips.by_host[d], size_bytes, payload,
+            )
+
         # loss (skipped during bootstrap)
         lat_ns, thresh = self.routing.path(s, d)
+        if self.dynamic_runahead and (
+            self._min_used_lat is None or lat_ns < self._min_used_lat
+        ):
+            self._min_used_lat = lat_ns
         if t >= self.bootstrap_end and thresh > 0:
             u = int(rng_mod.rand_u32(self.seed, s | rng_mod.LOSS_STREAM, seq))
             if u < thresh:
@@ -321,6 +353,11 @@ class CpuEngine:
         self.event_log.append(
             LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DELIVERED)
         )
+        if dst_host.pcap is not None:  # inbound capture at delivery
+            dst_host.pcap.capture(
+                stime.sim_to_emu(t_deliver), self.ips.by_host[ev.src_host],
+                self.ips.by_host[dst_host.host_id], size_bytes, payload,
+            )
         dst_host.queue.push(
             Event(
                 t_deliver,
@@ -336,6 +373,16 @@ class CpuEngine:
     def next_event_time(self) -> int:
         return min((h.queue.next_time() for h in self.hosts), default=stime.NEVER)
 
+    def current_runahead(self) -> int:
+        """Window width for the next round.  Static mode: the precomputed
+        min possible latency.  Dynamic mode: the min latency of paths used
+        so far (never below the configured floor) — wider windows while
+        only slow paths carry traffic, exactly the reference's
+        use_dynamic_runahead law (runahead.rs:44-57)."""
+        if not self.dynamic_runahead or self._min_used_lat is None:
+            return self.runahead
+        return max(self._min_used_lat, self._runahead_floor, 1)
+
     def finalize(self) -> None:
         """End-of-simulation teardown: reap managed processes still parked
         past stop_time (the reference kills plugins at teardown too,
@@ -345,6 +392,8 @@ class CpuEngine:
                 shutdown = getattr(app, "shutdown", None)
                 if shutdown is not None:
                     shutdown()
+            if h.pcap is not None:
+                h.pcap.close()
 
     def describe_next_window(self, until: int) -> list[tuple[str, int, list[int]]]:
         """Hosts with events before ``until`` + native PIDs of their managed
@@ -373,7 +422,7 @@ class CpuEngine:
             start = self.next_event_time()
             if start >= self.stop_time or start == stime.NEVER:
                 break
-            self.window_end = min(start + self.runahead, self.stop_time)
+            self.window_end = min(start + self.current_runahead(), self.stop_time)
             pl = self.perf_log
             if pl is not None:
                 active = sum(

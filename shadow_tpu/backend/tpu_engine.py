@@ -78,11 +78,22 @@ class TpuEngine:
         init_events: list[tuple[int, int, int, int, int, int]] = []  # lane,t,kind,src,seq,size
         local_seq0 = np.ones(n, dtype=np.int64)
 
+        if cfg.experimental.use_dynamic_runahead:
+            raise LaneCompatError(
+                "use_dynamic_runahead is cpu-backend only for now (the lane "
+                "round program uses a static window width)"
+            )
         for hid, hopt in enumerate(cfg.hosts):
             if len(hopt.processes) > 1:
                 raise LaneCompatError(
                     f"host {hopt.hostname!r} has {len(hopt.processes)} processes; "
                     "the lane backend supports at most one per host"
+                )
+            if hopt.pcap_enabled:
+                raise LaneCompatError(
+                    f"host {hopt.hostname!r} enables pcap capture; packet "
+                    "bytes live on device in the lane backend — use the cpu "
+                    "backend for pcap"
                 )
             if not hopt.processes:
                 model[hid] = lanes.M_NONE

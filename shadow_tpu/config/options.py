@@ -37,6 +37,12 @@ class ConfigError(ValueError):
     pass
 
 
+# socket buffer defaults, single-sourced for the config dataclass, the shim
+# shared-memory block, and the managed-process manager
+SOCKET_SEND_BUFFER_DEFAULT = 131072
+SOCKET_RECV_BUFFER_DEFAULT = 174760
+
+
 @dataclasses.dataclass
 class GeneralOptions:
     stop_time: int = 0  # ns; required > 0
@@ -75,8 +81,8 @@ class ExperimentalOptions:
     use_worker_spinning: bool = True
     # transport knobs
     use_new_tcp: bool = False
-    socket_send_buffer: int = 131072  # bytes
-    socket_recv_buffer: int = 174760
+    socket_send_buffer: int = SOCKET_SEND_BUFFER_DEFAULT  # bytes
+    socket_recv_buffer: int = SOCKET_RECV_BUFFER_DEFAULT
     interface_qdisc: str = "fifo"  # | "round-robin"
     # strace-style logging
     strace_logging_mode: str = "off"  # off | standard | deterministic

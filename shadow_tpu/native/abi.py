@@ -107,8 +107,15 @@ def futex_wake(addr: int) -> None:
 class ShmChannel:
     """Manager-side view of one plugin's shared-memory block."""
 
-    def __init__(self, path: str, seed: int, sndbuf: int = 131072,
-                 rcvbuf: int = 174760) -> None:
+    def __init__(self, path: str, seed: int, sndbuf: int | None = None,
+                 rcvbuf: int | None = None) -> None:
+        from ..config.options import (
+            SOCKET_RECV_BUFFER_DEFAULT,
+            SOCKET_SEND_BUFFER_DEFAULT,
+        )
+
+        sndbuf = SOCKET_SEND_BUFFER_DEFAULT if sndbuf is None else sndbuf
+        rcvbuf = SOCKET_RECV_BUFFER_DEFAULT if rcvbuf is None else rcvbuf
         size = ctypes.sizeof(ShimShmem)
         with open(path, "wb") as f:
             f.truncate(size)

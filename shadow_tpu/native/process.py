@@ -158,8 +158,8 @@ class ManagedApp:
         self.chan = abi.ShmChannel(
             str(shm_path),
             seed=self._proc_seed(api),
-            sndbuf=exp.socket_send_buffer if exp else 131072,
-            rcvbuf=exp.socket_recv_buffer if exp else 174760,
+            sndbuf=exp.socket_send_buffer if exp else None,
+            rcvbuf=exp.socket_recv_buffer if exp else None,
         )
         self.chan.set_clock(stime.sim_to_emu(api.now))
         self._strace_mode = self._cfg_strace_mode(api)
@@ -573,7 +573,14 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "shutdown", -EBADF)
             return
-        if sock.kind != "tcp" or sock.sim is None:
+        if sock.kind == "udp":
+            self._reply(api, "shutdown",
+                        0 if sock.default_dst is not None else -ENOTCONN)
+            return
+        if sock.kind == "listen":
+            self._reply(api, "shutdown", 0)
+            return
+        if sock.sim is None:
             self._reply(api, "shutdown", -ENOTCONN)
             return
         if how in (0, 2):  # SHUT_RD / SHUT_RDWR: further reads return EOF

@@ -256,3 +256,68 @@ def test_out_of_range_numeric_peer_rejected():
                 "  b: {}\n"
             )
         ).run()
+
+
+class TestDynamicRunahead:
+    YAML = """
+general: {stop_time: 2s, seed: 3}
+experimental: {use_dynamic_runahead: true}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 2 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 1 latency "2 ms" ]
+        edge [ source 0 target 2 latency "50 ms" ]
+        edge [ source 1 target 2 latency "50 ms" ]
+      ]
+hosts:
+  a: {network_node_id: 0, processes: [{path: tgen-client, args: [--server, c, --interval, 5ms, --size, "200"]}]}
+  b: {network_node_id: 1}
+  c: {network_node_id: 2, processes: [{path: tgen-server}]}
+"""
+
+    def test_dynamic_widens_window_on_slow_paths(self):
+        # only the 50ms path carries traffic: dynamic mode needs far fewer
+        # rounds than the static 2ms window
+        from shadow_tpu.config.options import ConfigOptions
+
+        dyn = CpuEngine(ConfigOptions.from_yaml(self.YAML))
+        assert dyn.dynamic_runahead
+        rdyn = dyn.run()
+        static_yaml = self.YAML.replace("use_dynamic_runahead: true",
+                                        "use_dynamic_runahead: false")
+        stat = CpuEngine(ConfigOptions.from_yaml(static_yaml))
+        rstat = stat.run()
+        assert dyn.current_runahead() == 50_000_000
+        assert stat.current_runahead() == 2_000_000
+        assert rdyn.rounds < rstat.rounds / 5
+        # traffic still flows and is deterministic
+        assert rdyn.counters["tgen_recv_bytes"] > 0
+        from shadow_tpu.engine.determinism import compare_results
+
+        rdyn2 = CpuEngine(ConfigOptions.from_yaml(self.YAML)).run()
+        assert compare_results(rdyn, rdyn2).identical
+
+    def test_floor_respected(self):
+        from shadow_tpu.config.options import ConfigOptions
+
+        cfg = ConfigOptions.from_yaml(self.YAML)
+        cfg.experimental.runahead = 80_000_000  # floor above every latency
+        eng = CpuEngine(cfg)
+        eng.run()
+        assert eng.current_runahead() >= 80_000_000
+
+    def test_lane_backend_rejects_dynamic(self):
+        import pytest
+
+        from shadow_tpu.backend.tpu_engine import LaneCompatError, TpuEngine
+        from shadow_tpu.config.options import ConfigOptions
+
+        cfg = ConfigOptions.from_yaml(self.YAML)
+        with pytest.raises(LaneCompatError, match="dynamic"):
+            TpuEngine(cfg)
