@@ -90,6 +90,17 @@ class Host:
         self.down_bucket = TokenBucket(rate=dn_rate, burst=dn_burst)
         self.codel = CoDel()
         self.pcap = None  # PcapWriter when HostOptions.pcap_enabled
+        # cross-host packet inbox: worker threads of OTHER hosts append
+        # here under the lock; drained into the queue at the round barrier
+        # (the push_packet_to_host discipline, worker.rs:603-615)
+        import threading
+
+        self.inbox: list = []
+        self.inbox_lock = threading.Lock()
+        # per-host event-log buffer + min-used-latency, merged at the
+        # barrier in host-id order so results are worker-count-invariant
+        self.log_buf: list = []
+        self.min_used_lat: Optional[int] = None
         self.send_seq = 0  # per-host packet counter (RNG counter + FIFO prio)
         self.local_seq = 0  # per-host local-event counter
         self.app_draws = 0  # APP_STREAM counter
@@ -318,25 +329,25 @@ class CpuEngine:
         # loss (skipped during bootstrap)
         lat_ns, thresh = self.routing.path(s, d)
         if self.dynamic_runahead and (
-            self._min_used_lat is None or lat_ns < self._min_used_lat
+            src_host.min_used_lat is None or lat_ns < src_host.min_used_lat
         ):
-            self._min_used_lat = lat_ns
+            src_host.min_used_lat = lat_ns
         if t >= self.bootstrap_end and thresh > 0:
             u = int(rng_mod.rand_u32(self.seed, s | rng_mod.LOSS_STREAM, seq))
             if u < thresh:
-                self.event_log.append(LogRecord(t, s, d, seq, size_bytes, DROP_LOSS))
+                src_host.log_buf.append(LogRecord(t, s, d, seq, size_bytes, DROP_LOSS))
                 return seq
 
         arr = max(t_dep + lat_ns, self.window_end)
-        self.hosts[d].queue.push(
-            Event(
-                arr,
-                EventKind.PACKET,
-                src_host=s,
-                seq=seq,
-                data=(size_bytes, payload),
-            )
+        ev = Event(
+            arr, EventKind.PACKET, src_host=s, seq=seq, data=(size_bytes, payload)
         )
+        dst = self.hosts[d]
+        if dst is src_host:
+            dst.queue.push(ev)  # self-traffic never crosses threads
+        else:
+            with dst.inbox_lock:
+                dst.inbox.append(ev)
         return seq
 
     def inbound(self, dst_host: Host, ev: Event) -> None:
@@ -346,11 +357,11 @@ class CpuEngine:
         t_deliver = dst_host.down_bucket.charge(ev.time, bits)
         sojourn = t_deliver - ev.time
         if dst_host.codel.offer(t_deliver, sojourn):
-            self.event_log.append(
+            dst_host.log_buf.append(
                 LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DROP_CODEL)
             )
             return
-        self.event_log.append(
+        dst_host.log_buf.append(
             LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DELIVERED)
         )
         if dst_host.pcap is not None:  # inbound capture at delivery
@@ -372,6 +383,23 @@ class CpuEngine:
 
     def next_event_time(self) -> int:
         return min((h.queue.next_time() for h in self.hosts), default=stime.NEVER)
+
+    def _barrier_merge(self) -> None:
+        """Round barrier: drain cross-host inboxes into queues, merge
+        per-host log buffers and min-used latencies — all in host-id order
+        so any worker count produces identical results."""
+        for h in self.hosts:
+            if h.inbox:
+                for ev in h.inbox:
+                    h.queue.push(ev)
+                h.inbox.clear()
+            if h.log_buf:
+                self.event_log.extend(h.log_buf)
+                h.log_buf.clear()
+            if h.min_used_lat is not None:
+                if self._min_used_lat is None or h.min_used_lat < self._min_used_lat:
+                    self._min_used_lat = h.min_used_lat
+                h.min_used_lat = None
 
     def current_runahead(self) -> int:
         """Window width for the next round.  Static mode: the precomputed
@@ -417,6 +445,31 @@ class CpuEngine:
         next_event_time)`` runs after every round — the seam where the
         facade hangs heartbeats, perf telemetry, and run-control pauses
         (and through which RestartRequest propagates)."""
+        from ..engine.scheduler import HostScheduler
+        from ..native.process import ManagedApp
+
+        exp = self.cfg.experimental
+        parallelism = self.cfg.general.parallelism
+        if parallelism == 0 and exp.scheduler != "thread-per-host":
+            # default "all cores" engages only where threads can help:
+            # managed OS processes (futex waits release the GIL); pure
+            # Python model hosts run serial to skip pool overhead
+            has_managed = any(
+                isinstance(a, ManagedApp) for h in self.hosts for a in h.apps
+            )
+            parallelism = 0 if has_managed else 1
+        scheduler = HostScheduler(
+            self.hosts,
+            parallelism=parallelism,
+            policy=exp.scheduler,
+            pin_cpus=exp.use_cpu_pinning,
+        )
+        try:
+            return self._run_rounds(scheduler, on_window)
+        finally:
+            scheduler.shutdown()
+
+    def _run_rounds(self, scheduler, on_window) -> "SimResult":
         t0 = wall_time.perf_counter()
         while True:
             start = self.next_event_time()
@@ -428,8 +481,8 @@ class CpuEngine:
                 active = sum(
                     1 for h in self.hosts if h.queue.next_time() < self.window_end
                 )
-            for host in self.hosts:  # id order; serial == deterministic
-                host.execute(self.window_end)
+            scheduler.run_round(self.window_end)
+            self._barrier_merge()
             self.rounds += 1
             if pl is not None or on_window is not None:
                 next_ev = self.next_event_time()

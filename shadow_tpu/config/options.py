@@ -296,6 +296,13 @@ class ConfigOptions:
             raise ConfigError("general.stop_time must be > 0")
         if self.experimental.network_backend not in ("cpu", "tpu"):
             raise ConfigError("experimental.network_backend must be cpu|tpu")
+        if self.experimental.scheduler not in (
+            "thread-per-core",
+            "thread-per-host",
+        ):
+            raise ConfigError(
+                "experimental.scheduler must be thread-per-core|thread-per-host"
+            )
         names = [h.hostname for h in self.hosts]
         if len(set(names)) != len(names):
             raise ConfigError("duplicate hostnames")

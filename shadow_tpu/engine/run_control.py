@@ -256,6 +256,9 @@ class PerfLog:
         self._out = out  # None = whatever sys.stderr is at emit time
         self.host_exec_calls = 0
         self.host_exec_total_ns = 0
+        import threading
+
+        self._lock = threading.Lock()  # host_exec is called by worker threads
 
     @property
     def _sink(self) -> TextIO:
@@ -277,12 +280,15 @@ class PerfLog:
         )
 
     def host_exec(self, hostname: str, elapsed_ns: int, window_end: int) -> None:
-        self.host_exec_calls += 1
-        self.host_exec_total_ns += elapsed_ns
-        if self.host_exec_calls % self.HOST_EXEC_LOG_EVERY == 0:
+        with self._lock:
+            self.host_exec_calls += 1
+            self.host_exec_total_ns += elapsed_ns
+            calls = self.host_exec_calls
+            total = self.host_exec_total_ns
+        if calls % self.HOST_EXEC_LOG_EVERY == 0:
             print(
-                f"[host-exec-agg] calls={self.host_exec_calls} "
-                f"total_ns={self.host_exec_total_ns} last_ns={elapsed_ns} "
+                f"[host-exec-agg] calls={calls} "
+                f"total_ns={total} last_ns={elapsed_ns} "
                 f"host={hostname} window_end_abs_ns={window_end}",
                 file=self._sink,
                 flush=True,

@@ -93,10 +93,11 @@ class Simulation:
             self._write_data(result, total)
         return result
 
-    def _make_on_window(self, describe_source, runahead: int, t0: float):
+    def _make_on_window(self, describe_source, runahead, t0: float):
         """Compose the per-round callback: heartbeat lines + run-control
         boundary processing.  ``describe_source(until)`` names the hosts
-        with events before ``until`` (for the pause console)."""
+        with events before ``until`` (for the pause console).  ``runahead``
+        is an int or a live callable (dynamic runahead widens it)."""
         heartbeat = self.cfg.general.heartbeat_interval
         rc = self.run_control
         if not heartbeat and rc is None:
@@ -117,7 +118,8 @@ class Simulation:
             if rc is not None:
                 # next_ev == NEVER means no next window: describe nothing
                 # rather than listing every idle host
-                until = next_ev + runahead if next_ev < stime.NEVER else 0
+                ra = runahead() if callable(runahead) else runahead
+                until = next_ev + ra if next_ev < stime.NEVER else 0
                 rc.at_window_boundary(
                     window_start,
                     window_end,
@@ -136,7 +138,7 @@ class Simulation:
             engine.perf_log = PerfLog()
         t0 = time.perf_counter()
         on_window = self._make_on_window(
-            engine.describe_next_window, engine.runahead, t0
+            engine.describe_next_window, engine.current_runahead, t0
         )
         try:
             return engine.run(on_window=on_window)

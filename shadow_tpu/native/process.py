@@ -90,7 +90,7 @@ class _VSocket:
     shim — a reserved real kernel fd, so it can't collide in the plugin)."""
 
     __slots__ = ("vfd", "kind", "port", "default_dst", "queue", "sim",
-                 "listener", "accept_q")
+                 "listener", "accept_q", "recv_shut")
 
     def __init__(self, vfd: int, kind: str) -> None:
         self.vfd = vfd
@@ -101,6 +101,7 @@ class _VSocket:
         self.sim = None  # SimTcpSocket (tcp)
         self.listener = None  # SimTcpListener (listen)
         self.accept_q: list = []  # SimTcpSockets awaiting accept()
+        self.recv_shut = False  # SHUT_RD: reads return EOF / accept EINVAL
 
 
 class ManagedApp:
@@ -426,6 +427,9 @@ class ManagedApp:
         if sock is None or sock.kind != "listen":
             self._reply(api, "accept", -EBADF if sock is None else -EINVAL)
             return True
+        if sock.recv_shut:
+            self._reply(api, "accept", -EINVAL)  # shut-down listener
+            return True
         if sock.accept_q:
             self._complete_accept(api, vfd, child_fd)
             return True
@@ -522,6 +526,9 @@ class ManagedApp:
             if sock.queue:
                 self._reply_udp_recv(api, vfd, max_len)
                 return True
+            if sock.recv_shut:
+                self._reply(api, "recvfrom", 0)  # SHUT_RD: EOF
+                return True
             if nonblock:
                 self._reply(api, "recvfrom", -EAGAIN)
                 return True
@@ -574,11 +581,18 @@ class ManagedApp:
             self._reply(api, "shutdown", -EBADF)
             return
         if sock.kind == "udp":
-            self._reply(api, "shutdown",
-                        0 if sock.default_dst is not None else -ENOTCONN)
+            if sock.default_dst is None:
+                self._reply(api, "shutdown", -ENOTCONN)
+                return
+            if how in (0, 2):
+                sock.recv_shut = True  # further reads drain then EOF
+            self._reply(api, "shutdown", 0)
+            self._wake_after_shutdown(api, vfd)
             return
         if sock.kind == "listen":
+            sock.recv_shut = True  # a parked/future accept fails (EINVAL)
             self._reply(api, "shutdown", 0)
+            self._wake_after_shutdown(api, vfd)
             return
         if sock.sim is None:
             self._reply(api, "shutdown", -ENOTCONN)
@@ -588,6 +602,12 @@ class ManagedApp:
         if how in (1, 2):  # SHUT_WR / SHUT_RDWR: send our FIN
             sock.sim.close()
         self._reply(api, "shutdown", 0)
+
+    def _wake_after_shutdown(self, api: HostApi, vfd: int) -> None:
+        """shutdown() from a sibling's service turn can unblock a call the
+        plugin parked earlier (single-threaded plugins can't be parked when
+        they call shutdown themselves, but the wake is harmless)."""
+        self._socket_activity(api, vfd)
 
     def _op_getsockname(self, api: HostApi, req) -> None:
         sock = self.sockets.get(req.args[0])
@@ -688,7 +708,7 @@ class ManagedApp:
             return abi.POLLNVAL
         ready = 0
         if sock.kind == "udp":
-            if sock.queue:
+            if sock.queue or sock.recv_shut:
                 ready |= abi.POLLIN
             ready |= abi.POLLOUT
         elif sock.kind == "listen":
@@ -742,9 +762,15 @@ class ManagedApp:
         kind = b[0]
         if kind == "recvfrom" and b[1] == vfd:
             sock = self.sockets.get(vfd)
-            if sock is not None and sock.queue:
+            if sock is None:
+                return
+            if sock.queue:
                 self._blocked = None
                 self._reply_udp_recv(api, vfd, b[2])
+                self._service(api)
+            elif sock.recv_shut:
+                self._blocked = None
+                self._reply(api, "recvfrom", 0)
                 self._service(api)
         elif kind == "recv" and b[1] == vfd:
             sock = self.sockets.get(vfd)
@@ -808,7 +834,13 @@ class ManagedApp:
                 self._service(api)
         elif kind == "accept" and b[1] == vfd:
             sock = self.sockets.get(vfd)
-            if sock is not None and sock.accept_q:
+            if sock is None:
+                return
+            if sock.recv_shut:
+                self._blocked = None
+                self._reply(api, "accept", -EINVAL)
+                self._service(api)
+            elif sock.accept_q:
                 child_fd = b[2]
                 self._blocked = None
                 self._complete_accept(api, vfd, child_fd)

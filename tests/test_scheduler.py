@@ -1,0 +1,107 @@
+"""Host scheduler (scheduler crate analog): parallel rounds stay
+bit-identical to serial execution for any worker count and policy."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from shadow_tpu.backend.cpu_engine import CpuEngine
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.determinism import compare_results
+from shadow_tpu.engine.scheduler import HostScheduler
+
+REPO = Path(__file__).resolve().parents[1]
+
+MESH = """
+general: {stop_time: 300ms, seed: 17, parallelism: %d}
+experimental: {scheduler: "%s"}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 0 latency "3 ms" packet_loss 0.05 ]
+      ]
+hosts:
+  m: {count: 8, network_node_id: 0, processes: [{path: tgen-mesh, args: [--interval, 5ms, --size, "700"]}]}
+"""
+
+
+def _run(parallelism, policy="thread-per-core"):
+    return CpuEngine(ConfigOptions.from_yaml(MESH % (parallelism, policy))).run()
+
+
+def test_worker_counts_bit_identical():
+    serial = _run(1)
+    assert len(serial.event_log) > 200
+    for workers in (2, 4, 8):
+        report = compare_results(serial, _run(workers))
+        assert report.identical, f"{workers} workers: {report.describe()}"
+
+
+def test_thread_per_host_policy():
+    report = compare_results(_run(1), _run(0, policy="thread-per-host"))
+    assert report.identical, report.describe()
+
+
+def test_scheduler_worker_sizing():
+    s = HostScheduler([object()] * 10, parallelism=4)
+    assert s.workers == 4
+    s.shutdown()
+    s = HostScheduler([object()] * 3, parallelism=8)
+    assert s.workers == 3  # never more workers than hosts
+    s.shutdown()
+    s = HostScheduler([object()] * 5, parallelism=0, policy="thread-per-host")
+    assert s.workers == 5
+    s.shutdown()
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+    )
+
+
+def test_managed_processes_parallel_identical(native_build, tmp_path):
+    # real OS processes on 4 hosts driven by 4 workers: futex waits release
+    # the GIL, so this exercises true concurrency on the managed path
+    build = REPO / "native" / "build"
+    yaml = f"""
+general: {{stop_time: 3s, seed: 23, parallelism: %d, data_directory: {tmp_path}/d%d, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  c1:
+    network_node_id: 0
+    processes:
+      - path: {build / 'tcpecho'}
+        args: [client, 11.0.0.4, "7000", "3", "1000", "7"]
+        start_time: 100ms
+  c2:
+    network_node_id: 0
+    processes:
+      - path: {build / 'tcpecho'}
+        args: [client, 11.0.0.4, "7000", "2", "500", "11"]
+        start_time: 130ms
+  p1:
+    network_node_id: 0
+    processes:
+      - path: {build / 'pingpong'}
+        args: [client, 11.0.0.4, "9000", "3", "64"]
+        start_time: 200ms
+  srv:
+    network_node_id: 0
+    processes:
+      - path: {build / 'tcpecho'}
+        args: [server, "7000", "2"]
+      - path: {build / 'pingpong'}
+        args: [server, "9000", "3"]
+"""
+    serial = CpuEngine(ConfigOptions.from_yaml(yaml % (1, 1))).run()
+    par = CpuEngine(ConfigOptions.from_yaml(yaml % (4, 4))).run()
+    report = compare_results(serial, par)
+    assert report.identical, report.describe()
+    assert serial.counters["managed_procs"] == 5
