@@ -47,6 +47,7 @@ class Simulation:
                 # alike; a stdin already drained for the config just EOFs
                 self.run_control.start_stdin_thread()
         self.restarts = 0
+        self.engine = None  # the backend engine of the most recent run()
 
     # -- running -----------------------------------------------------------
 
@@ -133,7 +134,7 @@ class Simulation:
         return on_window
 
     def _run_cpu(self) -> SimResult:
-        engine = CpuEngine(self.cfg)
+        engine = self.engine = CpuEngine(self.cfg)
         if self.cfg.experimental.perf_logging:
             engine.perf_log = PerfLog()
         t0 = time.perf_counter()
@@ -149,7 +150,7 @@ class Simulation:
     def _run_tpu(self) -> SimResult:
         from ..backend.tpu_engine import TpuEngine
 
-        engine = TpuEngine(self.cfg)
+        engine = self.engine = TpuEngine(self.cfg)
         mesh_shape = self.cfg.experimental.tpu_mesh_shape
         if mesh_shape is not None and len(mesh_shape) == 1 and mesh_shape[0] > 1:
             import jax

@@ -1,0 +1,38 @@
+"""Helper tooling for driving simulations from Python and the shell.
+
+The shadowtools analog (reference ``shadowtools/``): typed config builders
+(``shadowtools.config``'s TypedDicts) and a streamlined one-shot runner
+(``shadowtools.shadow_exec``).
+
+- :mod:`shadow_tpu.tools.config` — TypedDicts mirroring the YAML document
+  shape, for generating configs from Python with IDE/type-checker support.
+- :func:`shadow_tpu.tools.shadow_exec` — run one command (or model) in a
+  single-host simulation and get its stdout back, like the reference's
+  ``shadow-exec date`` giving ``Sat Jan  1 00:00:00 GMT 2000``.
+- :class:`shadow_tpu.tools.SimData` — typed access to a finished run's
+  data directory (sim-stats, per-host stdout/strace/pcap/counters).
+"""
+
+from .config import (
+    ConfigDict,
+    GeneralDict,
+    GraphDict,
+    HostDict,
+    NetworkDict,
+    ProcessDict,
+    make_config,
+)
+from .exec import ExecResult, SimData, shadow_exec
+
+__all__ = [
+    "ConfigDict",
+    "GeneralDict",
+    "GraphDict",
+    "HostDict",
+    "NetworkDict",
+    "ProcessDict",
+    "make_config",
+    "ExecResult",
+    "SimData",
+    "shadow_exec",
+]

@@ -1,0 +1,7 @@
+"""``python -m shadow_tpu.tools [options] -- CMD [ARGS...]`` — shadow-exec."""
+
+import sys
+
+from .exec import main
+
+sys.exit(main())

@@ -1,0 +1,178 @@
+"""One-shot simulation runner + typed results access.
+
+``shadow_exec`` is the shadowtools.shadow_exec analog: run one command (a
+real binary under the shim, or a built-in model) in a single-host
+simulation and get its output back — e.g. a real ``date`` binary prints
+``Sat Jan  1 00:00:00 GMT 2000``, the simulation's epoch, exactly like
+the reference's ``shadow-exec date`` example.
+
+``SimData`` wraps a finished run's data directory (the reference's
+``shadow.data/``): sim-stats, per-host stdout/strace/pcap/counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shlex
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+from ..config.options import ConfigOptions
+
+
+class SimData:
+    """Typed access to a simulation data directory."""
+
+    def __init__(self, data_dir: str | Path) -> None:
+        self.path = Path(data_dir)
+
+    def stats(self) -> dict:
+        return json.loads((self.path / "sim-stats.json").read_text())
+
+    def hosts(self) -> list[str]:
+        d = self.path / "hosts"
+        return sorted(p.name for p in d.iterdir() if p.is_dir()) if d.exists() else []
+
+    def host_dir(self, hostname: str) -> Path:
+        return self.path / "hosts" / hostname
+
+    def stdout(self, hostname: str, process_stem: str) -> str:
+        return (self.host_dir(hostname) / f"{process_stem}.stdout").read_text(
+            errors="replace"  # managed stdout can carry arbitrary bytes
+        )
+
+    def strace(self, hostname: str, process_stem: str) -> str:
+        return (self.host_dir(hostname) / f"{process_stem}.strace").read_text()
+
+    def pcap_path(self, hostname: str) -> Path:
+        return self.host_dir(hostname) / "eth0.pcap"
+
+    def counters(self, hostname: str) -> dict:
+        p = self.host_dir(hostname) / "counters.json"
+        return json.loads(p.read_text()) if p.exists() else {}
+
+
+@dataclasses.dataclass
+class ExecResult:
+    """What shadow_exec hands back for the single process it ran."""
+
+    stdout: str
+    exit_code: Optional[int]
+    sim_stats: dict
+    data: Optional[SimData]  # None when the temp data dir was discarded
+
+    @property
+    def ok(self) -> bool:
+        return self.exit_code == 0
+
+
+def shadow_exec(
+    argv: list[str] | str,
+    stop_time: str | int = "60s",
+    seed: int = 1,
+    data_directory: Optional[str | Path] = None,
+    environment: Optional[dict[str, str]] = None,
+    config_extra: Optional[dict] = None,
+) -> ExecResult:
+    """Run one command in a single-host simulation and return its output.
+
+    ``argv`` names a real binary (absolute path — runs under the native
+    shim) or a built-in model.  The host is named ``host0``.  Without
+    ``data_directory`` the run uses a temp dir that is deleted afterwards
+    (pass one to keep strace/pcap artifacts, like shadow-exec's
+    ``--preserve``)."""
+    if isinstance(argv, str):
+        argv = shlex.split(argv)
+    path, args = argv[0], argv[1:]
+    keep = data_directory is not None
+    data_dir = Path(data_directory) if keep else Path(tempfile.mkdtemp(prefix="shadow-exec-"))
+    doc = {
+        "general": {
+            "stop_time": stop_time,
+            "seed": seed,
+            "data_directory": str(data_dir),
+            "heartbeat_interval": None,
+        },
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "hosts": {
+            "host0": {
+                "network_node_id": 0,
+                "processes": [
+                    {
+                        "path": path,
+                        "args": args,
+                        **({"environment": environment} if environment else {}),
+                    }
+                ],
+            }
+        },
+    }
+    for key, val in (config_extra or {}).items():
+        doc.setdefault(key, {}).update(val)
+    cfg = ConfigOptions.from_dict(doc)
+
+    from ..engine.sim import Simulation
+
+    sim = Simulation(cfg)
+    sim.run()  # dispatches on experimental.network_backend, writes data
+
+    stem = Path(path).name
+    stdout_path = data_dir / "hosts" / "host0" / f"{stem}.stdout"
+    stdout = (
+        stdout_path.read_text(errors="replace") if stdout_path.exists() else ""
+    )
+    exit_code: Optional[int] = 0
+    host0 = sim.engine.hosts[0] if getattr(sim.engine, "hosts", None) else None
+    app = host0.apps[0] if host0 is not None and host0.apps else None
+    if app is not None and hasattr(app, "exit_code"):
+        exit_code = app.exit_code
+    stats = json.loads((data_dir / "sim-stats.json").read_text())
+    if keep:
+        return ExecResult(stdout, exit_code, stats, SimData(data_dir))
+    shutil.rmtree(data_dir, ignore_errors=True)
+    return ExecResult(stdout, exit_code, stats, None)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """``python -m shadow_tpu.tools.exec [options] -- CMD [ARGS...]`` —
+    the shadow-exec CLI (reference shadowtools/src/shadowtools/shadow_exec.py)."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="shadow-exec",
+        description="Run one command in a single-host simulation and print "
+        "its output (a real binary sees the simulated clock/network).",
+    )
+    p.add_argument("--stop-time", default="60s")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument(
+        "--preserve",
+        metavar="DIR",
+        default=None,
+        help="keep the data directory at DIR (strace/pcap/stats)",
+    )
+    p.add_argument("command", nargs=argparse.REMAINDER, help="-- CMD [ARGS...]")
+    ns = p.parse_args(argv)
+    cmd = ns.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given")
+    res = shadow_exec(
+        cmd,
+        stop_time=ns.stop_time,
+        seed=ns.seed,
+        data_directory=ns.preserve,
+    )
+    sys.stdout.write(res.stdout)
+    return res.exit_code or 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
