@@ -1,0 +1,142 @@
+"""Microbenchmark of lane-kernel pieces on the current default device.
+
+Times (a) the full fused bench at small sim duration, (b) isolated device
+kernels with the bench's shapes: the cross-lane flat sort, the merge row
+sort, one scan-slot's elementwise math, threefry draws.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import shadow_tpu  # noqa: F401
+from shadow_tpu.backend.tpu_engine import TpuEngine
+from shadow_tpu.config.presets import flagship_mesh_config
+
+N, K, C = 10_000, 4, 16
+NEVER = (1 << 62)
+
+
+def timeit(name, fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:36s} {dt*1e3:8.3f} ms")
+    return dt
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    m = N * K
+
+    # (1) cross-lane flat sort: [m] single-key, 4 operands
+    dst = jax.random.randint(key, (m,), 0, N, dtype=jnp.int32)
+    t64 = jax.random.randint(key, (m,), 0, 1 << 40, dtype=jnp.int64)
+    aux = jax.random.randint(key, (m,), 0, 1 << 60, dtype=jnp.int64)
+    sz = jax.random.randint(key, (m,), 0, 1500, dtype=jnp.int32)
+
+    flat_sort = jax.jit(
+        lambda d, t, a, s: lax.sort((d, t, a, s), dimension=0, num_keys=1)
+    )
+    timeit("cross flat sort [40k] 4ops", flat_sort, dst, t64, aux, sz)
+
+    # (2) merge row sort: [N, C+2K+C] 2-key, 3 operands
+    w = C + 2 * K + C
+    mt = jax.random.randint(key, (N, w), 0, 1 << 40, dtype=jnp.int64)
+    ma = jax.random.randint(key, (N, w), 0, 1 << 60, dtype=jnp.int64)
+    ms = jax.random.randint(key, (N, w), 0, 1500, dtype=jnp.int32)
+    row_sort = jax.jit(
+        lambda t, a, s: lax.sort((t, a, s), dimension=1, num_keys=2)
+    )
+    timeit(f"merge row sort [N,{w}] 3ops", row_sort, mt, ma, ms)
+
+    # (2b) narrower row sort [N, 24]
+    mt2, ma2, ms2 = mt[:, :24], ma[:, :24], ms[:, :24]
+    timeit("row sort [N,24] 3ops", row_sort, mt2, ma2, ms2)
+
+    # (2c) row sort [N, C] (the no-merge re-sort path)
+    timeit("row sort [N,16] 3ops", row_sort, mt[:, :C], ma[:, :C], ms[:, :C])
+
+    # (3) threefry draw [N]
+    from shadow_tpu.core import rng as rng_mod
+
+    ctr = jnp.arange(N, dtype=jnp.int64)
+    tf = jax.jit(lambda c: rng_mod.rand_u32(7, jnp.uint32(3), c, xp=jnp))
+    timeit("threefry [N]", tf, ctr)
+
+    # (4) searchsorted + window gather
+    from shadow_tpu.backend.lanes import _window_gather
+
+    srt = jnp.sort(dst)
+    gather = jax.jit(
+        lambda d, t, a, s: _window_gather(
+            [t, a, s],
+            jnp.searchsorted(d, jnp.arange(N, dtype=d.dtype)).astype(jnp.int32),
+            C,
+        )
+    )
+    timeit("searchsorted+window gather", gather, srt, t64, aux, sz)
+
+    # (5) full bench, 1 sim-second
+    cfg = flagship_mesh_config(N, sim_seconds=1, queue_capacity=C, pops_per_round=K)
+    eng = TpuEngine(cfg, log_capacity=0)
+    res = eng.run(mode="device", precompile=True)
+    print(
+        f"full bench 1 sim-s: wall={res.wall_seconds:.3f}s rounds={res.rounds} "
+        f"-> {res.wall_seconds/max(res.rounds,1)*1e3:.3f} ms/round, "
+        f"rate={res.sim_seconds_per_wall_second:.2f} sim-s/s"
+    )
+
+
+if __name__ == "__main__":
+    main()
+
+
+def bisect():
+    """Time one jitted round, one jitted iteration, and its halves."""
+    import shadow_tpu.backend.lanes as lanes
+
+    cfg = flagship_mesh_config(N, sim_seconds=1, queue_capacity=C, pops_per_round=K)
+    eng = TpuEngine(cfg, log_capacity=0)
+    p, tb = eng.params, eng.tables
+    s0 = eng.initial_state()
+    round_fn = jax.jit(lanes._build_round(p, tb))
+    s1, _ = round_fn(s0)
+    jax.block_until_ready(s1)
+    timeit("one full round (jit)", lambda s: round_fn(s)[0], s1)
+
+    # one iteration's pieces on a live state
+    def pops(s):
+        we = jnp.min(s.q_time) + p.runahead
+        popped = {
+            "time": s.q_time[:, :K],
+            "aux": s.q_aux[:, :K],
+            "size": s.q_size[:, :K],
+        }
+        slots = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), popped)
+
+        def scan_body(carry, slot_cols):
+            st, emit = lanes._process_slot(p, tb, carry, slot_cols, we)
+            return st, emit
+
+        s, emits = lax.scan(scan_body, s, slots)
+        return s, emits
+
+    scan_fn = jax.jit(lambda s: pops(s)[0])
+    timeit("scan K slots (jit)", scan_fn, s1)
+
+    merge_fn = jax.jit(lambda s: lanes._merge_append(p, *pops(s))[0])
+    timeit("scan + merge (jit)", merge_fn, s1)
+
+
+bisect()

@@ -108,6 +108,7 @@ class Host:
         self.counters: dict[str, int] = {}
         self.now = 0  # current event time while executing
         self._net = None  # lazy HostNetStack (TCP tier)
+        self._passive = None  # lazy: all apps passive_delivery (or no apps)
 
     # -- HostApi ----------------------------------------------------------
 
@@ -148,6 +149,18 @@ class Host:
     @property
     def hosts_file_path(self):
         return self.engine.hosts_file_path
+
+    @property
+    def passive_delivery(self) -> bool:
+        """True when every app's delivery handling is counters-only (or the
+        host has no apps): plain-model deliveries are then applied inline at
+        packet arrival and the DELIVERY queue event is elided — identical
+        elision on the lane backend keeps the backends bit-compatible."""
+        if self._passive is None:
+            self._passive = all(
+                getattr(a, "passive_delivery", False) for a in self.apps
+            )
+        return self._passive
 
     @property
     def net(self):
@@ -369,6 +382,19 @@ class CpuEngine:
                 stime.sim_to_emu(t_deliver), self.ips.by_host[ev.src_host],
                 self.ips.by_host[dst_host.host_id], size_bytes, payload,
             )
+        if payload is None and dst_host.passive_delivery:
+            # passive fast path: counters apply now; no DELIVERY event.
+            # now anchors at delivery time so even a contract-violating app
+            # behaves like the queued path (the pop loop reassigns now per
+            # event, so this is safe)
+            dst_host.now = t_deliver
+            for app in dst_host.apps:
+                dst_host._current_app = app
+                app.on_delivery(
+                    dst_host, t_deliver, ev.src_host, ev.seq, size_bytes,
+                    payload=None,
+                )
+            return
         dst_host.queue.push(
             Event(
                 t_deliver,

@@ -64,6 +64,12 @@ NEVER = stime.NEVER
 # lane-supported app models
 M_NONE, M_PHOLD, M_TGEN_MESH, M_TGEN_CLIENT, M_TGEN_SERVER, M_PING_CLIENT, M_PING_SERVER = range(7)
 
+# models whose delivery handling is PASSIVE (counters only — no sends, no
+# timers): their DELIVERY events are elided and applied inline at packet
+# arrival, exactly like the CPU engine's passive-delivery fast path; both
+# backends elide identically so event logs stay bit-identical
+PASSIVE_MODELS = frozenset({M_NONE, M_TGEN_MESH, M_TGEN_CLIENT, M_TGEN_SERVER})
+
 # ---- packed aux word: kind(2b) | src(17b) | seq(44b), sign bit clear ------
 AUX_SEQ_BITS = 44
 AUX_SRC_BITS = 17
@@ -149,6 +155,13 @@ class LaneParams:
     bootstrap_end: int
     runahead: int
     bucket_interval: int = DEFAULT_INTERVAL_NS
+    # models present in this simulation (static): absent models' slot logic
+    # is dropped at trace time — the branchless cascade only pays for what
+    # the config uses
+    models_present: tuple = tuple(range(7))
+    # static: any edge with packet_loss > 0?  loss-free graphs skip the
+    # per-send threefry draw entirely
+    has_loss: bool = True
 
     def __post_init__(self) -> None:
         if self.n_lanes > MAX_LANES:
@@ -323,11 +336,13 @@ def _process_slot(
 ) -> tuple[LaneState, _SlotEmit]:
     """Process one popped queue column (all lanes, masked by kind)."""
     n = p.n_lanes
+    mp = set(p.models_present)
     lanes = jnp.arange(n, dtype=jnp.int32)
     t = slot["time"]
     kind, src, seq = unpack_aux(slot["aux"])
     size = slot["size"]
     active = t < window_end
+    false_n = jnp.zeros(n, dtype=bool)
 
     i64 = jnp.int64
     i32 = jnp.int32
@@ -348,8 +363,19 @@ def _process_slot(
         n_delivered=s.n_delivered + deliver,
     )
 
-    # DELIVERY self-insert keyed by the packet's (src, seq)
-    ins_valid = deliver
+    # passive lanes consume the delivery inline (counters only); active
+    # lanes get a DELIVERY self-insert keyed by the packet's (src, seq)
+    model = tb.model
+    passive = false_n
+    for _m in sorted(PASSIVE_MODELS & mp):
+        passive = passive | (model == _m)
+    inline_del = deliver & passive
+    s = s._replace(
+        recv_bytes=s.recv_bytes
+        + jnp.where(inline_del & (model != M_NONE), size.astype(i64), 0)
+    )
+    all_passive = mp <= PASSIVE_MODELS
+    ins_valid = false_n if all_passive else (deliver & ~passive)
     ins_time = t_del
     ins_aux = pack_aux(DELIVERY, src, seq)
     ins_size = size
@@ -358,22 +384,16 @@ def _process_slot(
     pk_rec_valid = is_pkt
     pk_rec_outcome = jnp.where(codel_drop, DROP_CODEL, DELIVERED).astype(i32)
 
-    # ---- DELIVERY pops: app on_delivery ---------------------------------
+    # ---- DELIVERY pops: app on_delivery (non-passive models only; the
+    # passive ones were consumed inline at packet arrival above) ----------
     is_del = active & (kind == DELIVERY)
-    model = tb.model
-    s = s._replace(
-        recv_bytes=s.recv_bytes
-        + jnp.where(
-            is_del
-            & ((model == M_TGEN_MESH) | (model == M_TGEN_CLIENT) | (model == M_TGEN_SERVER)),
-            size.astype(i64),
-            0,
-        )
-    )
     # phold: send to a random peer; ping server: echo back to src
-    del_send_phold = is_del & (model == M_PHOLD)
-    del_send_echo = is_del & (model == M_PING_SERVER)
-    s = s._replace(n_hops=s.n_hops + (is_del & (model == M_PHOLD)))
+    del_send_phold = (is_del & (model == M_PHOLD)) if M_PHOLD in mp else false_n
+    del_send_echo = (
+        (is_del & (model == M_PING_SERVER)) if M_PING_SERVER in mp else false_n
+    )
+    if M_PHOLD in mp:
+        s = s._replace(n_hops=s.n_hops + (is_del & (model == M_PHOLD)))
 
     # ---- LOCAL pops (start markers / timers / phold initial messages) ----
     # size == -1 marks a process-start event: it anchors the first window at
@@ -382,30 +402,47 @@ def _process_slot(
     is_loc = active & (kind == LOCAL)
     is_start = is_loc & (size == -1)
     is_timer = is_loc & ~is_start
-    loc_send_phold = is_timer & (model == M_PHOLD)
-    mesh_tick = is_timer & (model == M_TGEN_MESH) & (n > 1)
-    client_tick = is_timer & (model == M_TGEN_CLIENT)
-    ping_tick = is_timer & (model == M_PING_CLIENT) & (s.m_sent < tb.p_count)
+    loc_send_phold = (is_timer & (model == M_PHOLD)) if M_PHOLD in mp else false_n
+    mesh_tick = (
+        (is_timer & (model == M_TGEN_MESH) & (n > 1))
+        if M_TGEN_MESH in mp
+        else false_n
+    )
+    client_tick = (
+        (is_timer & (model == M_TGEN_CLIENT)) if M_TGEN_CLIENT in mp else false_n
+    )
+    ping_tick = (
+        (is_timer & (model == M_PING_CLIENT) & (s.m_sent < tb.p_count))
+        if M_PING_CLIENT in mp
+        else false_n
+    )
 
     # ---- unified send channel (≤1 send per lane per slot) ----------------
     send_phold = del_send_phold | loc_send_phold
     do_send = send_phold | del_send_echo | mesh_tick | client_tick | ping_tick
 
-    # phold peer draw (consumes an app draw only where it happens)
-    draw = rand_u32_lane(
-        p.seed, (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.APP_STREAM)), s.app_draws
-    )
-    r = rng_mod.u32_below(draw, max(n - 1, 1), xp=jnp).astype(i32)
-    phold_dst = jnp.where(n == 1, lanes, (lanes + 1 + r) % n)
-    s = s._replace(app_draws=s.app_draws + send_phold)
+    # phold peer draw (consumes an app draw only where it happens; traced
+    # only when phold lanes exist — the threefry is ~50 ops per slot)
+    if M_PHOLD in mp:
+        draw = rand_u32_lane(
+            p.seed, (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.APP_STREAM)), s.app_draws
+        )
+        r = rng_mod.u32_below(draw, max(n - 1, 1), xp=jnp).astype(i32)
+        phold_dst = jnp.where(n == 1, lanes, (lanes + 1 + r) % n)
+        s = s._replace(app_draws=s.app_draws + send_phold)
+    else:
+        phold_dst = lanes
 
     # tgen-mesh round-robin peer
-    mesh_off = (s.m_peer_offset % max(n - 1, 1)).astype(i32)
-    mesh_dst = (lanes + 1 + mesh_off) % n
-    s = s._replace(
-        m_peer_offset=s.m_peer_offset + jnp.where(mesh_tick, tb.p_stride, 0),
-        m_sent=s.m_sent + (client_tick | ping_tick),
-    )
+    if M_TGEN_MESH in mp:
+        mesh_off = (s.m_peer_offset % max(n - 1, 1)).astype(i32)
+        mesh_dst = (lanes + 1 + mesh_off) % n
+        s = s._replace(
+            m_peer_offset=s.m_peer_offset + jnp.where(mesh_tick, tb.p_stride, 0)
+        )
+    else:
+        mesh_dst = lanes
+    s = s._replace(m_sent=s.m_sent + (client_tick | ping_tick))
 
     dst = jnp.where(
         send_phold,
@@ -430,17 +467,20 @@ def _process_slot(
     )
     s = s._replace(up_tokens=up_tokens, up_next_refill=up_next, up_last_depart=up_last)
 
-    # loss (bootstrap window is loss-free)
-    u = rand_u32_lane(
-        p.seed, (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
-        snd_seq,
-    ).astype(jnp.uint64)
+    # loss (bootstrap window is loss-free; loss-free graphs skip the draw)
     my_node = tb.node_of
     dst_node = tb.node_of[dst]
-    thresh = tb.thresh[my_node, dst_node]
     lat = tb.lat[my_node, dst_node]
-    lost = do_send & (t >= p.bootstrap_end) & (u.astype(i64) < thresh)
-    s = s._replace(n_loss=s.n_loss + lost)
+    if p.has_loss:
+        u = rand_u32_lane(
+            p.seed, (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
+            snd_seq,
+        ).astype(jnp.uint64)
+        thresh = tb.thresh[my_node, dst_node]
+        lost = do_send & (t >= p.bootstrap_end) & (u.astype(i64) < thresh)
+        s = s._replace(n_loss=s.n_loss + lost)
+    else:
+        lost = false_n
 
     arr = jnp.maximum(t_dep + lat, window_end)
     out_valid = do_send & ~lost
@@ -629,8 +669,13 @@ def _append_log(p: LaneParams, s: LaneState, recs: dict) -> LaneState:
     )
 
 
-def _build_round(p: LaneParams, tb: LaneTables):
-    """Build the raw (un-jitted) one-round advance: state -> (state, done)."""
+def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
+    """Build the raw (un-jitted) one-round advance: state -> (state, done).
+
+    ``guard_done=True`` (the step driver) preserves the pre-round state when
+    the simulation already finished — a full-state ``where``.  The fused
+    full-run loop terminates via its own ``cond`` instead and skips that
+    copy (``guard_done=False``)."""
 
     k = p.pops_per_iter
 
@@ -651,11 +696,30 @@ def _build_round(p: LaneParams, tb: LaneTables):
 
         def scan_body(carry, slot_cols):
             st = carry
-            st, emit = _process_slot(p, tb, st, slot_cols, window_end)
+
+            def live(st_):
+                return _process_slot(p, tb, st_, slot_cols, window_end)
+
+            def dead(st_):
+                nb = jnp.zeros(p.n_lanes, dtype=bool)
+                z64 = jnp.zeros(p.n_lanes, dtype=jnp.int64)
+                z32 = jnp.zeros(p.n_lanes, dtype=jnp.int32)
+                return st_, _SlotEmit(
+                    nb, z64, z64, z32,
+                    nb, z64, z64,
+                    nb, z32, z64, z64, z32,
+                    nb, z64, z64, z64, z64, z64, z64,
+                )
+
+            st, emit = lax.cond(
+                jnp.any(slot_cols["time"] < window_end), live, dead, st
+            )
             return st, emit
 
         slots = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), popped)  # [K, N]
-        s, emits = lax.scan(scan_body, s, slots)
+        # full unroll: K is small and static; unrolling removes the scan
+        # loop's per-step kernel boundaries so XLA fuses across slots
+        s, emits = lax.scan(scan_body, s, slots, unroll=k)
 
         # the merge (exchange + wide row sort) is the expensive step; on
         # iterations that generated no events (e.g. windows that only pop
@@ -686,22 +750,23 @@ def _build_round(p: LaneParams, tb: LaneTables):
         return s
 
     def round_fn(s: LaneState) -> tuple[LaneState, jnp.ndarray]:
-        start = jnp.min(s.q_time)
+        start = jnp.min(s.q_time[:, 0])  # rows sorted: col 0 is the min
         done = start >= p.stop_time
         window_end = jnp.minimum(start + p.runahead, p.stop_time)
         s = s._replace(now_window_end=window_end)
 
         def cond(st: LaneState):
-            return jnp.min(st.q_time) < st.now_window_end
+            return jnp.min(st.q_time[:, 0]) < st.now_window_end
 
         def body(st: LaneState):
             return iter_body(st)
 
         s2 = lax.while_loop(cond, body, s)
         s2 = s2._replace(rounds=s2.rounds + 1)
-        # keep the pre-round state when already done
-        s_out = jax.tree.map(lambda a, b: jnp.where(done, a, b), s, s2)
-        return s_out, done
+        if guard_done:
+            # keep the pre-round state when already done
+            s2 = jax.tree.map(lambda a, b: jnp.where(done, a, b), s, s2)
+        return s2, done
 
     return round_fn
 
@@ -714,20 +779,19 @@ def make_round_fn(p: LaneParams, tb: LaneTables):
 
 def _build_full_run(p: LaneParams, tb: LaneTables):
     """Raw (un-jitted) full-simulation run: ``lax.while_loop`` over rounds,
-    entirely on-device.  Shared by the single-device and sharded drivers."""
-    round_fn = _build_round(p, tb)
+    entirely on-device.  Shared by the single-device and sharded drivers.
+    Termination rides the loop cond (queues drained or stop time reached),
+    so the round body never needs the full-state done-guard copy."""
+    round_fn = _build_round(p, tb, guard_done=False)
 
     def full_run(s: LaneState) -> LaneState:
-        def cond(carry):
-            _, done = carry
-            return ~done
+        def cond(st: LaneState):
+            return jnp.min(st.q_time[:, 0]) < p.stop_time
 
-        def body(carry):
-            st, _ = carry
-            return round_fn(st)
+        def body(st: LaneState):
+            return round_fn(st)[0]
 
-        final, _ = lax.while_loop(cond, body, (s, jnp.bool_(False)))
-        return final
+        return lax.while_loop(cond, body, s)
 
     return full_run
 

@@ -148,6 +148,7 @@ class TpuEngine:
                 "initial events per lane (+8 headroom)"
             )
 
+        node_idx, lat, thresh = self.routing.device_tables()
         if log_capacity is None:
             log_capacity = 200_000
         self.params = lanes.LaneParams(
@@ -159,9 +160,10 @@ class TpuEngine:
             stop_time=cfg.general.stop_time,
             bootstrap_end=cfg.general.bootstrap_end_time,
             runahead=runahead,
+            models_present=tuple(sorted(set(int(x) for x in model))),
+            has_loss=bool(np.any(np.asarray(thresh) > 0)),
         )
 
-        node_idx, lat, thresh = self.routing.device_tables()
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)
         dn = np.array([bucket_params(int(b)) for b in bw_dn], dtype=np.int64)
         self.tables = lanes.LaneTables(
@@ -262,9 +264,16 @@ class TpuEngine:
         ``wall_seconds`` measures only the steady-state device program."""
         state = self.initial_state()
         if mode == "device":
-            run_fn = lanes.make_run_fn(self.params, self.tables)
-            if precompile:
-                run_fn = run_fn.lower(state).compile()
+            # cache the program: repeat runs (bench best-of-N) must not
+            # retrace/recompile
+            run_fn = getattr(self, "_run_fn", None)
+            if run_fn is None:
+                run_fn = self._run_fn = lanes.make_run_fn(self.params, self.tables)
+            if precompile and getattr(self, "_compiled", None) is None:
+                # AOT-compile so the timed run is the steady-state program
+                self._compiled = run_fn.lower(state).compile()
+            if getattr(self, "_compiled", None) is not None:
+                run_fn = self._compiled
             t0 = wall_time.perf_counter()
             state = jax.block_until_ready(run_fn(state))
             wall = wall_time.perf_counter() - t0

@@ -24,6 +24,11 @@ from .base import HostApi, parse_kv_args, register_model
 
 @register_model("tgen-mesh")
 class TgenMesh:
+    # delivery handling is counters-only: the engine may apply it inline at
+    # packet arrival and skip the DELIVERY queue event (both backends elide
+    # identically, keeping event logs bit-identical)
+    passive_delivery = True
+
     def __init__(self, interval_ns: int, size: int = 1428, stride: int = 1) -> None:
         self.interval = interval_ns
         self.size = size
@@ -60,6 +65,8 @@ class TgenClient:
     """``--server H`` destination host id (or hostname resolved by the
     engine), ``--interval``, ``--size``."""
 
+    passive_delivery = True
+
     def __init__(self, server: str, interval_ns: int, size: int = 1428) -> None:
         self.server = server
         self.interval = interval_ns
@@ -91,6 +98,8 @@ class TgenClient:
 
 @register_model("tgen-server")
 class TgenServer:
+    passive_delivery = True
+
     @classmethod
     def from_args(cls, args: list[str]) -> "TgenServer":
         parse_kv_args(args, known=set())  # accepts no args

@@ -200,7 +200,24 @@ def test_non_power_of_two_capacity_parity():
 
 
 def test_overflow_raises_loudly():
-    yaml = BOTTLENECK.replace("tpu_lane_queue_capacity: 1024", "tpu_lane_queue_capacity: 16")
+    # 40 synchronized senders blast one sink: the sink lane receives a
+    # >capacity burst in a single window and must raise, not diverge
+    yaml = """
+general: {stop_time: 100ms, seed: 2}
+experimental: {tpu_lane_queue_capacity: 9}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+      ]
+hosts:
+  c: {count: 40, network_node_id: 0, processes: [{path: tgen-client, args: [--server, sink, --interval, 5ms, --size, "300"]}]}
+  sink: {network_node_id: 0}
+"""
     from shadow_tpu.backend.tpu_engine import TpuEngine as TE
 
     with pytest.raises(RuntimeError, match="lane-queue overflow"):
