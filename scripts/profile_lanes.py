@@ -98,10 +98,6 @@ def main():
     )
 
 
-if __name__ == "__main__":
-    main()
-
-
 def bisect():
     """Time one jitted round, one jitted iteration, and its halves."""
     import shadow_tpu.backend.lanes as lanes
@@ -139,4 +135,6 @@ def bisect():
     timeit("scan + merge (jit)", merge_fn, s1)
 
 
-bisect()
+if __name__ == "__main__":
+    main()
+    bisect()

@@ -143,6 +143,10 @@ def main(argv: list[str] | None = None) -> int:
     if ns.event_log:
         path = sim.write_event_log(result)
         print(f"event log: {path}", file=sys.stderr)
+    if result.process_errors:
+        for err in result.process_errors:
+            print(f"process error: {err}", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -271,6 +271,8 @@ class CpuEngine:
         ]
 
         # app models scheduled at their start times
+        from ..native.process import ManagedApp as _ManagedApp
+
         for hid, hopt in enumerate(cfg.hosts):
             host = self.hosts[hid]
             for p in hopt.processes:
@@ -279,6 +281,16 @@ class CpuEngine:
                 host.push_local(
                     p.start_time, Task(lambda h, a=app: _start_app(h, a), label="start")
                 )
+                if isinstance(app, _ManagedApp):
+                    app.configure_lifecycle(p.expected_final_state, p.shutdown_signal)
+                    if p.shutdown_time is not None:
+                        host.push_local(
+                            p.shutdown_time,
+                            Task(
+                                lambda h, a=app: a.deliver_shutdown(h),
+                                label="shutdown",
+                            ),
+                        )
 
         # per-host pcap capture (interface.rs:45-75; host option
         # pcap_enabled, configuration.rs:602-612)
@@ -440,7 +452,8 @@ class CpuEngine:
     def finalize(self) -> None:
         """End-of-simulation teardown: reap managed processes still parked
         past stop_time (the reference kills plugins at teardown too,
-        manager.rs end-of-sim)."""
+        manager.rs end-of-sim), then check every process's final state
+        against expected_final_state (worker.rs:475-481)."""
         for h in self.hosts:
             for app in h.apps:
                 shutdown = getattr(app, "shutdown", None)
@@ -448,6 +461,14 @@ class CpuEngine:
                     shutdown()
             if h.pcap is not None:
                 h.pcap.close()
+        self.process_errors = []
+        for h in self.hosts:
+            for app in h.apps:
+                check = getattr(app, "final_state_matches", None)
+                if check is not None:
+                    err = check()
+                    if err is not None:
+                        self.process_errors.append(f"host {h.hostname}: {err}")
 
     def describe_next_window(self, until: int) -> list[tuple[str, int, list[int]]]:
         """Hosts with events before ``until`` + native PIDs of their managed
@@ -532,6 +553,7 @@ class CpuEngine:
             event_log=self.event_log,
             counters=counters,
             per_host_counters=[dict(h.counters) for h in self.hosts],
+            process_errors=list(getattr(self, "process_errors", [])),
         )
 
 
@@ -548,6 +570,9 @@ class SimResult:
     event_log: list[LogRecord]
     counters: dict[str, int]
     per_host_counters: list[dict[str, int]]
+    # expected_final_state mismatches; a non-empty list makes the CLI exit
+    # nonzero (controller.rs:70-74)
+    process_errors: list[str] = dataclasses.field(default_factory=list)
 
     def log_tuples(self) -> list[tuple[int, int, int, int, int, int]]:
         """Canonical ordered event log for determinism diffs."""

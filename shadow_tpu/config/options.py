@@ -318,6 +318,24 @@ def _opt_time(v: Any) -> Optional[int]:
     return None if v is None else units.parse_time(v)
 
 
+def _parse_signal(v: Any, host: str) -> str:
+    """Validate a signal name (or number) at parse time — a typo'd
+    shutdown_signal must not silently become SIGTERM."""
+    import signal as _sig
+
+    if isinstance(v, int):
+        try:
+            return _sig.Signals(v).name
+        except ValueError:
+            raise ConfigError(f"host {host!r}: unknown signal number {v}")
+    name = str(v).upper()
+    if not name.startswith("SIG"):
+        name = "SIG" + name
+    if not hasattr(_sig, name) or not isinstance(getattr(_sig, name), _sig.Signals):
+        raise ConfigError(f"host {host!r}: unknown shutdown_signal {v!r}")
+    return name
+
+
 def _parse_host(name: str, doc: dict[str, Any]) -> HostOptions:
     doc = dict(doc)
     procs = []
@@ -333,7 +351,7 @@ def _parse_host(name: str, doc: dict[str, Any]) -> HostOptions:
                 environment={str(k): str(v) for k, v in p.pop("environment", {}).items()},
                 start_time=units.parse_time(p.pop("start_time", 0)),
                 shutdown_time=_opt_time(p.pop("shutdown_time", None)),
-                shutdown_signal=str(p.pop("shutdown_signal", "SIGTERM")),
+                shutdown_signal=_parse_signal(p.pop("shutdown_signal", "SIGTERM"), name),
                 expected_final_state=p.pop("expected_final_state", {"exited": 0}),
             )
         )

@@ -81,6 +81,8 @@ class Simulation:
                 if self.run_control is not None:
                     self.run_control.arm_after_restart(rr.run_until_ns)
         total = time.perf_counter() - t0
+        for err in result.process_errors:
+            log.error("process final-state mismatch: %s", err)
         log.info(
             "simulation done: %s simulated in %.2fs wall (%.2fx real time), "
             "%d rounds, %d log records",

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import os
+import signal as _signal
 import socket as pysocket
 import struct
 import subprocess
@@ -124,6 +125,84 @@ class ManagedApp:
         self._strace_file = None
         self._strace_mode = "off"
         self._api = None  # host handle, set at on_start (needed for teardown)
+        # lifecycle config (ProcessOptions; set via configure_lifecycle)
+        self.expected_final_state = {"exited": 0}
+        self.shutdown_signal = "SIGTERM"
+        # observed final state: ("exited", code) | ("signaled", name) |
+        # ("running",) — None until the process ends
+        self.final_state: Optional[tuple] = None
+
+    def configure_lifecycle(self, expected_final_state, shutdown_signal: str) -> None:
+        """Apply the config's process lifecycle options (the reference's
+        expected_final_state / shutdown_signal, configuration.rs:688-718)."""
+        self.expected_final_state = expected_final_state
+        self.shutdown_signal = shutdown_signal
+
+    def deliver_shutdown(self, api: HostApi) -> None:
+        """Scheduled shutdown_time: send the configured signal to the real
+        process.  Default-fatal signals terminate it (the common server
+        shape: expected_final_state: {signaled: SIGTERM}).  A plugin that
+        CATCHES the signal but then needs sim-serviced I/O cannot make
+        progress (signal handlers run outside the simulation's turn-taking;
+        see docs/managed-processes.md limitations), so after a short grace
+        period it is force-killed and counted as managed_shutdown_forced —
+        final state SIGKILL, honestly reported."""
+        if self.finished or self.proc is None:
+            return
+        signum = getattr(_signal, self.shutdown_signal)
+        try:
+            self.proc.send_signal(signum)
+        except ProcessLookupError:
+            pass
+        self.finished = True
+        self._blocked = None
+        forced = self._reap(grace_s=2)
+        self._release_ports(api)
+        self._close_files()
+        api.count("managed_shutdown_forced" if forced else "managed_shutdown_signaled")
+
+    def _reap(self, grace_s: float = 10) -> bool:
+        """Wait for the process to end (force-kill past the grace period),
+        record exit_code and final_state.  True when the kill was forced."""
+        forced = False
+        try:
+            self.exit_code = self.proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            forced = True
+            self.proc.kill()
+            self.exit_code = self.proc.wait()
+        self._classify_exit()
+        return forced
+
+    def _classify_exit(self) -> None:
+        if self.exit_code is not None and self.exit_code < 0:
+            self.final_state = ("signaled", _signal.Signals(-self.exit_code).name)
+        else:
+            self.final_state = ("exited", self.exit_code or 0)
+
+    def final_state_matches(self) -> Optional[str]:
+        """None if the observed final state matches expected_final_state,
+        else a human-readable mismatch description (the reference turns
+        these into sim errors and a nonzero exit, worker.rs:475-481)."""
+        if self.proc is None and self.final_state is None:
+            return None  # never spawned (start_time past stop_time)
+        exp = self.expected_final_state
+        got = self.final_state or ("running",)
+        if exp == "running" or exp == {"running": None}:
+            ok = got == ("running",)
+        elif isinstance(exp, dict) and "exited" in exp:
+            ok = got == ("exited", int(exp["exited"]))
+        elif isinstance(exp, dict) and "signaled" in exp:
+            want = exp["signaled"]
+            want = want if isinstance(want, str) else _signal.Signals(int(want)).name
+            ok = got == ("signaled", want)
+        elif exp == "exited":  # bare string: any clean exit code
+            ok = got[0] == "exited"
+        else:
+            return f"unrecognized expected_final_state {exp!r}"
+        if ok:
+            return None
+        return f"{Path(self.argv[0]).name}: expected {exp!r}, finished as {got!r}"
 
     # -- host-level port namespace (shared across sibling processes) -------
 
@@ -859,11 +938,7 @@ class ManagedApp:
         self._blocked = None
         self._release_ports(api)
         if self.proc is not None:
-            try:
-                self.exit_code = self.proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.exit_code = self.proc.wait()
+            self._reap()
         self._close_files()
         api.count("managed_exit_unexpected" if unexpected else "managed_exit_clean")
         if unexpected:
@@ -878,6 +953,7 @@ class ManagedApp:
         if self.finished or self.proc is None:
             return
         self.finished = True
+        self.final_state = ("running",)  # alive at stop_time (then reaped)
         self.proc.kill()
         self.exit_code = self.proc.wait()
         if self._api is not None:

@@ -1,0 +1,126 @@
+"""Process lifecycle enforcement: shutdown_time signals and
+expected_final_state checks (configuration.rs:688-718, worker.rs:475-481)."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.sim import Simulation
+
+REPO = Path(__file__).resolve().parents[1]
+BUILD = REPO / "native" / "build"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_build():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+    )
+
+
+def _run(tmp_path, proc_yaml, stop="3s"):
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: {stop}, seed: 4, data_directory: {tmp_path / 'data'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+{proc_yaml}
+"""
+    )
+    return Simulation(cfg).run()
+
+
+def test_clean_exit_matches_default(tmp_path):
+    res = _run(
+        tmp_path,
+        f"""
+      - path: {BUILD / 'pingpong'}
+        args: [client, 11.0.0.1, "9", "0", "1"]
+""",
+    )
+    # pingpong with count 0 exits immediately with 0; default expectation
+    assert res.process_errors == []
+
+
+def test_long_lived_process_flagged_unless_expected_running(tmp_path):
+    # a server parked past stop_time is killed at teardown: final state
+    # "running" mismatches the default {exited: 0} ...
+    res = _run(
+        tmp_path,
+        f"""
+      - path: {BUILD / 'tcpecho'}
+        args: [server, "7000", "5"]
+""",
+    )
+    assert len(res.process_errors) == 1
+    assert "('running',)" in res.process_errors[0]
+    # ... and matches an explicit expected_final_state: running
+    res2 = _run(
+        tmp_path,
+        f"""
+      - path: {BUILD / 'tcpecho'}
+        args: [server, "7000", "5"]
+        expected_final_state: running
+""",
+    )
+    assert res2.process_errors == []
+
+
+def test_shutdown_time_signal(tmp_path):
+    # sleep 1000 would outlive the sim; shutdown_time SIGTERMs it at 1s
+    res = _run(
+        tmp_path,
+        """
+      - path: /bin/sleep
+        args: ["1000"]
+        shutdown_time: 1s
+        expected_final_state: {signaled: SIGTERM}
+""",
+    )
+    assert res.process_errors == []
+    assert res.counters.get("managed_shutdown_signaled") == 1
+
+
+def test_shutdown_signal_mismatch_detected(tmp_path):
+    res = _run(
+        tmp_path,
+        """
+      - path: /bin/sleep
+        args: ["1000"]
+        shutdown_time: 1s
+        expected_final_state: {exited: 0}
+""",
+    )
+    assert len(res.process_errors) == 1
+    assert "SIGTERM" in res.process_errors[0]
+
+
+def test_cli_exits_nonzero_on_mismatch(tmp_path):
+    import sys
+
+    cfg_path = tmp_path / "c.yaml"
+    cfg_path.write_text(
+        f"""
+general: {{stop_time: 2s, data_directory: {tmp_path / 'data'}}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'tcpecho'}
+        args: [server, "7000", "5"]
+"""
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu", str(cfg_path)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 1
+    assert "process error" in proc.stderr
