@@ -53,6 +53,7 @@ from ..core import rng as rng_mod
 from ..core import time as stime
 from ..net import codel as codel_mod
 from ..net.token_bucket import DEFAULT_INTERVAL_NS, FRAME_OVERHEAD_BYTES
+from . import lanes_stream as lstr
 
 # event kinds (must match core.event.EventKind)
 PACKET, LOCAL, DELIVERY = 0, 1, 2
@@ -62,13 +63,15 @@ DELIVERED, DROP_LOSS, DROP_CODEL, DROP_QUEUE = 0, 1, 2, 3
 NEVER = stime.NEVER
 
 # lane-supported app models
-M_NONE, M_PHOLD, M_TGEN_MESH, M_TGEN_CLIENT, M_TGEN_SERVER, M_PING_CLIENT, M_PING_SERVER = range(7)
+(M_NONE, M_PHOLD, M_TGEN_MESH, M_TGEN_CLIENT, M_TGEN_SERVER, M_PING_CLIENT,
+ M_PING_SERVER, M_STREAM_CLIENT, M_STREAM_SERVER) = range(9)
 
 # models whose delivery handling is PASSIVE (counters only — no sends, no
 # timers): their DELIVERY events are elided and applied inline at packet
 # arrival, exactly like the CPU engine's passive-delivery fast path; both
 # backends elide identically so event logs stay bit-identical
 PASSIVE_MODELS = frozenset({M_NONE, M_TGEN_MESH, M_TGEN_CLIENT, M_TGEN_SERVER})
+STREAM_MODELS = frozenset({M_STREAM_CLIENT, M_STREAM_SERVER})
 
 # ---- packed aux word: kind(2b) | src(17b) | seq(44b), sign bit clear ------
 AUX_SEQ_BITS = 44
@@ -106,6 +109,7 @@ class LaneState(NamedTuple):
     q_time: jnp.ndarray  # int64, NEVER = empty slot
     q_aux: jnp.ndarray  # int64 packed (kind, src, seq)
     q_size: jnp.ndarray  # int32
+    q_pay: jnp.ndarray  # int64 opaque payload (stream tier); 0 otherwise
     # per-lane counters [N]
     send_seq: jnp.ndarray  # int64
     local_seq: jnp.ndarray  # int64
@@ -137,6 +141,8 @@ class LaneState(NamedTuple):
     log: jnp.ndarray  # int64 (time, src, dst, seq, size, outcome)
     log_count: jnp.ndarray  # int64 scalar
     log_lost: jnp.ndarray  # int64 scalar: records dropped on log overflow
+    # stream tier (lanes_stream.StreamState columns; zeros when unused)
+    stream: Any
     # round bookkeeping (scalars)
     rounds: jnp.ndarray  # int64
     now_window_end: jnp.ndarray  # int64 (current round's end)
@@ -158,10 +164,14 @@ class LaneParams:
     # models present in this simulation (static): absent models' slot logic
     # is dropped at trace time — the branchless cascade only pays for what
     # the config uses
-    models_present: tuple = tuple(range(7))
+    models_present: tuple = tuple(range(9))
     # static: any edge with packet_loss > 0?  loss-free graphs skip the
     # per-send threefry draw entirely
     has_loss: bool = True
+
+    @property
+    def stream_present(self) -> bool:
+        return bool(set(self.models_present) & STREAM_MODELS)
 
     def __post_init__(self) -> None:
         if self.n_lanes > MAX_LANES:
@@ -187,6 +197,9 @@ class LaneTables(NamedTuple):
     p_count: jnp.ndarray  # [N] int64 message budget (ping client)
     p_stride: jnp.ndarray  # [N] int64 (tgen-mesh)
     codel_div: jnp.ndarray  # [1025] int64
+    st_segs: jnp.ndarray  # [N] int64 stream-client data segments
+    st_mss: jnp.ndarray  # [N] int64
+    st_last: jnp.ndarray  # [N] int64 final-segment payload bytes
 
 
 # --------------------------------------------------------------------------
@@ -290,13 +303,19 @@ def rand_u32_lane(seed: int, stream, counter):
 # --------------------------------------------------------------------------
 
 
-def _sort_queues(s: LaneState) -> LaneState:
+def _sort_queues(s: LaneState, with_pay: bool = False) -> LaneState:
     """Key-sort every lane's queue by (time, aux) — the packed form of the
     (time, kind, src, seq) total order; empty slots (NEVER) end at the back.
 
     Establishes the sorted-row invariant on entry states
     (``TpuEngine.initial_state``) and restores it on iterations that pop
-    events but skip the merge (see ``iter_body``)."""
+    events but skip the merge (see ``iter_body``).  ``with_pay`` carries the
+    stream payload column through the permutation (static: stream tier)."""
+    if with_pay:
+        t, aux, size, pay = lax.sort(
+            (s.q_time, s.q_aux, s.q_size, s.q_pay), dimension=1, num_keys=2
+        )
+        return s._replace(q_time=t, q_aux=aux, q_size=size, q_pay=pay)
     t, aux, size = lax.sort(
         (s.q_time, s.q_aux, s.q_size), dimension=1, num_keys=2
     )
@@ -311,16 +330,25 @@ class _SlotEmit(NamedTuple):
     ins_time: jnp.ndarray  # int64
     ins_aux: jnp.ndarray  # int64
     ins_size: jnp.ndarray  # int32
-    # same-lane insert channel 2: timer re-arm (LOCAL, size 0)
+    ins_pay: jnp.ndarray  # int64
+    # same-lane insert channel 2: timer re-arm / stream pump (LOCAL)
     arm_valid: jnp.ndarray
     arm_time: jnp.ndarray
     arm_aux: jnp.ndarray
+    arm_size: jnp.ndarray  # int32 (0 timer, -2 pump)
+    arm_pay: jnp.ndarray  # int64 (stream flow id)
+    # same-lane insert channel 3: stream RTO arm (LOCAL, size -3)
+    arm2_valid: jnp.ndarray
+    arm2_time: jnp.ndarray
+    arm2_aux: jnp.ndarray
+    arm2_pay: jnp.ndarray
     # cross-lane channel: outbound packets
     out_valid: jnp.ndarray
     out_dst: jnp.ndarray  # int32
     out_time: jnp.ndarray
     out_aux: jnp.ndarray
     out_size: jnp.ndarray
+    out_pay: jnp.ndarray  # int64
     # log record channel
     rec_valid: jnp.ndarray
     rec_time: jnp.ndarray
@@ -341,7 +369,8 @@ def _process_slot(
     t = slot["time"]
     kind, src, seq = unpack_aux(slot["aux"])
     size = slot["size"]
-    active = t < window_end
+    pay = slot["pay"]
+    active = slot["act"]
     false_n = jnp.zeros(n, dtype=bool)
 
     i64 = jnp.int64
@@ -379,6 +408,7 @@ def _process_slot(
     ins_time = t_del
     ins_aux = pack_aux(DELIVERY, src, seq)
     ins_size = size
+    ins_pay = pay
 
     # packet outcome log record
     pk_rec_valid = is_pkt
@@ -417,9 +447,67 @@ def _process_slot(
         else false_n
     )
 
+    # ---- stream tier (vectorized lane-TCP; static gate) ------------------
+    if p.stream_present:
+        is_cl = model == M_STREAM_CLIENT
+        is_sv = model == M_STREAM_SERVER
+        st_any = is_cl | is_sv
+        flags_in, sseq_in, sack_in = lstr.unpack_pay(pay)
+        # flow id: the client lane (delivery src at the server, payload
+        # word on server locals, own lane otherwise)
+        stim_open = is_start & is_cl
+        stim_pump = is_loc & (size == lstr.SZ_PUMP) & st_any
+        stim_rto = is_loc & (size == lstr.SZ_RTO) & st_any
+        stim_seg = is_del & st_any
+        stream_stim = stim_open | stim_pump | stim_rto | stim_seg
+        flow = jnp.where(
+            is_sv,
+            jnp.where(stim_seg, src, (pay & 0xFFFFFFFF).astype(jnp.int32)),
+            lanes,
+        )
+        server_mask = stream_stim & is_sv
+        f = lstr.gather_cols(
+            s.stream, flow, server_mask, tb.st_segs, tb.st_mss, tb.st_last
+        )
+        f1, em1 = lstr.open_flow_vec(f, t, stim_open)
+        f = lstr._merge_cols(f, f1, stim_open)
+        f2, em2 = lstr.on_pump_vec(f, t, stim_pump)
+        f = lstr._merge_cols(f, f2, stim_pump)
+        f3, em3 = lstr.on_rto_vec(f, t, stim_rto)
+        f = lstr._merge_cols(f, f3, stim_rto)
+        f4, em4 = lstr.on_segment_vec(
+            f, t, stim_seg, flags_in, sseq_in, sack_in, size.astype(jnp.int64)
+        )
+        f = lstr._merge_cols(f, f4, stim_seg)
+        sem = lstr._merge_emit(
+            lstr._merge_emit(
+                lstr._merge_emit(em1, em2, stim_pump), em3, stim_rto
+            ),
+            em4,
+            stim_seg,
+        )
+        # completion latches (counted once, like the CPU _track)
+        f = f._replace(
+            completed=f.completed | (sem.completed_now & stream_stim)
+        )
+        stream_state = lstr.scatter_cols(
+            s.stream, f, flow, stream_stim & ~server_mask, server_mask
+        )
+        s = s._replace(stream=stream_state)
+        st_send = sem.send_valid & stream_stim
+        st_pump = sem.pump_valid & stream_stim
+        st_rto = sem.rto_valid & stream_stim
+    else:
+        st_send = st_pump = st_rto = false_n
+        sem = None
+        flow = lanes
+        is_sv = false_n
+
     # ---- unified send channel (≤1 send per lane per slot) ----------------
     send_phold = del_send_phold | loc_send_phold
-    do_send = send_phold | del_send_echo | mesh_tick | client_tick | ping_tick
+    do_send = (
+        send_phold | del_send_echo | mesh_tick | client_tick | ping_tick | st_send
+    )
 
     # phold peer draw (consumes an app draw only where it happens; traced
     # only when phold lanes exist — the threefry is ~50 ops per slot)
@@ -454,6 +542,17 @@ def _process_slot(
         ),
     ).astype(i32)
     out_size = jnp.where(del_send_echo, size, tb.p_size).astype(i32)
+    if p.stream_present:
+        # server sends go to the flow's client lane; clients to p_peer
+        dst = jnp.where(st_send, jnp.where(is_sv, flow, tb.p_peer), dst).astype(i32)
+        out_size = jnp.where(st_send, sem.send_size, out_size).astype(i32)
+        out_pay = jnp.where(
+            st_send,
+            lstr.pack_pay(sem.send_flags, sem.send_seq, sem.send_ack),
+            jnp.zeros(n, dtype=i64),
+        )
+    else:
+        out_pay = jnp.zeros(n, dtype=i64)
 
     # per-send sequence numbers
     snd_seq = s.send_seq
@@ -486,20 +585,32 @@ def _process_slot(
     out_valid = do_send & ~lost
     out_aux = pack_aux(jnp.full(n, PACKET, dtype=i32), lanes, snd_seq)
 
-    # ---- timer (re-)arm channel -----------------------------------------
+    # ---- local arm channels ---------------------------------------------
     has_timer = (
         (model == M_TGEN_MESH) | (model == M_TGEN_CLIENT) | (model == M_PING_CLIENT)
     )
-    rearm = (
+    rearm_timer = (
         (is_start & has_timer)
         | mesh_tick
         | client_tick
         | ping_tick
         | (is_timer & (model == M_TGEN_MESH) & (n == 1))
     )
-    arm_time = t + tb.p_interval
+    rearm = rearm_timer | st_pump
+    arm_time = jnp.where(st_pump, t, t + tb.p_interval)
+    arm_size = jnp.where(st_pump, lstr.SZ_PUMP, 0).astype(i32)
+    arm_pay = jnp.where(st_pump, flow.astype(i64), 0)
     arm_aux = pack_aux(jnp.full(n, LOCAL, dtype=i32), lanes, s.local_seq)
     s = s._replace(local_seq=s.local_seq + rearm)
+    # stream RTO arm consumes the NEXT local_seq (the CPU driver arms the
+    # pump before the RTO inside one stimulus)
+    arm2_valid = st_rto
+    arm2_time = sem.rto_time if sem is not None else jnp.zeros(n, dtype=i64)
+    arm2_aux = pack_aux(jnp.full(n, LOCAL, dtype=i32), lanes, s.local_seq)
+    arm2_pay = arm_pay
+    if p.stream_present:
+        arm2_pay = jnp.where(st_rto, flow.astype(i64), 0)
+        s = s._replace(local_seq=s.local_seq + arm2_valid)
 
     # ---- log record (≤1 per slot: packet outcome, or send loss) ----------
     rec_valid = pk_rec_valid | lost
@@ -511,9 +622,10 @@ def _process_slot(
     rec_outcome = jnp.where(pk_rec_valid, pk_rec_outcome, DROP_LOSS).astype(i64)
 
     emit = _SlotEmit(
-        ins_valid, ins_time, ins_aux, ins_size,
-        rearm, arm_time, arm_aux,
-        out_valid, dst, arr, out_aux, out_size,
+        ins_valid, ins_time, ins_aux, ins_size, ins_pay,
+        rearm, arm_time, arm_aux, arm_size, arm_pay,
+        arm2_valid, arm2_time, arm2_aux, arm2_pay,
+        out_valid, dst, arr, out_aux, out_size, out_pay,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
     )
     return s, emit
@@ -567,29 +679,37 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     """
     n, c = p.n_lanes, p.capacity
     i64 = jnp.int64
+    sp = p.stream_present
 
-    # -- same-lane block [N, 2K] ------------------------------------------
-    self_valid = jnp.concatenate([emits.ins_valid.T, emits.arm_valid.T], axis=1)
-    self_time = jnp.where(
-        self_valid,
-        jnp.concatenate([emits.ins_time.T, emits.arm_time.T], axis=1),
-        NEVER,
-    )
-    self_aux = jnp.concatenate([emits.ins_aux.T, emits.arm_aux.T], axis=1)
-    self_size = jnp.concatenate(
-        [emits.ins_size.T, jnp.zeros_like(emits.ins_size.T)], axis=1
-    )
+    # -- same-lane block [N, 2K] (3K with the stream RTO channel) ----------
+    self_parts = [emits.ins_valid.T, emits.arm_valid.T]
+    time_parts = [emits.ins_time.T, emits.arm_time.T]
+    aux_parts = [emits.ins_aux.T, emits.arm_aux.T]
+    size_parts = [emits.ins_size.T, emits.arm_size.T]
+    pay_parts = [emits.ins_pay.T, emits.arm_pay.T]
+    if sp:
+        self_parts.append(emits.arm2_valid.T)
+        time_parts.append(emits.arm2_time.T)
+        aux_parts.append(emits.arm2_aux.T)
+        size_parts.append(jnp.full_like(emits.ins_size.T, lstr.SZ_RTO))
+        pay_parts.append(emits.arm2_pay.T)
+    self_valid = jnp.concatenate(self_parts, axis=1)
+    self_time = jnp.where(self_valid, jnp.concatenate(time_parts, axis=1), NEVER)
+    self_aux = jnp.concatenate(aux_parts, axis=1)
+    self_size = jnp.concatenate(size_parts, axis=1)
+    self_pay = jnp.concatenate(pay_parts, axis=1)
 
     # -- cross-lane block [N, C] via sort-by-dst + segment gather ----------
     valid = emits.out_valid.reshape(-1)
     dst = jnp.where(valid, emits.out_dst.reshape(-1), jnp.int32(n))
     m = dst.shape[0]
-    dst_s, time_s, aux_s, size_s = lax.sort(
-        (dst, emits.out_time.reshape(-1), emits.out_aux.reshape(-1),
-         emits.out_size.reshape(-1)),
-        dimension=0,
-        num_keys=1,
-    )
+    flat_ops = [dst, emits.out_time.reshape(-1), emits.out_aux.reshape(-1),
+                emits.out_size.reshape(-1)]
+    if sp:
+        flat_ops.append(emits.out_pay.reshape(-1))
+    sorted_ops = lax.sort(tuple(flat_ops), dimension=0, num_keys=1)
+    dst_s, time_s, aux_s, size_s = sorted_ops[:4]
+    pay_s = sorted_ops[4] if sp else None
     # one search over [0..N]: start of lane n+1 is the end of lane n
     bounds = jnp.searchsorted(
         dst_s, jnp.arange(n + 1, dtype=dst_s.dtype), side="left"
@@ -598,19 +718,26 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     cnt = bounds[1:] - start
     r = jnp.arange(c, dtype=jnp.int32)[None, :]  # [1, C]
     in_seg = r < cnt[:, None]
-    g_time, g_aux, g_size = _window_gather([time_s, aux_s, size_s], start, c)
+    gather_ops = [time_s, aux_s, size_s] + ([pay_s] if sp else [])
+    gathered = _window_gather(gather_ops, start, c)
+    g_time, g_aux, g_size = gathered[:3]
     cross_time = jnp.where(in_seg, g_time, NEVER)
     cross_aux = jnp.where(in_seg, g_aux, 0)
     cross_size = jnp.where(in_seg, g_size, 0).astype(jnp.int32)
+    cross_pay = jnp.where(in_seg, gathered[3], 0) if sp else None
     # receivers of more than C events in one iteration lose the tail
     # before the merge even sees it; count those drops too
     lost_pre = jnp.maximum(cnt - c, 0).astype(i64)
 
-    # -- merge [N, C + 2K + C], keep first C ------------------------------
+    # -- merge [N, C + self + C], keep first C ----------------------------
     mt = jnp.concatenate([s.q_time, self_time, cross_time], axis=1)
     ma = jnp.concatenate([s.q_aux, self_aux, cross_aux], axis=1)
     ms = jnp.concatenate([s.q_size, self_size, cross_size], axis=1)
-    mt, ma, ms = lax.sort((mt, ma, ms), dimension=1, num_keys=2)
+    if sp:
+        mpay = jnp.concatenate([s.q_pay, self_pay, cross_pay], axis=1)
+        mt, ma, ms, mpay = lax.sort((mt, ma, ms, mpay), dimension=1, num_keys=2)
+    else:
+        mt, ma, ms = lax.sort((mt, ma, ms), dimension=1, num_keys=2)
     tail_mask = mt[:, c:] != NEVER
     s = s._replace(
         q_time=mt[:, :c],
@@ -618,6 +745,8 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
         q_size=ms[:, :c],
         n_queue=s.n_queue + tail_mask.sum(axis=1) + lost_pre,
     )
+    if sp:
+        s = s._replace(q_pay=mpay[:, :c])
 
     # overflow log records from the merge tail (pre-gather losses surface
     # only in n_queue; both paths raise in strict mode)
@@ -679,15 +808,37 @@ def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
 
     k = p.pops_per_iter
 
+    # per-lane pop-safety class (static): passive lanes co-pop ANY prefix —
+    # their packet handling (inline counters, dst-side bucket/CoDel) and
+    # timer ticks (src-side bucket, cross-window sends) touch disjoint state
+    # and commute, so heap-order interleaving cannot be observed.  Active
+    # lanes (phold/ping/stream) may generate same-window events (pump arms,
+    # DELIVERY inserts) that the CPU heap pops before later queue entries,
+    # so they co-pop only same-instant PACKET prefixes (a packet pop
+    # generates nothing that sorts before a same-time PACKET).
+    mp_r = set(p.models_present)
+    passive_ids = sorted(PASSIVE_MODELS & mp_r)
+
     def iter_body(s: LaneState) -> LaneState:
         # queue rows are kept sorted by (time, aux) — the pop is a slice
         window_end = s.now_window_end
+        qt = s.q_time[:, :k]
+        kind_cols = (s.q_aux[:, :k] >> AUX_KIND_SHIFT).astype(jnp.int32)
+        same_t = qt == qt[:, :1]
+        pkt_prefix = jnp.cumprod(kind_cols == PACKET, axis=1).astype(bool)
+        first_col = (jnp.arange(k) == 0)[None, :]
+        passive_lane = jnp.zeros(p.n_lanes, dtype=bool)
+        for _mid in passive_ids:
+            passive_lane = passive_lane | (tb.model == _mid)
+        allowed = passive_lane[:, None] | (same_t & (pkt_prefix | first_col))
         popped = {
-            "time": s.q_time[:, :k],
+            "time": qt,
             "aux": s.q_aux[:, :k],
             "size": s.q_size[:, :k],
+            "pay": s.q_pay[:, :k],
+            "act": allowed & (qt < window_end),
         }
-        consumed = popped["time"] < window_end
+        consumed = popped["act"]
         s = s._replace(
             q_time=s.q_time.at[:, :k].set(
                 jnp.where(consumed, NEVER, popped["time"])
@@ -705,15 +856,14 @@ def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
                 z64 = jnp.zeros(p.n_lanes, dtype=jnp.int64)
                 z32 = jnp.zeros(p.n_lanes, dtype=jnp.int32)
                 return st_, _SlotEmit(
-                    nb, z64, z64, z32,
-                    nb, z64, z64,
-                    nb, z32, z64, z64, z32,
+                    nb, z64, z64, z32, z64,
+                    nb, z64, z64, z32, z64,
+                    nb, z64, z64, z64,
+                    nb, z32, z64, z64, z32, z64,
                     nb, z64, z64, z64, z64, z64, z64,
                 )
 
-            st, emit = lax.cond(
-                jnp.any(slot_cols["time"] < window_end), live, dead, st
-            )
+            st, emit = lax.cond(jnp.any(slot_cols["act"]), live, dead, st)
             return st, emit
 
         slots = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), popped)  # [K, N]
@@ -728,6 +878,7 @@ def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
         any_new = (
             jnp.any(emits.ins_valid)
             | jnp.any(emits.arm_valid)
+            | jnp.any(emits.arm2_valid)
             | jnp.any(emits.out_valid)
         )
 
@@ -735,7 +886,10 @@ def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
             st, over_rec = _merge_append(p, st, emits)
             return _append_log(p, st, over_rec)
 
-        s = lax.cond(any_new, do_merge, _sort_queues, s)
+        def do_sort(st: LaneState) -> LaneState:
+            return _sort_queues(st, with_pay=p.stream_present)
+
+        s = lax.cond(any_new, do_merge, do_sort, s)
 
         per_slot = {
             "valid": emits.rec_valid.reshape(-1),

@@ -1,0 +1,699 @@
+"""Vectorized lane-TCP: the stream tier on device.
+
+The masked-vector twin of the scalar law in :mod:`shadow_tpu.net.ltcp`
+(SURVEY §7 hard part (e): "TCP state machine vectorization").  One flow per
+stream-client lane; all flow state lives in ``[N]`` integer arrays indexed
+by the CLIENT lane (the flow's identity on both ends, mirroring the CPU
+models' ``(client, conn)`` key with conn=0):
+
+- client-role columns (``cl_*``) are the client's FlowState, updated in
+  place on the client lane;
+- server-role columns (``sv_*``) are the server's FlowState for flow c,
+  gathered/scattered at index c — unique per slot because each lane pops
+  at most one event and every flow has exactly one client lane.
+
+Wire payloads pack ``flags(4) | seq(28) | ack(28)`` into one int64 queue
+word; pump/RTO local events are marked by size -2/-3 and carry the flow id
+in the payload word.  Every stimulus handler below is a line-for-line
+masked translation of ltcp.py's scalar functions — the CPU oracle these
+lanes are diffed against bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..core.time import NEVER
+from ..net import ltcp
+
+# size-field markers for stream LOCAL events
+SZ_PUMP = -2
+SZ_RTO = -3
+
+# payload packing: flags(4) | seq(28) | ack(28)
+_P_SEQ_BITS = 28
+_P_MASK = (1 << _P_SEQ_BITS) - 1
+
+
+def pack_pay(flags, seq, ack):
+    i64 = jnp.int64
+    return (
+        (jnp.asarray(flags).astype(i64) << (2 * _P_SEQ_BITS))
+        | (jnp.asarray(seq).astype(i64) << _P_SEQ_BITS)
+        | jnp.asarray(ack).astype(i64)
+    )
+
+
+def unpack_pay(pay):
+    flags = (pay >> (2 * _P_SEQ_BITS)).astype(jnp.int32)
+    seq = (pay >> _P_SEQ_BITS) & _P_MASK
+    ack = pay & _P_MASK
+    return flags, seq, ack
+
+
+class StreamState(NamedTuple):
+    """Per-flow columns, all [N] indexed by client lane.  ``cl_*`` is the
+    client endpoint, ``sv_*`` the server endpoint of the same flow."""
+
+    # client endpoint (ltcp.FlowState fields)
+    cl_state: jnp.ndarray  # int32
+    cl_snd_una: jnp.ndarray  # int64
+    cl_snd_nxt: jnp.ndarray
+    cl_rcv_nxt: jnp.ndarray
+    cl_cwnd_fp: jnp.ndarray
+    cl_ssthresh_fp: jnp.ndarray
+    cl_dup_acks: jnp.ndarray  # int32
+    cl_in_rec: jnp.ndarray  # bool
+    cl_recover: jnp.ndarray
+    cl_max_sent: jnp.ndarray
+    cl_srtt: jnp.ndarray
+    cl_rttvar: jnp.ndarray
+    cl_rto: jnp.ndarray
+    cl_rtt_seq: jnp.ndarray
+    cl_rtt_ts: jnp.ndarray
+    cl_rto_deadline: jnp.ndarray
+    cl_rto_evt: jnp.ndarray
+    cl_tx_segs: jnp.ndarray
+    cl_retransmits: jnp.ndarray
+    cl_completed: jnp.ndarray  # bool
+    # server endpoint (full FlowState mirror)
+    sv_state: jnp.ndarray
+    sv_snd_una: jnp.ndarray
+    sv_snd_nxt: jnp.ndarray
+    sv_rcv_nxt: jnp.ndarray
+    sv_cwnd_fp: jnp.ndarray
+    sv_ssthresh_fp: jnp.ndarray
+    sv_dup_acks: jnp.ndarray
+    sv_in_rec: jnp.ndarray
+    sv_recover: jnp.ndarray
+    sv_max_sent: jnp.ndarray
+    sv_srtt: jnp.ndarray
+    sv_rttvar: jnp.ndarray
+    sv_rto: jnp.ndarray
+    sv_rtt_seq: jnp.ndarray
+    sv_rtt_ts: jnp.ndarray
+    sv_rto_deadline: jnp.ndarray
+    sv_rto_evt: jnp.ndarray
+    sv_rx_segs: jnp.ndarray
+    sv_rx_bytes: jnp.ndarray
+    sv_retransmits: jnp.ndarray
+    sv_tx_segs: jnp.ndarray
+    sv_completed: jnp.ndarray  # bool
+
+
+def init_stream_state(n: int, segs, mss, last_bytes) -> StreamState:
+    """Fresh columns; ``segs``/``mss``/``last_bytes`` are static [N] tables
+    (0 on non-client lanes)."""
+    i64 = jnp.int64
+    i32 = jnp.int32
+    z64 = jnp.zeros(n, dtype=i64)
+    z32 = jnp.zeros(n, dtype=i32)
+    zb = jnp.zeros(n, dtype=bool)
+    never = jnp.full(n, NEVER, dtype=i64)
+    return StreamState(
+        cl_state=z32,
+        cl_snd_una=z64,
+        cl_snd_nxt=z64,
+        cl_rcv_nxt=z64,
+        cl_cwnd_fp=jnp.full(n, ltcp.INIT_CWND_FP, dtype=i64),
+        cl_ssthresh_fp=jnp.full(n, ltcp.INIT_SSTHRESH_FP, dtype=i64),
+        cl_dup_acks=z32,
+        cl_in_rec=zb,
+        cl_recover=z64,
+        cl_max_sent=z64,
+        cl_srtt=jnp.full(n, -1, dtype=i64),
+        cl_rttvar=z64,
+        cl_rto=jnp.full(n, ltcp.RTO_INIT, dtype=i64),
+        cl_rtt_seq=jnp.full(n, -1, dtype=i64),
+        cl_rtt_ts=z64,
+        cl_rto_deadline=never,
+        cl_rto_evt=never,
+        cl_tx_segs=z64,
+        cl_retransmits=z64,
+        cl_completed=zb,
+        sv_state=z32,
+        sv_snd_una=z64,
+        sv_snd_nxt=z64,
+        sv_rcv_nxt=z64,
+        sv_cwnd_fp=jnp.full(n, ltcp.INIT_CWND_FP, dtype=i64),
+        sv_ssthresh_fp=jnp.full(n, ltcp.INIT_SSTHRESH_FP, dtype=i64),
+        sv_dup_acks=z32,
+        sv_in_rec=zb,
+        sv_recover=z64,
+        sv_max_sent=z64,
+        sv_srtt=jnp.full(n, -1, dtype=i64),
+        sv_rttvar=z64,
+        sv_rto=jnp.full(n, ltcp.RTO_INIT, dtype=i64),
+        sv_rtt_seq=jnp.full(n, -1, dtype=i64),
+        sv_rtt_ts=z64,
+        sv_rto_deadline=never,
+        sv_rto_evt=never,
+        sv_rx_segs=z64,
+        sv_rx_bytes=z64,
+        sv_retransmits=z64,
+        sv_tx_segs=z64,
+        sv_completed=zb,
+    )
+
+
+class FlowCols(NamedTuple):
+    """One endpoint's FlowState as gathered [N] columns + static shape."""
+
+    state: jnp.ndarray
+    snd_una: jnp.ndarray
+    snd_nxt: jnp.ndarray
+    rcv_nxt: jnp.ndarray
+    cwnd_fp: jnp.ndarray
+    ssthresh_fp: jnp.ndarray
+    dup_acks: jnp.ndarray
+    in_rec: jnp.ndarray
+    recover: jnp.ndarray
+    max_sent: jnp.ndarray
+    srtt: jnp.ndarray
+    rttvar: jnp.ndarray
+    rto: jnp.ndarray
+    rtt_seq: jnp.ndarray
+    rtt_ts: jnp.ndarray
+    rto_deadline: jnp.ndarray
+    rto_evt: jnp.ndarray
+    tx_segs: jnp.ndarray
+    retransmits: jnp.ndarray
+    role: jnp.ndarray  # SENDER / RECEIVER
+    segs: jnp.ndarray  # transfer shape (client flows; 0 for server role)
+    mss: jnp.ndarray
+    last_bytes: jnp.ndarray
+    rx_segs: jnp.ndarray
+    rx_bytes: jnp.ndarray
+    completed: jnp.ndarray  # bool: reached DONE before this stimulus
+
+
+class StreamEmit(NamedTuple):
+    """What one stream stimulus emits (all [N], masked by validity)."""
+
+    send_valid: jnp.ndarray
+    send_flags: jnp.ndarray
+    send_seq: jnp.ndarray
+    send_ack: jnp.ndarray
+    send_size: jnp.ndarray  # wire size
+    pump_valid: jnp.ndarray  # arm a pump LOCAL at the current time
+    rto_valid: jnp.ndarray  # arm an RTO LOCAL
+    rto_time: jnp.ndarray
+    completed_now: jnp.ndarray  # flow reached DONE on this stimulus
+
+
+# --------------------------------------------------------------------------
+# law helpers (vector twins of ltcp.py's helpers)
+# --------------------------------------------------------------------------
+
+
+def _seg_wire_size(f: FlowCols, unit):
+    is_data = (unit >= 1) & (unit <= f.segs)
+    payload = jnp.where(unit == f.segs, f.last_bytes, f.mss)
+    return jnp.where(is_data, ltcp.HDR_BYTES + payload, ltcp.HDR_BYTES).astype(
+        jnp.int32
+    )
+
+
+def _seg_flags(f: FlowCols, unit):
+    syn = jnp.where(
+        f.role == ltcp.SENDER, ltcp.F_SYN, ltcp.F_SYN | ltcp.F_ACK
+    )
+    data = ltcp.F_DATA | ltcp.F_ACK
+    fin = ltcp.F_FIN | ltcp.F_ACK
+    is_data = (f.role == ltcp.SENDER) & (unit >= 1) & (unit <= f.segs)
+    return jnp.where(
+        unit == 0, syn, jnp.where(is_data, data, fin)
+    ).astype(jnp.int32)
+
+
+def _flight(f: FlowCols):
+    return f.snd_nxt - f.snd_una
+
+
+def _can_send_new(f: FlowCols):
+    cwnd_segs = f.cwnd_fp // ltcp.FP
+    return (
+        (f.role == ltcp.SENDER)
+        & (f.state == ltcp.ESTAB)
+        & (f.snd_nxt <= f.segs + 1)
+        & (_flight(f) < jnp.minimum(cwnd_segs, ltcp.RWND_SEGS))
+    )
+
+
+def _rtt_sample(f: FlowCols, now, m) -> FlowCols:
+    """RFC 6298 update where mask ``m``."""
+    r = jnp.maximum(now - f.rtt_ts, 0)
+    first = f.srtt < 0
+    srtt1 = jnp.where(first, r, (7 * f.srtt + r) // 8)
+    delta = jnp.abs(f.srtt - r)
+    rttvar1 = jnp.where(first, r // 2, (3 * f.rttvar + delta) // 4)
+    rto1 = jnp.clip(
+        srtt1 + jnp.maximum(4 * rttvar1, 1_000_000), ltcp.RTO_MIN, ltcp.RTO_MAX
+    )
+    return f._replace(
+        srtt=jnp.where(m, srtt1, f.srtt),
+        rttvar=jnp.where(m, rttvar1, f.rttvar),
+        rto=jnp.where(m, rto1, f.rto),
+    )
+
+
+def _restart_rto(f: FlowCols, now, m, em_rto_valid, em_rto_time):
+    """(Re)start the retransmission timer where ``m``; returns (f, valid,
+    time) with the dedup law of ltcp._restart_rto."""
+    deadline = now + f.rto
+    arm = m & ((f.rto_evt == NEVER) | (deadline < f.rto_evt))
+    f = f._replace(
+        rto_deadline=jnp.where(m, deadline, f.rto_deadline),
+        rto_evt=jnp.where(arm, deadline, f.rto_evt),
+    )
+    return (
+        f,
+        em_rto_valid | arm,
+        jnp.where(arm, deadline, em_rto_time),
+    )
+
+
+def _emit_unit(f: FlowCols, unit, m, retransmit, em):
+    """Send the segment for ``unit`` where ``m`` (≤1 send per stimulus, so
+    the channel is a plain overwrite under the mask)."""
+    send_flags = _seg_flags(f, unit)
+    send_size = _seg_wire_size(f, unit)
+    f = f._replace(
+        tx_segs=f.tx_segs + m,
+        retransmits=f.retransmits + (m & retransmit),
+        rtt_seq=jnp.where(
+            m & retransmit & (f.rtt_seq >= 0) & (unit <= f.rtt_seq),
+            -1,
+            jnp.where(m & ~retransmit & (f.rtt_seq < 0), unit, f.rtt_seq),
+        ),
+        max_sent=jnp.where(m & (unit + 1 > f.max_sent), unit + 1, f.max_sent),
+    )
+    em = em._replace(
+        send_valid=em.send_valid | m,
+        send_flags=jnp.where(m, send_flags, em.send_flags),
+        send_seq=jnp.where(m, unit, em.send_seq),
+        send_ack=jnp.where(m, f.rcv_nxt, em.send_ack),
+        send_size=jnp.where(m, send_size, em.send_size),
+    )
+    return f, em
+
+
+def _empty_emit(n: int) -> StreamEmit:
+    i64 = jnp.int64
+    i32 = jnp.int32
+    zb = jnp.zeros(n, dtype=bool)
+    return StreamEmit(
+        send_valid=zb,
+        send_flags=jnp.zeros(n, dtype=i32),
+        send_seq=jnp.zeros(n, dtype=i64),
+        send_ack=jnp.zeros(n, dtype=i64),
+        send_size=jnp.zeros(n, dtype=i32),
+        pump_valid=zb,
+        rto_valid=zb,
+        rto_time=jnp.zeros(n, dtype=i64),
+        completed_now=zb,
+    )
+
+
+def _pull_back(f: FlowCols, now, m, em):
+    """Go-back-N loss response where ``m``."""
+    f = f._replace(
+        snd_nxt=jnp.where(m, f.snd_una + 1, f.snd_nxt),
+        state=jnp.where(
+            m & (f.role == ltcp.SENDER) & (f.state == ltcp.FIN_WAIT),
+            ltcp.ESTAB,
+            f.state,
+        ),
+    )
+    f, em = _emit_unit(f, f.snd_una, m, jnp.asarray(True), em)
+    f, rv, rt = _restart_rto(f, now, m, em.rto_valid, em.rto_time)
+    em = em._replace(rto_valid=rv, rto_time=rt)
+    em = em._replace(pump_valid=em.pump_valid | (m & _can_send_new(f)))
+    return f, em
+
+
+# --------------------------------------------------------------------------
+# stimulus handlers (vector twins of ltcp.open_flow / on_pump / on_rto_event
+# / on_segment); each applies under an activity mask ``m``
+# --------------------------------------------------------------------------
+
+
+def open_flow_vec(f: FlowCols, now, m) -> tuple[FlowCols, StreamEmit]:
+    em = _empty_emit(f.state.shape[0])
+    f = f._replace(
+        state=jnp.where(m, ltcp.SYN_SENT, f.state),
+        snd_nxt=jnp.where(m, 1, f.snd_nxt),
+    )
+    f, em = _emit_unit(f, jnp.zeros_like(f.snd_nxt), m, jnp.asarray(False), em)
+    f = f._replace(rtt_ts=jnp.where(m, now, f.rtt_ts))
+    f, rv, rt = _restart_rto(f, now, m, em.rto_valid, em.rto_time)
+    em = em._replace(rto_valid=rv, rto_time=rt)
+    return f, em
+
+
+def on_pump_vec(f: FlowCols, now, m) -> tuple[FlowCols, StreamEmit]:
+    em = _empty_emit(f.state.shape[0])
+    m = m & _can_send_new(f)
+    unit = f.snd_nxt
+    f = f._replace(snd_nxt=jnp.where(m, f.snd_nxt + 1, f.snd_nxt))
+    retransmit = unit < f.max_sent
+    f = f._replace(
+        rtt_ts=jnp.where(m & ~retransmit & (f.rtt_seq < 0), now, f.rtt_ts)
+    )
+    f, em = _emit_unit(f, unit, m, retransmit, em)
+    f = f._replace(
+        state=jnp.where(m & (unit == f.segs + 1), ltcp.FIN_WAIT, f.state)
+    )
+    f, rv, rt = _restart_rto(f, now, m, em.rto_valid, em.rto_time)
+    em = em._replace(rto_valid=rv, rto_time=rt)
+    em = em._replace(pump_valid=em.pump_valid | (m & _can_send_new(f)))
+    return f, em
+
+
+def on_rto_vec(f: FlowCols, now, m) -> tuple[FlowCols, StreamEmit]:
+    em = _empty_emit(f.state.shape[0])
+    m = m & (now == f.rto_evt)  # ownership law
+    f = f._replace(rto_evt=jnp.where(m, NEVER, f.rto_evt))
+    lapse = (f.rto_deadline == NEVER) | (_flight(f) <= 0)
+    m = m & ~lapse
+    # deadline moved later: re-arm there
+    rearm = m & (now < f.rto_deadline)
+    f = f._replace(rto_evt=jnp.where(rearm, f.rto_deadline, f.rto_evt))
+    em = em._replace(
+        rto_valid=em.rto_valid | rearm,
+        rto_time=jnp.where(rearm, f.rto_deadline, em.rto_time),
+    )
+    fire = m & ~rearm
+    fl_fp = _flight(f) * ltcp.FP
+    f = f._replace(
+        ssthresh_fp=jnp.where(
+            fire, jnp.maximum(fl_fp // 2, ltcp.MIN_SSTHRESH_FP), f.ssthresh_fp
+        ),
+        cwnd_fp=jnp.where(fire, ltcp.FP, f.cwnd_fp),
+        dup_acks=jnp.where(fire, 0, f.dup_acks),
+        in_rec=jnp.where(fire, False, f.in_rec),
+        rto=jnp.where(fire, jnp.minimum(f.rto * 2, ltcp.RTO_MAX), f.rto),
+    )
+    f, em = _pull_back(f, now, fire, em)
+    return f, em
+
+
+def on_segment_vec(
+    f: FlowCols, now, m, flags, seq, ack, size
+) -> tuple[FlowCols, StreamEmit]:
+    """Vector twin of ltcp.on_segment.  The scalar function is a sequence
+    of early returns; here each return path is a disjoint mask and state
+    updates compose under them in the same order."""
+    n = f.state.shape[0]
+    em = _empty_emit(n)
+    i64 = jnp.int64
+
+    is_syn = (flags & ltcp.F_SYN) != 0
+    is_ack = (flags & ltcp.F_ACK) != 0
+    is_fin = (flags & ltcp.F_FIN) != 0
+    is_data = (flags & ltcp.F_DATA) != 0
+
+    # ---- DONE: dup FIN from peer that missed our final ACK ---------------
+    done0 = m & (f.state == ltcp.DONE)
+    reack = done0 & (f.role == ltcp.SENDER) & is_fin
+    em = em._replace(
+        send_valid=em.send_valid | reack,
+        send_flags=jnp.where(reack, ltcp.F_ACK, em.send_flags),
+        send_seq=jnp.where(reack, f.snd_nxt, em.send_seq),
+        send_ack=jnp.where(reack, f.rcv_nxt, em.send_ack),
+        send_size=jnp.where(reack, ltcp.HDR_BYTES, em.send_size).astype(jnp.int32),
+    )
+    m = m & ~done0
+
+    # ---- passive open ----------------------------------------------------
+    po = m & (f.role == ltcp.RECEIVER) & (f.state == ltcp.CLOSED)
+    po_ok = po & is_syn & ~is_ack
+    f = f._replace(
+        state=jnp.where(po_ok, ltcp.SYN_RCVD, f.state),
+        rcv_nxt=jnp.where(po_ok, 1, f.rcv_nxt),
+        snd_nxt=jnp.where(po_ok, 1, f.snd_nxt),
+    )
+    f, em = _emit_unit(f, jnp.zeros(n, dtype=i64), po_ok, jnp.asarray(False), em)
+    f = f._replace(rtt_ts=jnp.where(po_ok, now, f.rtt_ts))
+    f, rv, rt = _restart_rto(f, now, po_ok, em.rto_valid, em.rto_time)
+    em = em._replace(rto_valid=rv, rto_time=rt)
+    m = m & ~po  # both the handled SYN and the ignored non-SYN return
+
+    # retransmitted SYN into SYN_RCVD: resend the SYN-ACK
+    rsyn = m & (f.role == ltcp.RECEIVER) & (f.state == ltcp.SYN_RCVD) & is_syn & ~is_ack
+    f, em = _emit_unit(f, jnp.zeros(n, dtype=i64), rsyn, jnp.asarray(True), em)
+    f, rv, rt = _restart_rto(f, now, rsyn, em.rto_valid, em.rto_time)
+    em = em._replace(rto_valid=rv, rto_time=rt)
+    m = m & ~rsyn
+
+    # ---- ACK processing ---------------------------------------------------
+    new_ack = m & is_ack & (ack > f.snd_una)
+    acked = ack - f.snd_una
+    pre_snd_una = f.snd_una  # the dup test is an elif on the PRE-ack value
+    pre_in_rec = f.in_rec  # branch on the PRE-ack recovery flag
+    was_syn_sent = new_ack & (f.state == ltcp.SYN_SENT)
+    was_syn_rcvd = new_ack & (f.state == ltcp.SYN_RCVD)
+    f = f._replace(snd_una=jnp.where(new_ack, ack, f.snd_una))
+    clamp = new_ack & (f.snd_nxt < f.snd_una)
+    f = f._replace(snd_nxt=jnp.where(clamp, f.snd_una, f.snd_nxt))
+    f = f._replace(
+        state=jnp.where(was_syn_sent | was_syn_rcvd, ltcp.ESTAB, f.state),
+        # the SYN-ACK consumed the peer's unit 0
+        rcv_nxt=jnp.where(was_syn_sent, 1, f.rcv_nxt),
+    )
+
+    # full-ack recovery exit / slow start / congestion avoidance
+    full_ack = new_ack & pre_in_rec & (ack >= f.recover)
+    f = f._replace(
+        cwnd_fp=jnp.where(full_ack, f.ssthresh_fp, f.cwnd_fp),
+        in_rec=jnp.where(full_ack, False, f.in_rec),
+        dup_acks=jnp.where(full_ack, 0, f.dup_acks),
+    )
+    growth = new_ack & ~pre_in_rec
+    ss = growth & (f.cwnd_fp < f.ssthresh_fp)
+    ca = growth & ~ss
+    f = f._replace(
+        dup_acks=jnp.where(growth, 0, f.dup_acks),
+        cwnd_fp=jnp.minimum(
+            jnp.where(
+                ss,
+                f.cwnd_fp + acked * ltcp.FP,
+                jnp.where(
+                    ca,
+                    f.cwnd_fp + jnp.maximum(1, (ltcp.FP * ltcp.FP) // jnp.maximum(f.cwnd_fp, 1)),
+                    f.cwnd_fp,
+                ),
+            ),
+            ltcp.MAX_CWND_FP,
+        ),
+    )
+    rtt_m = new_ack & (f.rtt_seq >= 0) & (ack > f.rtt_seq)
+    f = _rtt_sample(f, now, rtt_m)
+    f = f._replace(rtt_seq=jnp.where(rtt_m, -1, f.rtt_seq))
+    has_flight = _flight(f) > 0
+    f, rv, rt = _restart_rto(f, now, new_ack & has_flight, em.rto_valid, em.rto_time)
+    em = em._replace(rto_valid=rv, rto_time=rt)
+    f = f._replace(
+        rto_deadline=jnp.where(new_ack & ~has_flight, NEVER, f.rto_deadline)
+    )
+
+    # pure duplicate ACK
+    dup = (
+        m
+        & is_ack
+        & (ack == pre_snd_una)
+        & ~new_ack
+        & (_flight(f) > 0)
+        & ~(is_data | is_syn | is_fin)
+    )
+    infl = dup & f.in_rec
+    f = f._replace(cwnd_fp=jnp.where(infl, f.cwnd_fp + ltcp.FP, f.cwnd_fp))
+    count = dup & ~f.in_rec
+    f = f._replace(dup_acks=jnp.where(count, f.dup_acks + 1, f.dup_acks))
+    fr = count & (f.dup_acks == ltcp.DUP_THRESH)
+    f = f._replace(
+        in_rec=jnp.where(fr, True, f.in_rec),
+        recover=jnp.where(fr, f.snd_nxt, f.recover),
+        ssthresh_fp=jnp.where(
+            fr, jnp.maximum(_flight(f) * ltcp.FP // 2, ltcp.MIN_SSTHRESH_FP), f.ssthresh_fp
+        ),
+    )
+    f = f._replace(
+        cwnd_fp=jnp.where(fr, f.ssthresh_fp + ltcp.DUP_THRESH * ltcp.FP, f.cwnd_fp)
+    )
+    f, em = _pull_back(f, now, fr, em)
+
+    # ---- sender-side teardown / window-opened pump ------------------------
+    snd = m & (f.role == ltcp.SENDER)
+    fin_done = snd & is_fin & (f.snd_una == f.segs + 2)
+    f = f._replace(rcv_nxt=jnp.where(fin_done, 2, f.rcv_nxt))
+    em = em._replace(
+        send_valid=em.send_valid | fin_done,
+        send_flags=jnp.where(fin_done, ltcp.F_ACK, em.send_flags),
+        send_seq=jnp.where(fin_done, f.snd_nxt, em.send_seq),
+        send_ack=jnp.where(fin_done, f.rcv_nxt, em.send_ack),
+        send_size=jnp.where(fin_done, ltcp.HDR_BYTES, em.send_size).astype(jnp.int32),
+        completed_now=em.completed_now | fin_done,
+    )
+    f = f._replace(
+        state=jnp.where(fin_done, ltcp.DONE, f.state),
+        rto_deadline=jnp.where(fin_done, NEVER, f.rto_deadline),
+    )
+    # ACK opened the window and nothing else was sent: pump one unit now
+    opened = snd & ~fin_done & (f.state == ltcp.ESTAB) & ~em.send_valid & _can_send_new(f)
+    f2, em2 = on_pump_vec(f, now, opened)
+    f = _merge_cols(f, f2, opened)
+    # the scalar law keeps the ACK path's RTO arm unless the pump re-arms
+    # (ltcp.py: `if pump.arm_rto is not None: em.arm_rto = ...`) — a plain
+    # masked merge would drop an armed owner event that was never queued,
+    # killing the flow's retransmission timer
+    keep_rv = jnp.where(opened, em.rto_valid | em2.rto_valid, em.rto_valid)
+    keep_rt = jnp.where(opened & em2.rto_valid, em2.rto_time, em.rto_time)
+    em = _merge_emit(em, em2, opened)
+    em = em._replace(rto_valid=keep_rv, rto_time=keep_rt)
+    # sender path returns here in the scalar law
+    m = m & ~snd
+
+    # ---- receiver-side data path ------------------------------------------
+    stray = (
+        m
+        & ((f.state == ltcp.SYN_RCVD) | (f.state == ltcp.ESTAB))
+        & is_syn
+        & is_ack
+    )
+    m = m & ~stray
+    est = m & ((f.state == ltcp.ESTAB) | (f.state == ltcp.SYN_RCVD))
+    data_seg = est & is_data
+    in_order = data_seg & (seq == f.rcv_nxt)
+    f = f._replace(
+        rcv_nxt=jnp.where(in_order, f.rcv_nxt + 1, f.rcv_nxt),
+        rx_segs=f.rx_segs + in_order,
+        rx_bytes=f.rx_bytes + jnp.where(in_order, size - ltcp.HDR_BYTES, 0),
+    )
+    # ACK everything (advance or duplicate)
+    em = em._replace(
+        send_valid=em.send_valid | data_seg,
+        send_flags=jnp.where(data_seg, ltcp.F_ACK, em.send_flags),
+        send_seq=jnp.where(data_seg, f.snd_nxt, em.send_seq),
+        send_ack=jnp.where(data_seg, f.rcv_nxt, em.send_ack),
+        send_size=jnp.where(data_seg, ltcp.HDR_BYTES, em.send_size).astype(jnp.int32),
+    )
+    fin_seg = est & ~is_data & is_fin
+    fin_in_order = fin_seg & (seq == f.rcv_nxt)
+    unit = f.snd_nxt
+    f = f._replace(
+        rcv_nxt=jnp.where(fin_in_order, f.rcv_nxt + 1, f.rcv_nxt),
+        snd_nxt=jnp.where(fin_in_order, f.snd_nxt + 1, f.snd_nxt),
+        rtt_ts=jnp.where(fin_in_order & (f.rtt_seq < 0), now, f.rtt_ts),
+    )
+    f, em = _emit_unit(f, unit, fin_in_order, jnp.asarray(False), em)
+    f = f._replace(state=jnp.where(fin_in_order, ltcp.LAST_ACK, f.state))
+    f, rv, rt = _restart_rto(f, now, fin_in_order, em.rto_valid, em.rto_time)
+    em = em._replace(rto_valid=rv, rto_time=rt)
+    fin_ooo = fin_seg & ~fin_in_order
+    em = em._replace(
+        send_valid=em.send_valid | fin_ooo,
+        send_flags=jnp.where(fin_ooo, ltcp.F_ACK, em.send_flags),
+        send_seq=jnp.where(fin_ooo, f.snd_nxt, em.send_seq),
+        send_ack=jnp.where(fin_ooo, f.rcv_nxt, em.send_ack),
+        send_size=jnp.where(fin_ooo, ltcp.HDR_BYTES, em.send_size).astype(jnp.int32),
+    )
+
+    # LAST_ACK (elif in the scalar law: a flow the est branch just moved
+    # to LAST_ACK is NOT re-examined this stimulus)
+    la = m & ~est & (f.state == ltcp.LAST_ACK)
+    la_done = la & (f.snd_una >= 2)
+    f = f._replace(
+        state=jnp.where(la_done, ltcp.DONE, f.state),
+        rto_deadline=jnp.where(la_done, NEVER, f.rto_deadline),
+    )
+    em = em._replace(completed_now=em.completed_now | la_done)
+    la_stale = la & ~la_done & (is_data | is_fin) & (seq < f.rcv_nxt)
+    f, em = _emit_unit(f, f.snd_una, la_stale, jnp.asarray(True), em)
+    f, rv, rt = _restart_rto(f, now, la_stale, em.rto_valid, em.rto_time)
+    em = em._replace(rto_valid=rv, rto_time=rt)
+
+    return f, em
+
+
+def _merge_cols(a: FlowCols, b: FlowCols, m) -> FlowCols:
+    return FlowCols(*[
+        jnp.where(m, fb, fa) if fa is not fb else fa
+        for fa, fb in zip(a, b)
+    ])
+
+
+def _merge_emit(a: StreamEmit, b: StreamEmit, m) -> StreamEmit:
+    return StreamEmit(*[
+        jnp.where(m, fb, fa) if fa is not fb else fa for fa, fb in zip(a, b)
+    ])
+
+
+_FIELD_MAP = [
+    # (FlowCols field, cl field, sv field)
+    ("state", "cl_state", "sv_state"),
+    ("snd_una", "cl_snd_una", "sv_snd_una"),
+    ("snd_nxt", "cl_snd_nxt", "sv_snd_nxt"),
+    ("rcv_nxt", "cl_rcv_nxt", "sv_rcv_nxt"),
+    ("cwnd_fp", "cl_cwnd_fp", "sv_cwnd_fp"),
+    ("ssthresh_fp", "cl_ssthresh_fp", "sv_ssthresh_fp"),
+    ("dup_acks", "cl_dup_acks", "sv_dup_acks"),
+    ("in_rec", "cl_in_rec", "sv_in_rec"),
+    ("recover", "cl_recover", "sv_recover"),
+    ("max_sent", "cl_max_sent", "sv_max_sent"),
+    ("srtt", "cl_srtt", "sv_srtt"),
+    ("rttvar", "cl_rttvar", "sv_rttvar"),
+    ("rto", "cl_rto", "sv_rto"),
+    ("rtt_seq", "cl_rtt_seq", "sv_rtt_seq"),
+    ("rtt_ts", "cl_rtt_ts", "sv_rtt_ts"),
+    ("rto_deadline", "cl_rto_deadline", "sv_rto_deadline"),
+    ("rto_evt", "cl_rto_evt", "sv_rto_evt"),
+    ("tx_segs", "cl_tx_segs", "sv_tx_segs"),
+    ("retransmits", "cl_retransmits", "sv_retransmits"),
+    ("rx_segs", None, "sv_rx_segs"),
+    ("rx_bytes", None, "sv_rx_bytes"),
+    ("completed", "cl_completed", "sv_completed"),
+]
+
+
+def gather_cols(st: StreamState, flow, server_mask, st_segs, st_mss, st_last):
+    """Unified [N] FlowCols for this slot: client lanes read their own
+    columns; server lanes read the flow's server columns at index ``flow``."""
+    n = flow.shape[0]
+    idx = jnp.clip(flow, 0, n - 1)
+    vals = {}
+    for fc, cl, sv in _FIELD_MAP:
+        sv_col = getattr(st, sv)[idx]
+        if cl is None:  # rx accounting exists on the server side only
+            vals[fc] = sv_col
+        else:
+            vals[fc] = jnp.where(server_mask, sv_col, getattr(st, cl))
+    vals["role"] = jnp.where(server_mask, ltcp.RECEIVER, ltcp.SENDER).astype(
+        jnp.int32
+    )
+    # transfer shape: the client lane's static tables; 0 segs on the server
+    # role (its units 0/1 are control segments, like the scalar receiver)
+    vals["segs"] = jnp.where(server_mask, 0, st_segs)
+    vals["mss"] = jnp.where(server_mask, 0, st_mss)
+    vals["last_bytes"] = jnp.where(server_mask, 0, st_last)
+    return FlowCols(**vals)
+
+
+def scatter_cols(
+    st: StreamState, f: FlowCols, flow, client_mask, server_mask
+) -> StreamState:
+    """Write the slot's updated FlowCols back: client columns in place
+    under ``client_mask``; server columns scattered at ``flow`` under
+    ``server_mask`` (unique indices: one event per lane per slot, one
+    client lane per flow)."""
+    n = flow.shape[0]
+    sv_idx = jnp.where(server_mask, flow, n)  # n = dropped
+    out = {}
+    for fc, cl, sv in _FIELD_MAP:
+        new = getattr(f, fc)
+        if cl is not None:
+            out[cl] = jnp.where(client_mask, new, getattr(st, cl))
+        out[sv] = getattr(st, sv).at[sv_idx].set(new, mode="drop")
+    return st._replace(**out)

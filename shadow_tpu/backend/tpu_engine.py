@@ -21,6 +21,7 @@ from ..config.options import ConfigOptions
 from ..core import time as stime
 from ..models.base import create_model
 from ..models.phold import Phold
+from ..models.tcpflow import StreamClient, StreamServer
 from ..models.tgen import Ping, TgenClient, TgenMesh, TgenServer
 from ..net import codel as codel_mod
 from ..net.token_bucket import bucket_params
@@ -75,6 +76,9 @@ class TpuEngine:
         p_peer = np.zeros(n, dtype=np.int32)
         p_count = np.zeros(n, dtype=np.int64)
         p_stride = np.ones(n, dtype=np.int64)
+        st_segs = np.zeros(n, dtype=np.int64)
+        st_mss = np.zeros(n, dtype=np.int64)
+        st_last = np.zeros(n, dtype=np.int64)
         init_events: list[tuple[int, int, int, int, int, int]] = []  # lane,t,kind,src,seq,size
         local_seq0 = np.ones(n, dtype=np.int64)
 
@@ -121,6 +125,17 @@ class TpuEngine:
                 init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
             elif isinstance(app, TgenServer):
                 model[hid] = lanes.M_TGEN_SERVER
+                init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
+            elif isinstance(app, StreamClient):
+                model[hid] = lanes.M_STREAM_CLIENT
+                p_peer[hid] = self._resolve(app.server, n)
+                st_segs[hid], st_last[hid] = app.fs.segs, app.fs.last_bytes
+                st_mss[hid] = app.mss
+                init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
+            elif isinstance(app, StreamServer):
+                model[hid] = lanes.M_STREAM_SERVER
+                # the start marker anchors window boundaries exactly like
+                # the CPU engine's start task (flows open on the first SYN)
                 init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
             elif isinstance(app, Ping):
                 if app.peer is None:
@@ -181,6 +196,9 @@ class TpuEngine:
             p_count=jnp.asarray(p_count),
             p_stride=jnp.asarray(p_stride),
             codel_div=jnp.asarray(np.array(codel_mod.CODEL_DIV, dtype=np.int64)),
+            st_segs=jnp.asarray(st_segs),
+            st_mss=jnp.asarray(st_mss),
+            st_last=jnp.asarray(st_last),
         )
         self._init_events = init_events
         self._local_seq0 = local_seq0
@@ -215,6 +233,15 @@ class TpuEngine:
         q_aux = np.take_along_axis(q_aux, order, axis=1)
         q_size = np.take_along_axis(q_size, order, axis=1)
 
+        from . import lanes_stream as lstr
+
+        stream0 = lstr.init_stream_state(
+            n,
+            np.asarray(self.tables.st_segs),
+            np.asarray(self.tables.st_mss),
+            np.asarray(self.tables.st_last),
+        )
+
         up_burst = np.asarray(self.tables.up_burst)
         dn_burst = np.asarray(self.tables.dn_burst)
         z64 = np.zeros(n, dtype=np.int64)
@@ -222,6 +249,8 @@ class TpuEngine:
             q_time=jnp.asarray(q_time),
             q_aux=jnp.asarray(q_aux),
             q_size=jnp.asarray(q_size),
+            q_pay=jnp.zeros((n, c), dtype=jnp.int64),
+            stream=stream0,
             send_seq=jnp.asarray(z64),
             local_seq=jnp.asarray(self._local_seq0),
             app_draws=jnp.asarray(z64),
@@ -341,6 +370,25 @@ class TpuEngine:
         add("lane_drop_codel", int(np.asarray(s.n_codel).sum()))
         add("lane_drop_queue", int(np.asarray(s.n_queue).sum()))
         add("lane_sends", int(np.asarray(s.n_sends).sum()))
+
+        if self.params.stream_present:
+            st = s.stream
+            cl_mask = model == lanes.M_STREAM_CLIENT
+            done = np.asarray(st.cl_completed) & cl_mask
+            if done.any():
+                # tx/retransmit totals count at completion, like the CPU
+                # _track — including zero-valued keys (counter-set parity)
+                counters["stream_complete"] = int(done.sum())
+                counters["stream_tx_segs"] = int(np.asarray(st.cl_tx_segs)[done].sum())
+                counters["stream_retransmits"] = int(
+                    np.asarray(st.cl_retransmits)[done].sum()
+                )
+            add("stream_rx_bytes", int(np.asarray(st.sv_rx_bytes)[cl_mask].sum()))
+            add("stream_rx_segs", int(np.asarray(st.sv_rx_segs)[cl_mask].sum()))
+            add(
+                "stream_flows_done",
+                int((np.asarray(st.sv_completed) & cl_mask).sum()),
+            )
 
         return SimResult(
             sim_time_ns=self.params.stop_time,

@@ -318,6 +318,25 @@ def _opt_time(v: Any) -> Optional[int]:
     return None if v is None else units.parse_time(v)
 
 
+def _parse_final_state(v: Any, host: str) -> Any:
+    """Validate/normalize expected_final_state at parse time: "running",
+    {exited: code}, or {signaled: SIG} (signal normalized like
+    shutdown_signal) — a typo must fail the config, not the whole run."""
+    if v in ("running", "exited"):
+        return v
+    if isinstance(v, dict) and len(v) == 1:
+        if "exited" in v:
+            return {"exited": int(v["exited"])}
+        if "signaled" in v:
+            return {"signaled": _parse_signal(v["signaled"], host)}
+        if "running" in v:
+            return "running"
+    raise ConfigError(
+        f"host {host!r}: expected_final_state must be 'running', "
+        f"{{exited: CODE}}, or {{signaled: SIG}}; got {v!r}"
+    )
+
+
 def _parse_signal(v: Any, host: str) -> str:
     """Validate a signal name (or number) at parse time — a typo'd
     shutdown_signal must not silently become SIGTERM."""
@@ -352,7 +371,9 @@ def _parse_host(name: str, doc: dict[str, Any]) -> HostOptions:
                 start_time=units.parse_time(p.pop("start_time", 0)),
                 shutdown_time=_opt_time(p.pop("shutdown_time", None)),
                 shutdown_signal=_parse_signal(p.pop("shutdown_signal", "SIGTERM"), name),
-                expected_final_state=p.pop("expected_final_state", {"exited": 0}),
+                expected_final_state=_parse_final_state(
+                    p.pop("expected_final_state", {"exited": 0}), name
+                ),
             )
         )
         if p:

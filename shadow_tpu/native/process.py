@@ -953,9 +953,14 @@ class ManagedApp:
         if self.finished or self.proc is None:
             return
         self.finished = True
-        self.final_state = ("running",)  # alive at stop_time (then reaped)
-        self.proc.kill()
-        self.exit_code = self.proc.wait()
+        if self.proc.poll() is not None:
+            # died unobserved (no exit handshake): classify the real exit
+            self.exit_code = self.proc.wait()
+            self._classify_exit()
+        else:
+            self.final_state = ("running",)  # alive at stop_time (reap now)
+            self.proc.kill()
+            self.exit_code = self.proc.wait()
         if self._api is not None:
             self._release_ports(self._api)
             self._api.count("managed_killed_at_stop")

@@ -222,3 +222,127 @@ hosts:
 
     with pytest.raises(RuntimeError, match="lane-queue overflow"):
         TE(ConfigOptions.from_yaml(yaml)).run(mode="step")
+
+
+STREAM_PAIR = """
+general: {stop_time: 30s, seed: 5}
+experimental: {tpu_lane_queue_capacity: 128}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        node [ id 1 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        edge [ source 0 target 1 latency "15 ms" ]
+      ]
+hosts:
+  c: {network_node_id: 0, processes: [{path: stream-client, args: [--server, s, --size, 200kB]}]}
+  s: {network_node_id: 1, processes: [{path: stream-server}]}
+"""
+
+
+def test_stream_tcp_parity():
+    # the vectorized lane-TCP vs the scalar ltcp law: full handshake,
+    # slow start, teardown — bit-identical wire traffic
+    cpu, tpu = both_logs(STREAM_PAIR)
+    assert cpu.counters["stream_complete"] == 1
+    assert cpu.counters["stream_rx_bytes"] == 200_000
+    assert cpu.log_tuples() == tpu.log_tuples()
+    for k in ("stream_complete", "stream_rx_bytes", "stream_rx_segs",
+              "stream_tx_segs", "stream_flows_done", "stream_retransmits"):
+        assert cpu.counters.get(k) == tpu.counters.get(k), k
+
+
+def test_stream_tcp_lossy_parity():
+    yaml = STREAM_PAIR.replace('latency "15 ms"', 'latency "15 ms" packet_loss 0.03')
+    cpu, tpu = both_logs(yaml)
+    assert cpu.counters["stream_complete"] == 1
+    assert cpu.counters["stream_retransmits"] > 0  # recovery exercised
+    assert cpu.log_tuples() == tpu.log_tuples()
+    assert cpu.counters.get("stream_retransmits") == tpu.counters.get("stream_retransmits")
+
+
+STREAM_STAR = """
+general: {stop_time: 60s, seed: 9}
+experimental: {tpu_lane_queue_capacity: 512}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 0 latency "5 ms" packet_loss 0.01 ]
+      ]
+hosts:
+  c: {count: 6, network_node_id: 0, processes: [{path: stream-client, args: [--server, srv, --size, 80kB]}]}
+  srv: {network_node_id: 0, processes: [{path: stream-server}]}
+"""
+
+
+def test_stream_star_parity():
+    # 6 concurrent flows into one server lane: exercises the per-flow
+    # gather/scatter and multi-flow RTO/pump interleaving
+    cpu, tpu = both_logs(STREAM_STAR)
+    assert cpu.counters["stream_complete"] == 6
+    assert cpu.counters["stream_rx_bytes"] == 6 * 80_000
+    assert cpu.log_tuples() == tpu.log_tuples()
+    assert cpu.counters.get("stream_flows_done") == tpu.counters.get("stream_flows_done")
+
+
+def test_stream_device_mode_parity():
+    cpu, tpu = both_logs(STREAM_PAIR, mode="device")
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+def test_vector_law_keeps_ack_rto_arm_through_opened_pump():
+    # regression: an ACK that shrinks the RTO (arming a new owner event)
+    # AND opens the send window used to lose the arm when the inline pump's
+    # emit was merged wholesale — leaving rto_evt naming an event that was
+    # never queued (a dead retransmission timer)
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.backend import lanes_stream as lstr
+    from shadow_tpu.net import ltcp
+
+    segs = jnp.array([50])
+    mss = jnp.array([1448])
+    last = jnp.array([1448])
+    st = lstr.init_stream_state(1, segs, mss, last)
+    st = st._replace(
+        cl_state=jnp.array([ltcp.ESTAB], dtype=jnp.int32),
+        cl_snd_una=jnp.array([5]),
+        cl_snd_nxt=jnp.array([10]),
+        cl_rcv_nxt=jnp.array([1]),
+        cl_max_sent=jnp.array([10]),
+        cl_cwnd_fp=jnp.array([20 * ltcp.FP]),
+        cl_srtt=jnp.array([-1]),  # first RTT sample -> RTO collapses to 200ms
+        cl_rttvar=jnp.array([0]),
+        cl_rto=jnp.array([900_000_000]),
+        cl_rtt_seq=jnp.array([5]),
+        cl_rtt_ts=jnp.array([970_000_000]),
+        cl_rto_deadline=jnp.array([1_900_000_000]),
+        cl_rto_evt=jnp.array([1_900_000_000]),
+    )
+    f = lstr.gather_cols(st, jnp.array([0]), jnp.array([False]), segs, mss, last)
+    now = jnp.int64(1_000_000_000)
+    # mirror the scalar law on the identical state
+    fs = ltcp.FlowState(role=ltcp.SENDER, segs=50, mss=1448, last_bytes=1448,
+                        state=ltcp.ESTAB, snd_una=5, snd_nxt=10, rcv_nxt=1,
+                        max_sent=10, cwnd_fp=20 * ltcp.FP, srtt=-1,
+                        rttvar=0, rto=900_000_000, rtt_seq=5,
+                        rtt_ts=970_000_000, rto_deadline=1_900_000_000,
+                        rto_evt=1_900_000_000)
+    em_ref = ltcp.on_segment(fs, int(now), ltcp.F_ACK, 0, 6)
+    f2, em = lstr.on_segment_vec(
+        f, now, jnp.array([True]), jnp.array([ltcp.F_ACK]),
+        jnp.array([0]), jnp.array([6]), jnp.array([ltcp.HDR_BYTES], dtype=jnp.int64),
+    )
+    assert em_ref.arm_rto is not None  # the scenario arms a shrunk owner
+    assert bool(em.rto_valid[0])
+    assert int(em.rto_time[0]) == em_ref.arm_rto
+    assert int(f2.rto_evt[0]) == fs.rto_evt
+    assert bool(em.send_valid[0]) == (em_ref.send is not None)
