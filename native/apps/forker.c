@@ -1,0 +1,47 @@
+/* forker: fork/wait test plugin (no exec).  Parent forks N children; each
+ * child sleeps child_ms of simulated time, prints, and exits with its
+ * index; the parent waits for each and prints the reaped statuses. */
+#define _GNU_SOURCE
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static uint64_t now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (uint64_t)ts.tv_sec * 1000u + (uint64_t)(ts.tv_nsec / 1000000);
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    int n = argc > 1 ? atoi(argv[1]) : 2;
+    int child_ms = argc > 2 ? atoi(argv[2]) : 500;
+    uint64_t t0 = now_ms();
+    for (int i = 0; i < n; i++) {
+        pid_t pid = fork();
+        if (pid < 0) {
+            perror("fork");
+            return 1;
+        }
+        if (pid == 0) {
+            struct timespec ts = {child_ms / 1000, (child_ms % 1000) * 1000000L};
+            nanosleep(&ts, NULL);
+            printf("child %d done at +%llu ms\n", i,
+                   (unsigned long long)(now_ms() - t0));
+            return 40 + i;
+        }
+        int st = 0;
+        pid_t got = waitpid(pid, &st, 0);
+        if (got != pid || !WIFEXITED(st) || WEXITSTATUS(st) != 40 + i) {
+            printf("bad wait: got=%d st=%x\n", (int)got, st);
+            return 1;
+        }
+    }
+    printf("parent done n=%d elapsed=%llu ms\n", n,
+           (unsigned long long)(now_ms() - t0));
+    return 0;
+}

@@ -54,6 +54,12 @@ enum {
                               payload = nfds * shim_pollfd;
                               reply ret=nready, payload = nfds * u32 revents */
     SHIM_OP_FIONREAD = 17, /* args[0]=fd; reply args[1]=readable bytes */
+    SHIM_OP_PREFORK = 18,  /* reply payload = path of the child's channel */
+    SHIM_OP_FORKED = 19,   /* args[0]=child os pid (parent side, post-fork) */
+    SHIM_OP_CHILD_START = 20, /* child's first message on its own channel;
+                                 args[0]=os pid; parked until resumed */
+    SHIM_OP_WAITPID = 21,  /* args[0]=pid (-1 any) args[1]=options(WNOHANG=1);
+                              reply ret=pid|0, args[1]=wait status */
 };
 
 /* poll event bits (mirror Linux poll.h values) */

@@ -48,7 +48,9 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
+#include <sys/resource.h>
 #include <sys/time.h>
+#include <sys/wait.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -58,6 +60,10 @@
 
 static shim_shmem *g_shm = NULL;
 static int g_ready = 0;
+/* exit code captured by the exit wrapper so the destructor's farewell can
+ * report it (fork children are the PLUGIN's OS children; the manager
+ * cannot waitpid them itself) */
+static int g_exit_code = 0;
 
 /* per-fd shim state: kind + O_NONBLOCK, indexed by the real fd number */
 enum { VK_NONE = 0, VK_SOCKET = 1 };
@@ -220,11 +226,7 @@ static void shim_warn(const char *what) {
     (void)!real_write(2, "\n", 1);
 }
 
-__attribute__((constructor)) static void shim_init(void) {
-    const char *path = getenv("SHADOW_TPU_SHM");
-    resolve_reals();
-    if (!path) return; /* not under the simulator: become a no-op */
-
+static void shim_attach(const char *path) {
     int fd = open(path, O_RDWR);
     if (fd < 0) shim_abort("cannot open SHADOW_TPU_SHM");
     struct stat st;
@@ -236,7 +238,13 @@ __attribute__((constructor)) static void shim_init(void) {
     if (g_shm == MAP_FAILED) shim_abort("mmap failed");
     if (g_shm->magic != SHIM_ABI_MAGIC || g_shm->abi_size != sizeof(shim_shmem))
         shim_abort("ABI mismatch between shim and manager");
+}
 
+__attribute__((constructor)) static void shim_init(void) {
+    const char *path = getenv("SHADOW_TPU_SHM");
+    resolve_reals();
+    if (!path) return; /* not under the simulator: become a no-op */
+    shim_attach(path);
     g_ready = 1;
     /* report in and wait for the go signal: from here on the plugin only
      * runs while the manager has handed it the turn */
@@ -246,10 +254,10 @@ __attribute__((constructor)) static void shim_init(void) {
 __attribute__((destructor)) static void shim_fini(void) {
     if (!g_ready) return;
     g_ready = 0;
-    int64_t args[6] = {0};
     shim_msg *tx = &g_shm->to_shadow;
     tx->op = SHIM_OP_EXIT;
-    for (int i = 0; i < 6; i++) tx->args[i] = args[i];
+    tx->args[0] = g_exit_code;
+    for (int i = 1; i < 6; i++) tx->args[i] = 0;
     tx->payload_len = 0;
     msg_publish(tx); /* no reply: the process is on its way out */
 }
@@ -269,7 +277,7 @@ static int reserve_fd(void) {
     /* O_PATH: every uninterposed data syscall on the reservation (readv,
      * recvmsg, a dup...) fails loudly with EBADF instead of reading
      * /dev/null's silent EOF */
-    int fd = open("/dev/null", O_PATH);
+    int fd = open("/dev/null", O_PATH | O_CLOEXEC);
     if (fd < 0) return -1;
     if (fd >= SHIM_MAX_FDS) {
         real_close(fd);
@@ -408,6 +416,45 @@ static void fill_sockaddr(struct sockaddr *addr, socklen_t *alen, uint32_t ip,
         sin->sin_addr.s_addr = ip;
         sin->sin_port = htons(port);
         *alen = sizeof(struct sockaddr_in);
+    }
+}
+
+/* Real-fd pipes (command substitution, shell pipelines) connect managed
+ * processes that only run when the simulation schedules them: a NATIVE
+ * blocking read/write would deadlock the turn.  Poll non-blockingly and
+ * yield 1ms of SIMULATED time between attempts — the peer gets turns,
+ * the wait costs simulated (not wall) time. */
+static void sim_yield_1ms(void) {
+    int64_t args[6] = {1000000, 0, 0, 0, 0, 0};
+    shim_call(SHIM_OP_NANOSLEEP, args, NULL, 0, NULL, NULL, NULL);
+}
+
+/* per-fd fifo-ness cache: 0 unknown, 1 fifo, 2 not — one fstat per fd
+ * instead of one per I/O call; close() invalidates */
+static uint8_t fd_fifo_cache[SHIM_MAX_FDS];
+
+static int fd_is_fifo(int fd) {
+    if (fd < 0 || fd >= SHIM_MAX_FDS) return 0;
+    if (fd_fifo_cache[fd] == 0) {
+        struct stat st;
+        fd_fifo_cache[fd] =
+            (fstat(fd, &st) == 0 && S_ISFIFO(st.st_mode)) ? 1 : 2;
+    }
+    return fd_fifo_cache[fd] == 1;
+}
+
+static int fd_nonblock(int fd) {
+    int fl = real_fcntl(fd, F_GETFL, 0);
+    return fl >= 0 && (fl & O_NONBLOCK);
+}
+
+static void pipe_wait(int fd, short events) {
+    for (;;) {
+        struct pollfd pfd = {fd, events, 0};
+        int r = real_poll(&pfd, 1, 0);
+        if (r > 0) return;                      /* ready or hup */
+        if (r < 0 && errno != EINTR) return;    /* real error: surface it */
+        if (r == 0) sim_yield_1ms();            /* EINTR: just retry */
     }
 }
 
@@ -567,7 +614,11 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
 }
 
 ssize_t write(int fd, const void *buf, size_t n) {
-    if (!is_vfd(fd)) return real_write(fd, buf, n);
+    if (!is_vfd(fd)) {
+        if (g_ready && fd_is_fifo(fd) && !fd_nonblock(fd))
+            pipe_wait(fd, POLLOUT);
+        return real_write(fd, buf, n);
+    }
     return vfd_sendto(fd, buf, n, 0, 0, 0);
 }
 
@@ -587,7 +638,11 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
 }
 
 ssize_t read(int fd, void *buf, size_t n) {
-    if (!is_vfd(fd)) return real_read(fd, buf, n);
+    if (!is_vfd(fd)) {
+        if (g_ready && fd_is_fifo(fd) && !fd_nonblock(fd))
+            pipe_wait(fd, POLLIN);
+        return real_read(fd, buf, n);
+    }
     return vfd_recvfrom(fd, buf, n, 0, NULL, NULL);
 }
 
@@ -599,6 +654,7 @@ int shutdown(int fd, int how) {
 }
 
 int close(int fd) {
+    if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
     if (!is_vfd(fd)) {
         if (g_ready) epoll_forget_fd(fd); /* fd may be an epfd */
         return real_close(fd);
@@ -1200,4 +1256,168 @@ int gethostname(char *name, size_t len) {
     if (!g_ready || !simname) return real_ghname(name, len);
     snprintf(name, len, "%s", simname);
     return 0;
+}
+
+
+/* ---------------------------------------------------------- fork / wait */
+
+void exit(int status) {
+    static void (*real_exit)(int) __attribute__((noreturn));
+    if (!real_exit) *(void **)&real_exit = dlsym(RTLD_NEXT, "exit");
+    g_exit_code = status;
+    real_exit(status);
+    __builtin_unreachable();
+}
+
+/* Fork under the simulator: the parent asks the manager to prepare a
+ * fresh channel, the child attaches it and parks until the simulation
+ * hands it the turn — both processes only ever run while scheduled, the
+ * turn-taking the reference enforces per managed thread
+ * (managed_thread.rs native_clone).  The child env points at its own
+ * channel so an exec'd program's fresh shim re-registers on it. */
+pid_t fork(void) {
+    static pid_t (*real_fork)(void);
+    if (!real_fork) *(void **)&real_fork = dlsym(RTLD_NEXT, "fork");
+    if (!g_ready) return real_fork();
+    char path[480];
+    uint32_t len = sizeof(path) - 1;
+    int64_t ret =
+        shim_call(SHIM_OP_PREFORK, NULL, NULL, 0, path, &len, NULL);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    path[len] = 0;
+    pid_t pid = real_fork();
+    if (pid < 0) return pid;
+    if (pid == 0) {
+        setenv("SHADOW_TPU_SHM", path, 1);
+        shim_attach(path);
+        int64_t args[6] = {getpid(), 0, 0, 0, 0, 0};
+        /* parks here until the child's start event fires in the sim */
+        shim_call(SHIM_OP_CHILD_START, args, NULL, 0, NULL, NULL, NULL);
+        return 0;
+    }
+    int64_t args[6] = {pid, 0, 0, 0, 0, 0};
+    shim_call(SHIM_OP_FORKED, args, NULL, 0, NULL, NULL, NULL);
+    return pid;
+}
+
+/* waitpid must park in SIMULATED time: the child only runs when the sim
+ * schedules it, so a native blocking waitpid would deadlock the turn. */
+pid_t waitpid(pid_t pid, int *wstatus, int options) {
+    static pid_t (*real_waitpid)(pid_t, int *, int);
+    if (!real_waitpid) *(void **)&real_waitpid = dlsym(RTLD_NEXT, "waitpid");
+    if (!g_ready) return real_waitpid(pid, wstatus, options);
+    int64_t args[6] = {pid, (options & WNOHANG) ? 1 : 0, 0, 0, 0, 0};
+    int64_t reply[6];
+    int64_t ret = shim_call(SHIM_OP_WAITPID, args, NULL, 0, NULL, NULL, reply);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    if (ret > 0 && wstatus) *wstatus = (int)reply[1];
+    return (pid_t)ret;
+}
+
+pid_t wait(int *wstatus) { return waitpid(-1, wstatus, 0); }
+
+pid_t wait3(int *wstatus, int options, struct rusage *ru) {
+    if (ru) memset(ru, 0, sizeof(*ru));
+    return waitpid(-1, wstatus, options);
+}
+
+pid_t wait4(pid_t pid, int *wstatus, int options, struct rusage *ru) {
+    if (ru) memset(ru, 0, sizeof(*ru));
+    return waitpid(pid, wstatus, options);
+}
+
+/* Capture main()'s return value: glibc's __libc_start_main calls its
+ * internal exit alias (not the PLT), so the exit() wrapper alone misses
+ * `return code;` from main.  Wrapping main via __libc_start_main is the
+ * standard LD_PRELOAD technique. */
+static int (*g_real_main)(int, char **, char **);
+
+static int shim_main_wrapper(int argc, char **argv, char **envp) {
+    int r = g_real_main(argc, argv, envp);
+    g_exit_code = r;
+    return r;
+}
+
+int __libc_start_main(int (*m)(int, char **, char **), int argc, char **av,
+                      void (*init)(void), void (*fini)(void),
+                      void (*rtld_fini)(void), void *stack_end) {
+    static int (*real_start)(int (*)(int, char **, char **), int, char **,
+                             void (*)(void), void (*)(void), void (*)(void),
+                             void *);
+    if (!real_start)
+        *(void **)&real_start = dlsym(RTLD_NEXT, "__libc_start_main");
+    g_real_main = m;
+    return real_start(shim_main_wrapper, argc, av, init, fini, rtld_fini,
+                      stack_end);
+}
+
+/* exec: the caller may pass a hand-built envp (bash execs commands with
+ * its internal export list, not libc environ), which would carry the
+ * PARENT's channel path into the child program.  Rewrite the env so the
+ * exec'd program's fresh shim attaches THIS process's channel. */
+static int shim_execve(const char *path, char *const argv[],
+                       char *const envp[]) {
+    static int (*real_execve)(const char *, char *const[], char *const[]);
+    if (!real_execve) *(void **)&real_execve = dlsym(RTLD_NEXT, "execve");
+    if (!g_ready) return real_execve(path, argv, envp);
+    const char *shm = getenv("SHADOW_TPU_SHM");
+    const char *preload = getenv("LD_PRELOAD");
+    int n = 0;
+    while (envp && envp[n]) n++;
+    char **nenv = malloc((size_t)(n + 3) * sizeof(char *));
+    if (!nenv) return real_execve(path, argv, envp);
+    char shm_kv[512], pre_kv[1024];
+    snprintf(shm_kv, sizeof(shm_kv), "SHADOW_TPU_SHM=%s", shm ? shm : "");
+    snprintf(pre_kv, sizeof(pre_kv), "LD_PRELOAD=%s", preload ? preload : "");
+    int j = 0;
+    for (int i = 0; i < n; i++) {
+        if (strncmp(envp[i], "SHADOW_TPU_SHM=", 15) == 0) continue;
+        if (strncmp(envp[i], "LD_PRELOAD=", 11) == 0) continue;
+        nenv[j++] = envp[i];
+    }
+    if (shm) nenv[j++] = shm_kv;
+    if (preload) nenv[j++] = pre_kv;
+    nenv[j] = NULL;
+    int r = real_execve(path, argv, nenv);
+    free(nenv); /* only reached on failure */
+    return r;
+}
+
+int execve(const char *path, char *const argv[], char *const envp[]) {
+    return shim_execve(path, argv, envp);
+}
+
+int execv(const char *path, char *const argv[]) {
+    extern char **environ;
+    return shim_execve(path, argv, environ);
+}
+
+int execvp(const char *file, char *const argv[]) {
+    /* resolve via PATH the way libc would, then run our env-fixed exec */
+    extern char **environ;
+    if (strchr(file, '/')) return shim_execve(file, argv, environ);
+    const char *pathv = getenv("PATH");
+    if (!pathv) pathv = "/bin:/usr/bin";
+    char buf[4096];
+    const char *p = pathv;
+    while (*p) {
+        const char *colon = strchr(p, ':');
+        size_t len = colon ? (size_t)(colon - p) : strlen(p);
+        if (len + strlen(file) + 2 < sizeof(buf)) {
+            memcpy(buf, p, len);
+            buf[len] = '/';
+            strcpy(buf + len + 1, file);
+            if (access(buf, X_OK) == 0) return shim_execve(buf, argv, environ);
+        }
+        if (!colon) break;
+        p = colon + 1;
+    }
+    errno = ENOENT;
+    return -1;
 }

@@ -518,6 +518,15 @@ class CpuEngine:
 
     def _run_rounds(self, scheduler, on_window) -> "SimResult":
         t0 = wall_time.perf_counter()
+        try:
+            return self._round_loop(scheduler, on_window, t0)
+        except BaseException:
+            # a failing round must still reap managed OS processes (and
+            # their fork children) — no orphans outlive the simulation
+            self.finalize()
+            raise
+
+    def _round_loop(self, scheduler, on_window, t0) -> "SimResult":
         while True:
             start = self.next_event_time()
             if start >= self.stop_time or start == stime.NEVER:

@@ -32,12 +32,17 @@ OP_GETPEERNAME = 14
 OP_SOCKERR = 15
 OP_POLL = 16
 OP_FIONREAD = 17
+OP_PREFORK = 18
+OP_FORKED = 19
+OP_CHILD_START = 20
+OP_WAITPID = 21
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
     6: "sendto", 7: "recvfrom", 8: "close", 9: "connect", 10: "getsockname",
     11: "listen", 12: "accept", 13: "shutdown", 14: "getpeername",
-    15: "sockerr", 16: "poll", 17: "fionread",
+    15: "sockerr", 16: "poll", 17: "fionread", 18: "prefork", 19: "forked",
+    20: "child-start", 21: "waitpid",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)

@@ -48,7 +48,7 @@ EPHEMERAL_PORT_START = 49152
 # errno values the manager hands back over the channel (Linux numbers via
 # the stdlib so the table can't drift)
 from errno import (  # noqa: E402
-    EADDRINUSE, EAGAIN, EALREADY, EBADF, ECONNREFUSED, ECONNRESET,
+    EADDRINUSE, EAGAIN, EALREADY, EBADF, ECHILD, ECONNREFUSED, ECONNRESET,
     EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINVAL, EISCONN, ENOSYS,
     ENOTCONN, EPIPE, ETIMEDOUT,
 )
@@ -91,9 +91,10 @@ class _VSocket:
     shim — a reserved real kernel fd, so it can't collide in the plugin)."""
 
     __slots__ = ("vfd", "kind", "port", "default_dst", "queue", "sim",
-                 "listener", "accept_q", "recv_shut")
+                 "listener", "accept_q", "recv_shut", "refs")
 
     def __init__(self, vfd: int, kind: str) -> None:
+        self.refs = 1  # fork shares the socket across processes
         self.vfd = vfd
         self.kind = kind  # "udp" | "tcp" | "listen"
         self.port: Optional[int] = None
@@ -105,20 +106,65 @@ class _VSocket:
         self.recv_shut = False  # SHUT_RD: reads return EOF / accept EINVAL
 
 
+class _Proc:
+    """One OS process of a managed app: the root (spawned by the manager)
+    or a fork child (spawned by the plugin; registered via the PREFORK /
+    FORKED / CHILD_START handshake).  Each has its own channel, blocked-op
+    slot, and fd namespace (fork copies the parent's table, sharing the
+    refcounted socket objects, exactly like kernel fd inheritance)."""
+
+    __slots__ = ("chan", "os_pid", "popen", "parent", "blocked", "sockets",
+                 "dead", "label", "saw_start")
+
+    def __init__(self, chan, os_pid=None, popen=None, parent=None, label="root"):
+        self.saw_start = False
+        self.chan = chan
+        self.os_pid = os_pid  # child pid (root uses popen.pid)
+        self.popen = popen  # root only
+        self.parent = parent  # _Proc or None
+        self.blocked: Optional[tuple] = None
+        self.sockets: dict[int, _VSocket] = {}
+        self.dead = False
+        self.label = label
+
+    @property
+    def pid(self) -> int:
+        return self.popen.pid if self.popen is not None else self.os_pid
+
+    def alive(self) -> bool:
+        if self.dead:
+            return False
+        if self.popen is not None:
+            return self.popen.poll() is None
+        # fork children are the plugin's OS children: they stay zombies
+        # until the plugin reaps them, and a zombie answers kill(pid, 0) —
+        # read the real state instead
+        try:
+            with open(f"/proc/{self.os_pid}/stat", "rb") as f:
+                fields = f.read().rsplit(b") ", 1)
+            return not fields[1].startswith(b"Z")
+        except (FileNotFoundError, ProcessLookupError, IndexError):
+            return False
+
+
 class ManagedApp:
-    """Drives one real binary as a simulation app."""
+    """Drives one real binary as a simulation app (plus any processes it
+    forks — each fork child gets its own channel and turn-taking slot)."""
 
     def __init__(self, argv: list[str], environment: Optional[dict] = None) -> None:
         self.argv = argv
         self.environment = dict(environment or {})
         self.proc: Optional[subprocess.Popen] = None
-        self.chan: Optional[abi.ShmChannel] = None
-        self.sockets: dict[int, _VSocket] = {}
-        # one parked call at a time (the protocol strictly alternates):
+        # process set: procs[0] is the root; fork children append.  One
+        # parked call per PROC (each channel strictly alternates):
         # ("sleep", deadline) | ("recvfrom", vfd, max_len) | ("recv", vfd, n)
         # | ("send", vfd, data) | ("connect", vfd) | ("accept", vfd, child_fd)
-        # | ("poll", entries, deadline|None)
-        self._blocked: Optional[tuple] = None
+        # | ("poll", entries, deadline|None) | ("waitpid", pid)
+        self.procs: list[_Proc] = []
+        self.zombies: list[tuple[int, int, _Proc]] = []  # (pid, wstatus, parent)
+        self._pending_chans: list = []  # channels built at PREFORK
+        self._child_idx = 0
+        self._cur: Optional[_Proc] = None  # proc whose turn is being serviced
         self.finished = False
         self.exit_code: Optional[int] = None
         self._stdout_file = None
@@ -131,6 +177,28 @@ class ManagedApp:
         # observed final state: ("exited", code) | ("signaled", name) |
         # ("running",) — None until the process ends
         self.final_state: Optional[tuple] = None
+
+    # the op handlers below act on the process whose turn is active; these
+    # aliases keep their bodies identical to the single-process form
+    @property
+    def chan(self):
+        return self._cur.chan
+
+    @property
+    def sockets(self):
+        return self._cur.sockets
+
+    @property
+    def _blocked(self):
+        return self._cur.blocked
+
+    @_blocked.setter
+    def _blocked(self, v) -> None:
+        self._cur.blocked = v
+
+    @property
+    def root(self) -> Optional[_Proc]:
+        return self.procs[0] if self.procs else None
 
     def configure_lifecycle(self, expected_final_state, shutdown_signal: str) -> None:
         """Apply the config's process lifecycle options (the reference's
@@ -233,15 +301,17 @@ class ManagedApp:
         idx = getattr(api, "apps", [self]).index(self)
         stem = f"{Path(self.argv[0]).name}.{idx}" if idx else Path(self.argv[0]).name
         shm_path = host_dir / f"{stem}.shm"
+        self._stem = stem
+        self._host_dir_path = host_dir
         exp = getattr(getattr(api, "engine", None), "cfg", None)
-        exp = exp.experimental if exp is not None else None
-        self.chan = abi.ShmChannel(
+        self._exp = exp.experimental if exp is not None else None
+        chan = abi.ShmChannel(
             str(shm_path),
             seed=self._proc_seed(api),
-            sndbuf=exp.socket_send_buffer if exp else None,
-            rcvbuf=exp.socket_recv_buffer if exp else None,
+            sndbuf=self._exp.socket_send_buffer if self._exp else None,
+            rcvbuf=self._exp.socket_recv_buffer if self._exp else None,
         )
-        self.chan.set_clock(stime.sim_to_emu(api.now))
+        chan.set_clock(stime.sim_to_emu(api.now))
         self._strace_mode = self._cfg_strace_mode(api)
         if self._strace_mode != "off":
             self._strace_file = open(host_dir / f"{stem}.strace", "w")
@@ -270,26 +340,28 @@ class ManagedApp:
             stderr=subprocess.STDOUT,
             stdin=subprocess.DEVNULL,
         )
+        self.procs.append(_Proc(chan, popen=self.proc, label="root"))
         api.count("managed_procs")
         # first stop: the shim's OP_START from its constructor
-        self._service(api)
+        self._service(api, self.procs[0])
 
     def on_timer(self, api: HostApi, t: int) -> None:
         pass  # deadlines ride schedule_at closures, not the model timer
 
-    def _deadline_fired(self, api, deadline: int) -> None:
-        if self.finished or self._blocked is None:
+    def _deadline_fired(self, api, proc: "_Proc", deadline: int) -> None:
+        if self.finished or proc.dead or proc.blocked is None:
             return
-        kind = self._blocked[0]
-        if kind == "sleep" and self._blocked[1] == deadline:
-            self._blocked = None
+        self._cur = proc
+        kind = proc.blocked[0]
+        if kind == "sleep" and proc.blocked[1] == deadline:
+            proc.blocked = None
             self._reply(api, "nanosleep", 0)
-            self._service(api)
-        elif kind == "poll" and self._blocked[2] == deadline:
-            entries = self._blocked[1]
-            self._blocked = None
+            self._service(api, proc)
+        elif kind == "poll" and proc.blocked[2] == deadline:
+            entries = proc.blocked[1]
+            proc.blocked = None
             self._reply_poll(api, entries)  # whatever is ready now (maybe 0)
-            self._service(api)
+            self._service(api, proc)
 
     def on_delivery(
         self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None
@@ -305,18 +377,16 @@ class ManagedApp:
             if getattr(api, "apps", [self])[0] is self:
                 api.count("udp_unreachable_drops")
             return
-        app, vfd = owner
+        app, sock = owner
         if app is not self or self.finished:
             return
         src_ip_be = _ip_to_be(api.ip_of(src))
-        self.sockets[vfd].queue.append((src_ip_be, src_port, data))
+        sock.queue.append((src_ip_be, src_port, data))
         api.count("udp_rx_bytes", len(data))
-        self._socket_activity(api, vfd)
+        self._socket_activity_obj(api, sock)
 
     # -- channel servicing -------------------------------------------------
 
-    def _alive(self) -> bool:
-        return self.proc is not None and self.proc.poll() is None
 
     def _reply(self, api: HostApi, opname: str, ret: int, args=None,
                payload: bytes = b"") -> None:
@@ -325,7 +395,8 @@ class ManagedApp:
         self.chan.set_clock(stime.sim_to_emu(api.now))
         self.chan.reply(ret, args=args, payload=payload)
         if self._strace_file is not None:
-            self._trace_line(api, opname, ret)
+            label = self._cur.label
+            self._trace_line(api, opname if label == "root" else f"[{label}] {opname}", ret)
 
     def _trace_line(self, api, opname: str, ret: int) -> None:
         err = f" {_errno_name(-ret)}" if ret < 0 else ""
@@ -336,22 +407,41 @@ class ManagedApp:
                 f"[{stime.fmt(api.now)}] {opname} = {ret}{err}\n"
             )
 
-    def _service(self, api: HostApi) -> None:
-        """Run the plugin until it blocks (sleep/recv/accept/poll/...) or
-        exits — the analog of ManagedThread::resume's event loop
-        (managed_thread.rs:187-325)."""
+    def _service(self, api: HostApi, proc: Optional[_Proc] = None) -> None:
+        """Run one process until it blocks (sleep/recv/accept/poll/wait...)
+        or exits — the analog of ManagedThread::resume's event loop
+        (managed_thread.rs:187-325).  Exactly one process holds the turn at
+        any moment; fork children get their own loops."""
+        proc = proc or self.procs[0]
         while True:
-            try:
-                self.chan.wait_recv(self._alive)
-            except abi.PluginDied:
-                self._finish(api, unexpected=True)
+            self._cur = proc  # handlers act on the active process
+            if proc.dead or self.finished:
                 return
-            req = self.chan.req
+            try:
+                proc.chan.wait_recv(proc.alive)
+            except abi.PluginDied:
+                if proc.parent is None:
+                    self._finish(api, unexpected=True)
+                else:
+                    self._child_exit(api, proc, 9, unexpected=True)  # SIGKILL
+                return
+            req = proc.chan.req
             op = req.op
             if op == abi.OP_START:
+                if proc.saw_start:
+                    # the process exec'd a new image: its shim fd table is
+                    # fresh, so the manager-side namespace must reset too
+                    for sock in list(proc.sockets.values()):
+                        self._drop_socket_ref(api, sock)
+                    proc.sockets.clear()
+                proc.saw_start = True
                 self._reply(api, "start", 0)
             elif op == abi.OP_EXIT:
-                self._finish(api, unexpected=False)
+                if proc.parent is None:
+                    self._finish(api, unexpected=False)
+                else:
+                    code = int(req.args[0]) & 0xFF
+                    self._child_exit(api, proc, code << 8, unexpected=False)
                 return
             elif op == abi.OP_NANOSLEEP:
                 ns = req.args[0]
@@ -392,6 +482,13 @@ class ManagedApp:
                 self._op_sockerr(api, req)
             elif op == abi.OP_FIONREAD:
                 self._op_fionread(api, req)
+            elif op == abi.OP_PREFORK:
+                self._op_prefork(api, req)
+            elif op == abi.OP_FORKED:
+                self._op_forked(api, req)
+            elif op == abi.OP_WAITPID:
+                if not self._op_waitpid(api, req):
+                    return
             elif op == abi.OP_CLOSE:
                 self._op_close(api, req)
             else:
@@ -399,14 +496,130 @@ class ManagedApp:
                 self._reply(api, f"op{op}", -ENOSYS)
 
     def _park(self, api: HostApi, blocked: tuple, deadline: Optional[int]) -> None:
-        """Leave the plugin waiting on its channel; a simulation event (or
-        the deadline) completes the call later."""
-        self._blocked = blocked
+        """Leave the active process waiting on its channel; a simulation
+        event (or the deadline) completes the call later."""
+        proc = self._cur
+        proc.blocked = blocked
         if deadline is not None:
             api.schedule_at(
                 max(deadline, api.now + 1),
-                lambda h, d=deadline: self._deadline_fired(h, d),
+                lambda h, d=deadline, pr=proc: self._deadline_fired(h, pr, d),
             )
+
+    # -- fork / wait (the reference's clone/fork handling, handler/clone.rs,
+    # managed_thread.rs native_clone — done the channel-handshake way) -----
+
+    def _op_prefork(self, api: HostApi, req) -> None:
+        """Parent is about to fork: build the child's channel now and hand
+        back its path (the child attaches it before doing anything else)."""
+        self._child_idx += 1
+        path = self._host_dir_path / f"{self._stem}.child{self._child_idx}.shm"
+        seed = (
+            self._proc_seed(api) + self._child_idx * 0x9E3779B97F4A7C15
+        ) & ((1 << 64) - 1)
+        chan = abi.ShmChannel(
+            str(path),
+            seed=seed,
+            sndbuf=self._exp.socket_send_buffer if self._exp else None,
+            rcvbuf=self._exp.socket_recv_buffer if self._exp else None,
+        )
+        chan.set_clock(stime.sim_to_emu(api.now))
+        self._pending_chans.append(chan)
+        self._reply(api, "prefork", 0, payload=str(path).encode())
+
+    def _op_forked(self, api: HostApi, req) -> None:
+        """Parent returned from fork: register the child process, inherit
+        the fd table (shared refcounted sockets), and schedule its first
+        turn at the current instant."""
+        parent = self._cur
+        child_pid = int(req.args[0])
+        chan = self._pending_chans.pop(0)
+        child = _Proc(chan, os_pid=child_pid, parent=parent,
+                      label=f"child{self._child_idx}")
+        for vfd, sock in parent.sockets.items():
+            sock.refs += 1
+            child.sockets[vfd] = sock
+        self.procs.append(child)
+        api.count("managed_forks")
+        api.schedule_at(api.now, lambda h, c=child: self._start_child(h, c))
+        self._reply(api, "forked", 0)
+
+    def _start_child(self, api, child: _Proc) -> None:
+        """The child's first turn: consume its CHILD_START and let it run."""
+        if child.dead or self.finished:
+            return
+        self._cur = child
+        try:
+            child.chan.wait_recv(child.alive)
+        except abi.PluginDied:
+            self._child_exit(api, child, 9, unexpected=True)
+            return
+        self._reply(api, "child-start", 0)
+        self._service(api, child)
+
+    def _op_waitpid(self, api: HostApi, req) -> bool:
+        pid = int(req.args[0])
+        nohang = bool(req.args[1])
+        proc = self._cur
+        z = self._match_zombie(proc, pid)
+        if z is not None:
+            self.zombies.remove(z)
+            self._reply(api, "waitpid", z[0], args=[0, z[1]])
+            return True
+        if pid > 0:
+            known = any(
+                p.parent is proc and not p.dead and p.pid == pid
+                for p in self.procs
+            )
+        else:
+            known = any(
+                p.parent is proc and not p.dead for p in self.procs
+            ) or any(zp is proc for _pid, _st, zp in self.zombies)
+        if not known:
+            self._reply(api, "waitpid", -ECHILD)
+            return True
+        if nohang:
+            self._reply(api, "waitpid", 0)
+            return True
+        self._park(api, ("waitpid", pid), None)
+        return False
+
+    def _match_zombie(self, parent: _Proc, pid: int):
+        for z in self.zombies:
+            zpid, _st, zparent = z
+            if zparent is parent and (pid == -1 or pid == zpid):
+                return z
+        return None
+
+    def _child_exit(self, api, proc: _Proc, wstatus: int, unexpected: bool) -> None:
+        """A fork child ended: record the zombie, release its fd table,
+        and complete a parked waitpid in the parent (if any)."""
+        proc.dead = True
+        proc.blocked = None
+        for sock in list(proc.sockets.values()):
+            self._drop_socket_ref(api, sock)
+        proc.sockets.clear()
+        proc.chan.close()
+        self.zombies.append((proc.pid, wstatus, proc.parent))
+        api.count("managed_child_exit_unexpected" if unexpected
+                  else "managed_child_exit_clean")
+        parent = proc.parent
+        if (parent is not None and not parent.dead
+                and parent.blocked is not None
+                and parent.blocked[0] == "waitpid"):
+            want = parent.blocked[1]
+            z = self._match_zombie(parent, want)
+            if z is not None:
+                self.zombies.remove(z)
+                parent.blocked = None
+                self._cur = parent
+                self._reply(api, "waitpid", z[0], args=[0, z[1]])
+                self._service(api, parent)
+
+    def _drop_socket_ref(self, api, sock: _VSocket) -> None:
+        sock.refs -= 1
+        if sock.refs <= 0:
+            self._teardown_vsocket(api, sock)
 
     # -- socket ops --------------------------------------------------------
 
@@ -433,7 +646,7 @@ class ManagedApp:
                 self._reply(api, "bind", -EADDRINUSE)
                 return
             sock.port = port
-            ports[port] = (self, vfd)
+            ports[port] = (self, sock)
         else:
             if port in api.net.tcp_listeners:
                 self._reply(api, "bind", -EADDRINUSE)
@@ -459,7 +672,7 @@ class ManagedApp:
         sock.kind = "listen"
         sock.port = port
         sock.listener = lst
-        lst.on_accept = lambda child, now, v=vfd: self._tcp_accept(api, v, child)
+        lst.on_accept = lambda child, now, vs=sock: self._tcp_accept(api, vs, child)
         self._reply(api, "listen", 0)
 
     def _op_connect(self, api: HostApi, req) -> bool:
@@ -490,7 +703,7 @@ class ManagedApp:
             self._reply(api, "connect", -EHOSTUNREACH)
             return True
         sock.sim = api.net.connect(dst, port, src_port=sock.port)
-        sock.sim.on_event = lambda s, now, v=vfd: self._tcp_event(api, v)
+        sock.sim.on_event = lambda s, now, vs=sock: self._tcp_event_obj(api, vs)
         api.count("managed_tcp_connects")
         if nonblock:
             self._reply(api, "connect", -EINPROGRESS)
@@ -525,7 +738,7 @@ class ManagedApp:
         child.sim = child_sim
         child.port = child_sim.tcp.local_port
         self.sockets[child_fd] = child
-        child_sim.on_event = lambda s, now, v=child_fd: self._tcp_event(api, v)
+        child_sim.on_event = lambda s, now, vs=child: self._tcp_event_obj(api, vs)
         peer_ip = _u32be_to_shim_ip(child_sim.tcp.remote_ip)
         api.count("managed_tcp_accepts")
         self._reply(api, "accept", child_fd,
@@ -585,7 +798,7 @@ class ManagedApp:
         dst = api.resolve(_be_to_ip(ip_be))
         if sock.port is None:  # auto-bind an ephemeral source port
             sock.port = self._alloc_port(api)
-            self._host_ports(api)[sock.port] = (self, sock.vfd)
+            self._host_ports(api)[sock.port] = (self, sock)
         api.send(dst, len(data) + UDP_HEADER_BYTES, payload=(sock.port, port, data))
         api.count("udp_tx_bytes", len(data))
         self._reply(api, "sendto", len(data))
@@ -743,13 +956,14 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "close", -EBADF)
             return
-        self._teardown_vsocket(api, sock)
+        self._drop_socket_ref(api, sock)
         self._reply(api, "close", 0)
 
     def _teardown_vsocket(self, api, sock: _VSocket) -> None:
         if sock.kind == "udp":
             if sock.port is not None:
                 self._host_ports(api).pop(sock.port, None)
+                sock.port = None
         elif sock.kind == "tcp":
             if sock.sim is not None:
                 sock.sim.on_event = None
@@ -815,28 +1029,44 @@ class ManagedApp:
 
     # -- simulation-event wakeups ------------------------------------------
 
-    def _tcp_event(self, api: HostApi, vfd: int) -> None:
+    def _tcp_event_obj(self, api: HostApi, sock: _VSocket) -> None:
         """State change on a connected TCP socket (data, window, FIN, RST)."""
         if self.finished:
             return
-        self._socket_activity(api, vfd)
+        self._socket_activity_obj(api, sock)
 
-    def _tcp_accept(self, api: HostApi, vfd: int, child_sim) -> None:
+    def _tcp_accept(self, api: HostApi, sock: _VSocket, child_sim) -> None:
         """A new established child landed on a listener."""
-        if self.finished:
-            child_sim.close()
-            return
-        sock = self.sockets.get(vfd)
-        if sock is None:
+        if self.finished or sock.refs <= 0:
             child_sim.close()
             return
         sock.accept_q.append(child_sim)
-        self._socket_activity(api, vfd)
+        self._socket_activity_obj(api, sock)
 
     def _socket_activity(self, api: HostApi, vfd: int) -> None:
-        """Try to complete the parked call after an event touching vfd."""
-        b = self._blocked
-        if b is None or self.finished:
+        """Complete a parked call in the ACTIVE process's namespace (ops
+        servicing their own fd).  Events arriving from the engine use
+        :meth:`_socket_activity_obj`, which resolves by socket identity —
+        vfd numbers may collide across processes."""
+        sock = self._cur.sockets.get(vfd) if self._cur else None
+        if sock is not None:
+            self._socket_activity_obj(api, sock)
+
+    def _socket_activity_obj(self, api: HostApi, sock: _VSocket) -> None:
+        if self.finished:
+            return
+        for proc in list(self.procs):
+            if proc.dead or proc.blocked is None:
+                continue
+            for vfd, s in proc.sockets.items():
+                if s is sock:
+                    self._cur = proc
+                    self._proc_socket_activity(api, proc, vfd)
+                    break
+
+    def _proc_socket_activity(self, api: HostApi, proc: "_Proc", vfd: int) -> None:
+        b = proc.blocked
+        if b is None:
             return
         kind = b[0]
         if kind == "recvfrom" and b[1] == vfd:
@@ -846,11 +1076,11 @@ class ManagedApp:
             if sock.queue:
                 self._blocked = None
                 self._reply_udp_recv(api, vfd, b[2])
-                self._service(api)
+                self._service(api, proc)
             elif sock.recv_shut:
                 self._blocked = None
                 self._reply(api, "recvfrom", 0)
-                self._service(api)
+                self._service(api, proc)
         elif kind == "recv" and b[1] == vfd:
             sock = self.sockets.get(vfd)
             if sock is None or sock.sim is None:
@@ -864,15 +1094,15 @@ class ManagedApp:
                 self._reply(api, "recv", len(data),
                             args=[0, peer_ip, sock.sim.tcp.remote_port],
                             payload=data)
-                self._service(api)
+                self._service(api, proc)
             elif ps & PollState.ERROR:
                 self._blocked = None
                 self._reply(api, "recv", -(_tcp_errno(sock.sim.tcp) or ECONNRESET))
-                self._service(api)
+                self._service(api, proc)
             elif sock.sim.tcp.at_eof() or ps & PollState.RECV_CLOSED:
                 self._blocked = None
                 self._reply(api, "recv", 0)
-                self._service(api)
+                self._service(api, proc)
         elif kind == "send" and b[1] == vfd:
             sock = self.sockets.get(vfd)
             if sock is None or sock.sim is None:
@@ -881,12 +1111,12 @@ class ManagedApp:
             if ps & PollState.ERROR:
                 self._blocked = None
                 self._reply(api, "send", -(_tcp_errno(sock.sim.tcp) or ECONNRESET))
-                self._service(api)
+                self._service(api, proc)
                 return
             if ps & PollState.SEND_CLOSED:
                 self._blocked = None
                 self._reply(api, "send", -EPIPE)
-                self._service(api)
+                self._service(api, proc)
                 return
             n = sock.sim.send(b[2])
             if n:
@@ -895,7 +1125,7 @@ class ManagedApp:
             if not rest:  # whole chunk queued: report the full length
                 self._blocked = None
                 self._reply(api, "send", b[3])
-                self._service(api)
+                self._service(api, proc)
             elif n:
                 self._blocked = ("send", vfd, rest, b[3])
         elif kind == "connect" and b[1] == vfd:
@@ -906,11 +1136,11 @@ class ManagedApp:
             if ps & PollState.ERROR:
                 self._blocked = None
                 self._reply(api, "connect", -(_tcp_errno(sock.sim.tcp) or ECONNREFUSED))
-                self._service(api)
+                self._service(api, proc)
             elif ps & PollState.WRITABLE:
                 self._blocked = None
                 self._reply(api, "connect", 0)
-                self._service(api)
+                self._service(api, proc)
         elif kind == "accept" and b[1] == vfd:
             sock = self.sockets.get(vfd)
             if sock is None:
@@ -918,24 +1148,24 @@ class ManagedApp:
             if sock.recv_shut:
                 self._blocked = None
                 self._reply(api, "accept", -EINVAL)
-                self._service(api)
+                self._service(api, proc)
             elif sock.accept_q:
                 child_fd = b[2]
                 self._blocked = None
                 self._complete_accept(api, vfd, child_fd)
-                self._service(api)
+                self._service(api, proc)
         elif kind == "poll":
             entries = b[1]
             if any(self._readiness(api, fd, ev) for fd, ev in entries):
                 self._blocked = None
                 self._reply_poll(api, entries)
-                self._service(api)
+                self._service(api, proc)
 
     # -- lifecycle ---------------------------------------------------------
 
     def _finish(self, api: HostApi, unexpected: bool) -> None:
         self.finished = True
-        self._blocked = None
+        self._kill_children()
         self._release_ports(api)
         if self.proc is not None:
             self._reap()
@@ -953,6 +1183,7 @@ class ManagedApp:
         if self.finished or self.proc is None:
             return
         self.finished = True
+        self._kill_children()
         if self.proc.poll() is not None:
             # died unobserved (no exit handshake): classify the real exit
             self.exit_code = self.proc.wait()
@@ -968,13 +1199,28 @@ class ManagedApp:
 
     def _release_ports(self, api) -> None:
         ports = self._host_ports(api)
-        for port, (app, _vfd) in list(ports.items()):
+        for port, (app, _sock) in list(ports.items()):
             if app is self:
                 del ports[port]
-        for sock in list(self.sockets.values()):
-            if sock.kind in ("tcp", "listen"):
-                self._teardown_vsocket(api, sock)
-        self.sockets.clear()
+        for proc in self.procs:
+            for sock in list(proc.sockets.values()):
+                if sock.kind in ("tcp", "listen"):
+                    self._teardown_vsocket(api, sock)
+            proc.sockets.clear()
+
+    def _kill_children(self) -> None:
+        """Fork children are the PLUGIN's OS children; at teardown they are
+        killed directly (their zombies reparent to init when the root
+        exits)."""
+        for proc in self.procs[1:]:
+            if not proc.dead:
+                proc.dead = True
+                proc.blocked = None
+                try:
+                    os.kill(proc.os_pid, _signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.chan.close()
 
     def _close_files(self) -> None:
         if self._stdout_file:
@@ -983,9 +1229,12 @@ class ManagedApp:
         if self._strace_file:
             self._strace_file.close()
             self._strace_file = None
-        if self.chan is not None:
-            self.chan.close()
-            self.chan = None
+        for chan in self._pending_chans:
+            chan.close()
+        self._pending_chans.clear()
+        if self.procs and self.procs[0].chan is not None:
+            self.procs[0].chan.close()
+            self.procs[0].chan = None
 
     def _host_dir(self, api: HostApi) -> Path:
         return Path(api.data_directory) / "hosts" / api.hostname
