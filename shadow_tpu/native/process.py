@@ -45,6 +45,14 @@ log = logging.getLogger("shadow_tpu.native")
 UDP_HEADER_BYTES = 28  # IP (20) + UDP (8): wire size = payload + header
 EPHEMERAL_PORT_START = 49152
 
+# CPU model (general.model_unblocked_syscall_latency — the reference's
+# host/cpu.rs + preempt.rs discipline): every serviced call charges a fixed
+# simulated latency; once the unapplied balance crosses the threshold the
+# process is forced to yield that much simulated time before its next call
+# is serviced.  Deterministic: counts calls, not wall time.
+SYSCALL_LATENCY_NS = 1_000  # 1 us per serviced call
+MAX_UNAPPLIED_LATENCY_NS = 100_000  # forced yield every ~100 calls
+
 # errno values the manager hands back over the channel (Linux numbers via
 # the stdlib so the table can't drift)
 from errno import (  # noqa: E402
@@ -114,10 +122,11 @@ class _Proc:
     refcounted socket objects, exactly like kernel fd inheritance)."""
 
     __slots__ = ("chan", "os_pid", "popen", "parent", "blocked", "sockets",
-                 "dead", "label", "saw_start")
+                 "dead", "label", "saw_start", "cpu_lat")
 
     def __init__(self, chan, os_pid=None, popen=None, parent=None, label="root"):
         self.saw_start = False
+        self.cpu_lat = 0  # unapplied syscall latency (cpu model)
         self.chan = chan
         self.os_pid = os_pid  # child pid (root uses popen.pid)
         self.popen = popen  # root only
@@ -303,8 +312,11 @@ class ManagedApp:
         shm_path = host_dir / f"{stem}.shm"
         self._stem = stem
         self._host_dir_path = host_dir
-        exp = getattr(getattr(api, "engine", None), "cfg", None)
-        self._exp = exp.experimental if exp is not None else None
+        cfg = getattr(getattr(api, "engine", None), "cfg", None)
+        self._exp = cfg.experimental if cfg is not None else None
+        self._cpu_model = bool(
+            cfg is not None and cfg.general.model_unblocked_syscall_latency
+        )
         chan = abi.ShmChannel(
             str(shm_path),
             seed=self._proc_seed(api),
@@ -353,7 +365,10 @@ class ManagedApp:
             return
         self._cur = proc
         kind = proc.blocked[0]
-        if kind == "sleep" and proc.blocked[1] == deadline:
+        if kind == "cpulat" and proc.blocked[1] == deadline:
+            proc.blocked = None
+            self._service(api, proc, pending_req=True)
+        elif kind == "sleep" and proc.blocked[1] == deadline:
             proc.blocked = None
             self._reply(api, "nanosleep", 0)
             self._service(api, proc)
@@ -392,6 +407,8 @@ class ManagedApp:
                payload: bytes = b"") -> None:
         """Send a reply (advancing the plugin's clock to sim-now) and write
         the strace line — the single exit point of every serviced call."""
+        if self._cpu_model:
+            self._cur.cpu_lat += SYSCALL_LATENCY_NS
         self.chan.set_clock(stime.sim_to_emu(api.now))
         self.chan.reply(ret, args=args, payload=payload)
         if self._strace_file is not None:
@@ -407,23 +424,41 @@ class ManagedApp:
                 f"[{stime.fmt(api.now)}] {opname} = {ret}{err}\n"
             )
 
-    def _service(self, api: HostApi, proc: Optional[_Proc] = None) -> None:
+    def _service(
+        self, api: HostApi, proc: Optional[_Proc] = None, pending_req: bool = False
+    ) -> None:
         """Run one process until it blocks (sleep/recv/accept/poll/wait...)
         or exits — the analog of ManagedThread::resume's event loop
         (managed_thread.rs:187-325).  Exactly one process holds the turn at
-        any moment; fork children get their own loops."""
+        any moment; fork children get their own loops.  ``pending_req``:
+        the next request is already in the channel (cpu-model yields)."""
         proc = proc or self.procs[0]
+        pending = pending_req
         while True:
             self._cur = proc  # handlers act on the active process
             if proc.dead or self.finished:
                 return
             try:
-                proc.chan.wait_recv(proc.alive)
+                if not pending:
+                    proc.chan.wait_recv(proc.alive)
+                pending = False
             except abi.PluginDied:
                 if proc.parent is None:
                     self._finish(api, unexpected=True)
                 else:
                     self._child_exit(api, proc, 9, unexpected=True)  # SIGKILL
+                return
+            if (
+                self._cpu_model
+                and proc.cpu_lat >= MAX_UNAPPLIED_LATENCY_NS
+                and proc.chan.req.op not in (abi.OP_EXIT, abi.OP_START)
+            ):
+                # apply the accumulated syscall latency: the pending call is
+                # serviced only after cpu_lat of simulated time passes
+                deadline = api.now + proc.cpu_lat
+                proc.cpu_lat = 0
+                api.count("cpu_latency_yields")
+                self._park(api, ("cpulat", deadline), deadline)
                 return
             req = proc.chan.req
             op = req.op

@@ -124,3 +124,61 @@ hosts:
     )
     assert proc.returncode == 1
     assert "process error" in proc.stderr
+
+
+def test_cpu_model_charges_syscall_latency(tmp_path):
+    # model_unblocked_syscall_latency: each serviced call costs simulated
+    # time, so a syscall-heavy run finishes LATER in sim time than the
+    # pure-sleep baseline — deterministically
+    from shadow_tpu.engine.determinism import determinism_check
+
+    def run(flag, sub):
+        # tcpecho 40 rounds of 2000B => several hundred serviced calls,
+        # comfortably past the forced-yield threshold
+        cfg = ConfigOptions.from_yaml(
+            f"""
+general: {{stop_time: 60s, seed: 4, data_directory: {tmp_path / sub},
+          heartbeat_interval: null,
+          model_unblocked_syscall_latency: {str(flag).lower()}}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  cli:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'tcpecho'}
+        args: [client, 11.0.0.2, "7000", "40", "2000", "1"]
+        start_time: 100ms
+  srv:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'tcpecho'}
+        args: [server, "7000", "1"]
+"""
+        )
+        sim = Simulation(cfg)
+        res = sim.run()
+        assert res.process_errors == []
+        return res
+
+    off = run(False, "off")
+    on = run(True, "on")
+    assert on.counters.get("cpu_latency_yields", 0) > 0
+    assert off.counters.get("cpu_latency_yields", 0) == 0
+    # charged latency shifts the traffic later in simulated time
+    assert max(r.time for r in on.event_log) > max(r.time for r in off.event_log)
+    # and the modeled run is itself deterministic
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: 60s, seed: 4, data_directory: {tmp_path / 'det'},
+          heartbeat_interval: null, model_unblocked_syscall_latency: true}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'forker'}
+        args: ["2", "100"]
+"""
+    )
+    report = determinism_check(cfg)
+    assert report.identical, report.describe()
