@@ -1,0 +1,57 @@
+/* unixchat: AF_UNIX socketpair + fork IPC under the simulation.  The
+ * parent and child exchange messages over a unix socket with simulated
+ * sleeps between turns: unix sockets are intra-host IPC and ride the real
+ * kernel, but blocking waits must yield SIMULATED time.  Also asserts
+ * that AF_INET6 sockets are refused (hermeticity). */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static uint64_t now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (uint64_t)ts.tv_sec * 1000u + (uint64_t)(ts.tv_nsec / 1000000);
+}
+
+int main(void) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    if (socket(AF_INET6, SOCK_STREAM, 0) != -1 || errno != EAFNOSUPPORT) {
+        printf("inet6 not refused\n");
+        return 1;
+    }
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        perror("socketpair");
+        return 1;
+    }
+    uint64_t t0 = now_ms();
+    pid_t pid = fork();
+    if (pid == 0) { /* child: wait for ping, sleep 300 sim-ms, pong */
+        char buf[16];
+        if (recv(sv[1], buf, sizeof(buf), 0) != 5) return 1;
+        struct timespec ts = {0, 300000000};
+        nanosleep(&ts, NULL);
+        send(sv[1], "pong", 5, 0);
+        return 0;
+    }
+    struct timespec ts = {0, 200000000};
+    nanosleep(&ts, NULL); /* child blocks in recv meanwhile */
+    send(sv[0], "ping", 5, 0);
+    char buf[16];
+    if (recv(sv[0], buf, sizeof(buf), 0) != 5 || strcmp(buf, "pong") != 0) {
+        printf("bad pong\n");
+        return 1;
+    }
+    int st = 0;
+    waitpid(pid, &st, 0);
+    printf("chat done elapsed=%llu ms child_ok=%d\n",
+           (unsigned long long)(now_ms() - t0),
+           WIFEXITED(st) && WEXITSTATUS(st) == 0);
+    return 0;
+}

@@ -35,6 +35,7 @@
 #include <linux/futex.h>
 #include <netinet/in.h>
 #include <poll.h>
+#include <signal.h>
 #include <stdarg.h>
 #include <stdint.h>
 #include <stdio.h>
@@ -177,10 +178,36 @@ static void msg_await(shim_msg *m) {
 
 /* Synchronous call: fill to_shadow, wake manager, block for the reply.
  * The protocol strictly alternates, exactly like the reference's
- * ManagedThread::continue_plugin loop (managed_thread.rs:434-472). */
+ * ManagedThread::continue_plugin loop (managed_thread.rs:434-472).
+ *
+ * Handler-reentrancy guard: a handler running mid-exchange (e.g. bash's
+ * SIGCHLD reaper calling waitpid) would issue a REENTRANT shim_call and
+ * corrupt the alternation.  All signals except the termination/fault set
+ * are masked for the duration — deferred handlers run between calls,
+ * where their own calls are safe; SIGTERM/SIGINT/SIGQUIT stay deliverable
+ * so a shutdown_signal can still kill a parked plugin. */
 static int64_t shim_call(uint32_t op, const int64_t args[6], const void *out,
                          uint32_t out_len, void *in, uint32_t *in_len,
                          int64_t reply_args[6]) {
+    /* mask everything except termination/fault signals (built once):
+     * handler reentrancy is excluded wholesale, while a shutdown_signal
+     * can still kill a parked plugin and faults stay synchronous */
+    static sigset_t sig_blk;
+    static int sig_blk_ready;
+    if (!sig_blk_ready) {
+        sigfillset(&sig_blk);
+        sigdelset(&sig_blk, SIGTERM);
+        sigdelset(&sig_blk, SIGINT);
+        sigdelset(&sig_blk, SIGQUIT);
+        sigdelset(&sig_blk, SIGSEGV);
+        sigdelset(&sig_blk, SIGBUS);
+        sigdelset(&sig_blk, SIGILL);
+        sigdelset(&sig_blk, SIGFPE);
+        sigdelset(&sig_blk, SIGABRT);
+        sig_blk_ready = 1;
+    }
+    sigset_t sig_old;
+    sigprocmask(SIG_SETMASK, &sig_blk, &sig_old);
     shim_msg *tx = &g_shm->to_shadow;
     shim_msg *rx = &g_shm->to_shim;
     tx->op = op;
@@ -197,7 +224,9 @@ static int64_t shim_call(uint32_t op, const int64_t args[6], const void *out,
         memcpy(in, rx->payload, n);
         *in_len = n;
     }
-    return rx->ret;
+    int64_t ret = rx->ret;
+    sigprocmask(SIG_SETMASK, &sig_old, NULL);
+    return ret;
 }
 
 /* return-value helper: negative ret carries -errno */
@@ -437,8 +466,17 @@ static int fd_is_fifo(int fd) {
     if (fd < 0 || fd >= SHIM_MAX_FDS) return 0;
     if (fd_fifo_cache[fd] == 0) {
         struct stat st;
-        fd_fifo_cache[fd] =
-            (fstat(fd, &st) == 0 && S_ISFIFO(st.st_mode)) ? 1 : 2;
+        if (fstat(fd, &st) != 0)
+            fd_fifo_cache[fd] = 2;
+        else if (S_ISFIFO(st.st_mode))
+            fd_fifo_cache[fd] = 1;
+        else if (S_ISSOCK(st.st_mode))
+            /* a real socket under the shim is AF_UNIX/netlink (INET is
+             * interposed, INET6 refused): local IPC that must yield
+             * simulated time instead of blocking natively */
+            fd_fifo_cache[fd] = 1;
+        else
+            fd_fifo_cache[fd] = 2;
     }
     return fd_fifo_cache[fd] == 1;
 }
@@ -458,9 +496,25 @@ static void pipe_wait(int fd, short events) {
     }
 }
 
+/* the one blocking predicate for real-fd I/O: yield simulated time when
+ * the fd is local IPC (pipe/unix socket), the fd is in blocking mode, and
+ * the CALL doesn't request non-blocking behavior.  (accept4's flag
+ * configures the ACCEPTED socket, not this call's blocking — callers pass
+ * dontwait=0 there.) */
+static void maybe_yield(int fd, short events, int dontwait) {
+    if (g_ready && !dontwait && fd_is_fifo(fd) && !fd_nonblock(fd))
+        pipe_wait(fd, events);
+}
+
 int socket(int domain, int type, int protocol) {
     if (!real_socket) resolve_reals();
     int base_type = type & ~(SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (g_ready && domain == AF_INET6) {
+        /* the simulated internet is IPv4; a real IPv6 socket would escape
+         * the simulation entirely */
+        errno = EAFNOSUPPORT;
+        return -1;
+    }
     if (!g_ready || domain != AF_INET ||
         (base_type != SOCK_DGRAM && base_type != SOCK_STREAM))
         return real_socket(domain, type, protocol);
@@ -507,7 +561,10 @@ int listen(int fd, int backlog) {
 }
 
 int accept4(int fd, struct sockaddr *addr, socklen_t *alen, int flags) {
-    if (!is_vfd(fd)) return real_accept4(fd, addr, alen, flags);
+    if (!is_vfd(fd)) {
+        maybe_yield(fd, POLLIN, 0);
+        return real_accept4(fd, addr, alen, flags);
+    }
     int child = reserve_fd();
     if (child < 0) return -1;
     int64_t args[6] = {fd, vfd_nonblock[fd], child, 0, 0, 0};
@@ -527,6 +584,7 @@ int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
     if (!is_vfd(fd)) {
         static int (*real_accept)(int, struct sockaddr *, socklen_t *);
         if (!real_accept) real_accept = dlsym(RTLD_NEXT, "accept");
+        maybe_yield(fd, POLLIN, 0);
         return real_accept(fd, addr, alen);
     }
     return accept4(fd, addr, alen, 0);
@@ -597,7 +655,10 @@ static ssize_t vfd_recvfrom(int fd, void *buf, size_t n, int flags,
 
 ssize_t sendto(int fd, const void *buf, size_t n, int flags,
                const struct sockaddr *addr, socklen_t len) {
-    if (!is_vfd(fd)) return real_sendto(fd, buf, n, flags, addr, len);
+    if (!is_vfd(fd)) {
+        maybe_yield(fd, POLLOUT, flags & MSG_DONTWAIT);
+        return real_sendto(fd, buf, n, flags, addr, len);
+    }
     uint32_t ip = 0;
     uint16_t port = 0;
     if (addr && addr_to_ip_port(addr, len, &ip, &port) != 0) return -1;
@@ -608,6 +669,7 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
     if (!is_vfd(fd)) {
         static ssize_t (*real_send)(int, const void *, size_t, int);
         if (!real_send) real_send = dlsym(RTLD_NEXT, "send");
+        maybe_yield(fd, POLLOUT, flags & MSG_DONTWAIT);
         return real_send(fd, buf, n, flags);
     }
     return vfd_sendto(fd, buf, n, flags, 0, 0);
@@ -615,8 +677,7 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
 
 ssize_t write(int fd, const void *buf, size_t n) {
     if (!is_vfd(fd)) {
-        if (g_ready && fd_is_fifo(fd) && !fd_nonblock(fd))
-            pipe_wait(fd, POLLOUT);
+        maybe_yield(fd, POLLOUT, 0);
         return real_write(fd, buf, n);
     }
     return vfd_sendto(fd, buf, n, 0, 0, 0);
@@ -624,7 +685,10 @@ ssize_t write(int fd, const void *buf, size_t n) {
 
 ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
                  struct sockaddr *addr, socklen_t *alen) {
-    if (!is_vfd(fd)) return real_recvfrom(fd, buf, n, flags, addr, alen);
+    if (!is_vfd(fd)) {
+        maybe_yield(fd, POLLIN, flags & MSG_DONTWAIT);
+        return real_recvfrom(fd, buf, n, flags, addr, alen);
+    }
     return vfd_recvfrom(fd, buf, n, flags, addr, alen);
 }
 
@@ -632,6 +696,29 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
     if (!is_vfd(fd)) {
         static ssize_t (*real_recv)(int, void *, size_t, int);
         if (!real_recv) real_recv = dlsym(RTLD_NEXT, "recv");
+        int yieldable = g_ready && fd_is_fifo(fd) && !fd_nonblock(fd) &&
+                        !(flags & MSG_DONTWAIT);
+        int so_type = 0;
+        socklen_t so_len = sizeof(so_type);
+        int is_stream =
+            real_getsockopt(fd, SOL_SOCKET, SO_TYPE, &so_type, &so_len) == 0
+            && so_type == SOCK_STREAM;
+        if (yieldable && is_stream && (flags & MSG_WAITALL) &&
+            !(flags & MSG_PEEK)) {
+            /* WAITALL must yield between chunks, not block natively after
+             * the first readable byte (PEEK never consumes, so the loop
+             * form would duplicate data — PEEK falls through below) */
+            size_t off = 0;
+            while (off < n) {
+                pipe_wait(fd, POLLIN);
+                ssize_t r = real_recv(fd, (char *)buf + off, n - off,
+                                      flags & ~MSG_WAITALL);
+                if (r <= 0) return off > 0 ? (ssize_t)off : r;
+                off += (size_t)r;
+            }
+            return (ssize_t)off;
+        }
+        if (yieldable) pipe_wait(fd, POLLIN);
         return real_recv(fd, buf, n, flags);
     }
     return vfd_recvfrom(fd, buf, n, flags, NULL, NULL);
@@ -639,8 +726,7 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
 
 ssize_t read(int fd, void *buf, size_t n) {
     if (!is_vfd(fd)) {
-        if (g_ready && fd_is_fifo(fd) && !fd_nonblock(fd))
-            pipe_wait(fd, POLLIN);
+        maybe_yield(fd, POLLIN, 0);
         return real_read(fd, buf, n);
     }
     return vfd_recvfrom(fd, buf, n, 0, NULL, NULL);
@@ -1420,4 +1506,86 @@ int execvp(const char *file, char *const argv[]) {
     }
     errno = ENOENT;
     return -1;
+}
+
+/* uname: the nodename is the simulated hostname (apps commonly read it
+ * instead of gethostname) */
+#include <sys/utsname.h>
+
+int uname(struct utsname *buf) {
+    static int (*real_uname)(struct utsname *);
+    if (!real_uname) *(void **)&real_uname = dlsym(RTLD_NEXT, "uname");
+    int r = real_uname(buf);
+    const char *simname = getenv("SHADOW_TPU_HOSTNAME");
+    if (r == 0 && g_ready && simname) {
+        snprintf(buf->nodename, sizeof(buf->nodename), "%s", simname);
+    }
+    return r;
+}
+
+
+/* msghdr I/O: same yield discipline (AF_UNIX datagrams and SCM_RIGHTS
+ * riders use these).  Simulated INET sockets do not support msghdr I/O
+ * yet; fail loudly instead of bypassing the simulation. */
+ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
+    static ssize_t (*real_recvmsg)(int, struct msghdr *, int);
+    if (!real_recvmsg) *(void **)&real_recvmsg = dlsym(RTLD_NEXT, "recvmsg");
+    if (is_vfd(fd)) {
+        errno = ENOSYS;
+        return -1;
+    }
+    maybe_yield(fd, POLLIN, flags & MSG_DONTWAIT);
+    return real_recvmsg(fd, msg, flags);
+}
+
+ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
+    static ssize_t (*real_sendmsg)(int, const struct msghdr *, int);
+    if (!real_sendmsg) *(void **)&real_sendmsg = dlsym(RTLD_NEXT, "sendmsg");
+    if (is_vfd(fd)) {
+        errno = ENOSYS;
+        return -1;
+    }
+    maybe_yield(fd, POLLOUT, flags & MSG_DONTWAIT);
+    return real_sendmsg(fd, msg, flags);
+}
+
+/* dup family: keep the fifo cache honest; duplicating a SIMULATED socket
+ * is not supported yet (two fd numbers would alias one manager-side
+ * socket without refcounting the manager entry) — fail loudly. */
+int dup(int oldfd) {
+    static int (*real_dup)(int);
+    if (!real_dup) *(void **)&real_dup = dlsym(RTLD_NEXT, "dup");
+    if (is_vfd(oldfd)) {
+        errno = EBADF;
+        return -1;
+    }
+    int fd = real_dup(oldfd);
+    if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
+    return fd;
+}
+
+int dup2(int oldfd, int newfd) {
+    static int (*real_dup2)(int, int);
+    if (!real_dup2) *(void **)&real_dup2 = dlsym(RTLD_NEXT, "dup2");
+    if (is_vfd(oldfd) || is_vfd(newfd)) {
+        errno = EBADF;
+        return -1;
+    }
+    int fd = real_dup2(oldfd, newfd);
+    if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
+    if (fd >= 0 && g_ready) epoll_forget_fd(fd);
+    return fd;
+}
+
+int dup3(int oldfd, int newfd, int flags) {
+    static int (*real_dup3)(int, int, int);
+    if (!real_dup3) *(void **)&real_dup3 = dlsym(RTLD_NEXT, "dup3");
+    if (is_vfd(oldfd) || is_vfd(newfd)) {
+        errno = EBADF;
+        return -1;
+    }
+    int fd = real_dup3(oldfd, newfd, flags);
+    if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
+    if (fd >= 0 && g_ready) epoll_forget_fd(fd);
+    return fd;
 }

@@ -110,7 +110,10 @@ def futex_wake(addr: int) -> None:
 
 
 class ShmChannel:
-    """Manager-side view of one plugin's shared-memory block."""
+    """Manager-side view of one plugin's shared-memory block.  The backing
+    file must outlive the process (each execve re-opens it); ``close``
+    unlinks it so reused data directories cannot accumulate channel files
+    from prior runs."""
 
     def __init__(self, path: str, seed: int, sndbuf: int | None = None,
                  rcvbuf: int | None = None) -> None:
@@ -139,12 +142,17 @@ class ShmChannel:
         # until collected; drop ours, collect, and tolerate stragglers (the
         # region is tiny and unmapped at interpreter exit regardless)
         import gc
+        import os
 
         del self.shm
         gc.collect()
         try:
             self.mm.close()
         except BufferError:
+            pass
+        try:
+            os.unlink(self._f.name)
+        except OSError:
             pass
         self._f.close()
 

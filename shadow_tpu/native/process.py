@@ -309,7 +309,11 @@ class ManagedApp:
         # must not share a channel or a stdout file
         idx = getattr(api, "apps", [self]).index(self)
         stem = f"{Path(self.argv[0]).name}.{idx}" if idx else Path(self.argv[0]).name
-        shm_path = host_dir / f"{stem}.shm"
+        # the manager pid in the channel filename makes collisions with
+        # orphaned plugins of a killed previous run impossible (tmp dirs
+        # get reused; an orphan still attached to a reused path would
+        # corrupt the new run's handshake)
+        shm_path = host_dir / f"{stem}.{os.getpid()}.shm"
         self._stem = stem
         self._host_dir_path = host_dir
         cfg = getattr(getattr(api, "engine", None), "cfg", None)
@@ -548,7 +552,10 @@ class ManagedApp:
         """Parent is about to fork: build the child's channel now and hand
         back its path (the child attaches it before doing anything else)."""
         self._child_idx += 1
-        path = self._host_dir_path / f"{self._stem}.child{self._child_idx}.shm"
+        path = (
+            self._host_dir_path
+            / f"{self._stem}.{os.getpid()}.child{self._child_idx}.shm"
+        )
         seed = (
             self._proc_seed(api) + self._child_idx * 0x9E3779B97F4A7C15
         ) & ((1 << 64) - 1)

@@ -87,3 +87,18 @@ def test_no_orphan_processes_after_run():
     time.sleep(0.3)
     ps = subprocess.run(["ps", "-ef"], capture_output=True, text=True).stdout
     assert "sleep 987.654" not in ps
+
+
+def test_unix_socketpair_ipc_and_inet6_refused():
+    # AF_UNIX is intra-host IPC: native transport, but blocking recv yields
+    # SIMULATED time (parent sleeps 200ms, child replies after 300ms more);
+    # AF_INET6 is refused so nothing can escape the simulated internet
+    res = shadow_exec([str(BUILD / "unixchat")], stop_time="10s")
+    assert res.ok, res.stdout
+    assert "chat done elapsed=500 ms child_ok=1" in res.stdout
+
+
+def test_uname_reports_simulated_hostname():
+    res = shadow_exec(["/bin/bash", "-c", "uname -n; hostname"], stop_time="10s")
+    assert res.ok
+    assert res.stdout == "host0\nhost0\n"
