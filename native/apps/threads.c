@@ -1,0 +1,249 @@
+/* threads: a real pthread binary for the managed-thread end-to-end tests
+ * (the analog of the reference's clone/futex test dirs, src/test/clone,
+ * src/test/futex — done at the pthread API level the shim interposes).
+ *
+ * modes:
+ *   threads pool                4 workers x 25 mutex-guarded increments
+ *   threads prodcons            producer/consumer over a condvar
+ *   threads sem                 semaphore handoff + trywait error path
+ *   threads timed               cond_timedwait timeout + trylock EBUSY,
+ *                               simulated-clock advance across the timeout
+ *   threads mainexit            main pthread_exits; a worker finishes last
+ *   threads udp <ip> <port> <n> worker thread ping-pongs n datagrams with
+ *                               a pingpong server (shared fd table)
+ *
+ * Everything printed derives from simulated time and deterministic
+ * scheduling, so output is bit-identical run-to-run.
+ */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <semaphore.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static uint64_t now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+static pthread_mutex_t lock = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t cond = PTHREAD_COND_INITIALIZER;
+static long counter;
+
+/* -- pool -------------------------------------------------------------- */
+
+static void *adder(void *arg) {
+    long n = (long)(intptr_t)arg;
+    for (long i = 0; i < n; i++) {
+        pthread_mutex_lock(&lock);
+        counter++;
+        pthread_mutex_unlock(&lock);
+        usleep(1000); /* force interleaving across simulated time */
+    }
+    return (void *)(intptr_t)n;
+}
+
+static int run_pool(void) {
+    pthread_t th[4];
+    for (int i = 0; i < 4; i++)
+        if (pthread_create(&th[i], NULL, adder, (void *)(intptr_t)25) != 0) {
+            perror("pthread_create");
+            return 1;
+        }
+    long joined = 0;
+    for (int i = 0; i < 4; i++) {
+        void *rv = NULL;
+        if (pthread_join(th[i], &rv) != 0) {
+            perror("pthread_join");
+            return 1;
+        }
+        joined += (long)(intptr_t)rv;
+    }
+    printf("counter=%ld joined=%ld\n", counter, joined);
+    return 0;
+}
+
+/* -- prodcons ---------------------------------------------------------- */
+
+static int queue_val;  /* 0 = empty slot */
+static int prod_done;
+
+static void *consumer(void *arg) {
+    (void)arg;
+    long got = 0, sum = 0;
+    pthread_mutex_lock(&lock);
+    for (;;) {
+        while (queue_val == 0 && !prod_done)
+            pthread_cond_wait(&cond, &lock);
+        if (queue_val != 0) {
+            sum += queue_val;
+            got++;
+            queue_val = 0;
+            pthread_cond_signal(&cond); /* slot free */
+        } else {
+            break; /* done and drained */
+        }
+    }
+    pthread_mutex_unlock(&lock);
+    printf("consumed=%ld sum=%ld\n", got, sum);
+    return NULL;
+}
+
+static int run_prodcons(void) {
+    pthread_t th;
+    if (pthread_create(&th, NULL, consumer, NULL) != 0) return 1;
+    pthread_mutex_lock(&lock);
+    for (int i = 1; i <= 10; i++) {
+        while (queue_val != 0)
+            pthread_cond_wait(&cond, &lock);
+        queue_val = i;
+        pthread_cond_signal(&cond);
+    }
+    while (queue_val != 0)
+        pthread_cond_wait(&cond, &lock);
+    prod_done = 1;
+    pthread_cond_broadcast(&cond);
+    pthread_mutex_unlock(&lock);
+    pthread_join(th, NULL);
+    printf("producer done\n");
+    return 0;
+}
+
+/* -- sem --------------------------------------------------------------- */
+
+static sem_t sem;
+
+static void *poster(void *arg) {
+    (void)arg;
+    for (int i = 0; i < 5; i++) {
+        usleep(2000);
+        sem_post(&sem);
+    }
+    return NULL;
+}
+
+static int run_sem(void) {
+    if (sem_init(&sem, 0, 0) != 0) { perror("sem_init"); return 1; }
+    pthread_t th;
+    if (pthread_create(&th, NULL, poster, NULL) != 0) return 1;
+    for (int i = 0; i < 5; i++)
+        if (sem_wait(&sem) != 0) { perror("sem_wait"); return 1; }
+    int eagain = (sem_trywait(&sem) != 0 && errno == EAGAIN);
+    int val = -1;
+    sem_getvalue(&sem, &val);
+    pthread_join(th, NULL);
+    printf("sem_ok trywait_eagain=%d value=%d\n", eagain, val);
+    return 0;
+}
+
+/* -- timed ------------------------------------------------------------- */
+
+static int run_timed(void) {
+    uint64_t t0 = now_ns();
+    pthread_mutex_lock(&lock);
+    struct timespec abs;
+    clock_gettime(CLOCK_REALTIME, &abs);
+    abs.tv_nsec += 50 * 1000000L; /* +50ms */
+    if (abs.tv_nsec >= 1000000000L) {
+        abs.tv_sec += 1;
+        abs.tv_nsec -= 1000000000L;
+    }
+    int rc = pthread_cond_timedwait(&cond, &lock, &abs);
+    uint64_t waited_ms = (now_ns() - t0) / 1000000ull;
+    int busy = pthread_mutex_trylock(&lock); /* self-held: EBUSY or EDEADLK */
+    pthread_mutex_unlock(&lock);
+    printf("timedwait=%s waited_ms=%llu trylock_busy=%d\n",
+           rc == ETIMEDOUT ? "ETIMEDOUT" : "other",
+           (unsigned long long)waited_ms, busy != 0);
+    return 0;
+}
+
+/* -- mainexit ---------------------------------------------------------- */
+
+static void *late_worker(void *arg) {
+    (void)arg;
+    usleep(30000);
+    printf("late_worker_done @ %llu ns\n", (unsigned long long)now_ns());
+    fflush(stdout);
+    return NULL;
+}
+
+static int run_mainexit(void) {
+    pthread_t th;
+    if (pthread_create(&th, NULL, late_worker, NULL) != 0) return 1;
+    printf("main retiring\n");
+    fflush(stdout);
+    pthread_exit(NULL); /* process exits 0 once the worker finishes */
+}
+
+/* -- udp --------------------------------------------------------------- */
+
+typedef struct {
+    const char *ip;
+    int port;
+    int count;
+} udp_args;
+
+static void *udp_worker(void *arg) {
+    udp_args *a = arg;
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return (void *)1; }
+    struct sockaddr_in peer = {0};
+    peer.sin_family = AF_INET;
+    peer.sin_port = htons((uint16_t)a->port);
+    inet_pton(AF_INET, a->ip, &peer.sin_addr);
+    long long bytes = 0;
+    for (int i = 0; i < a->count; i++) {
+        char buf[256];
+        int n = snprintf(buf, sizeof buf, "thread-ping %d", i);
+        if (sendto(fd, buf, (size_t)n, 0, (struct sockaddr *)&peer,
+                   sizeof peer) < 0) {
+            perror("sendto");
+            return (void *)1;
+        }
+        char rbuf[256];
+        ssize_t r = recvfrom(fd, rbuf, sizeof rbuf, 0, NULL, NULL);
+        if (r < 0) { perror("recvfrom"); return (void *)1; }
+        bytes += r;
+        usleep(5000);
+    }
+    printf("udp worker: %d echoes, %lld bytes, done @ %llu ns\n", a->count,
+           bytes, (unsigned long long)now_ns());
+    close(fd);
+    return NULL;
+}
+
+static int run_udp(const char *ip, int port, int count) {
+    udp_args a = {ip, port, count};
+    pthread_t th;
+    if (pthread_create(&th, NULL, udp_worker, &a) != 0) return 1;
+    void *rv = NULL;
+    pthread_join(th, &rv);
+    printf("udp main: worker rv=%ld\n", (long)(intptr_t)rv);
+    return rv == NULL ? 0 : 1;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IOLBF, 0);
+    if (argc < 2) {
+        fprintf(stderr, "usage: threads <pool|prodcons|sem|timed|mainexit|udp>\n");
+        return 2;
+    }
+    if (strcmp(argv[1], "pool") == 0) return run_pool();
+    if (strcmp(argv[1], "prodcons") == 0) return run_prodcons();
+    if (strcmp(argv[1], "sem") == 0) return run_sem();
+    if (strcmp(argv[1], "timed") == 0) return run_timed();
+    if (strcmp(argv[1], "mainexit") == 0) return run_mainexit();
+    if (strcmp(argv[1], "udp") == 0 && argc >= 5)
+        return run_udp(argv[2], atoi(argv[3]), atoi(argv[4]));
+    fprintf(stderr, "unknown mode %s\n", argv[1]);
+    return 2;
+}

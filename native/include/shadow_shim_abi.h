@@ -60,6 +60,36 @@ enum {
                                  args[0]=os pid; parked until resumed */
     SHIM_OP_WAITPID = 21,  /* args[0]=pid (-1 any) args[1]=options(WNOHANG=1);
                               reply ret=pid|0, args[1]=wait status */
+    /* threads: one channel per thread, strict turn-taking — only one thread
+     * of the whole simulation runs natively at any instant (the reference's
+     * per-ManagedThread discipline, managed_thread.rs:187,355) */
+    SHIM_OP_PRETHREAD = 22,      /* creator: reply payload = new channel path,
+                                    args[1] = virtual tid */
+    SHIM_OP_THREAD_CREATED = 23, /* creator, post-pthread_create: args[0]=vtid
+                                    (args[1]=1 cancels a failed create) */
+    SHIM_OP_THREAD_START = 24,   /* new thread's first message on its own
+                                    channel; args[0]=vtid; parked until its
+                                    start event fires */
+    SHIM_OP_THREAD_EXIT = 25,    /* args[0]=vtid args[1]=retval (uintptr);
+                                    fire-and-forget, no reply */
+    SHIM_OP_THREAD_JOIN = 26,    /* args[0]=vtid args[1]=detach(0|1);
+                                    join parks until the thread exits,
+                                    reply args[1]=retval */
+    /* sync primitives, virtualized manager-side and keyed by address — the
+     * futex-table analog (host/futex_table.rs).  A native lock would block
+     * the OS thread outside the simulation and deadlock the turn. */
+    SHIM_OP_MUTEX_LOCK = 27,   /* args[0]=addr args[1]=try(0|1);
+                                  reply 0 | -EBUSY | -EDEADLK */
+    SHIM_OP_MUTEX_UNLOCK = 28, /* args[0]=addr */
+    SHIM_OP_COND_WAIT = 29,    /* args[0]=cond addr args[1]=mutex addr
+                                  args[2]=timeout ns rel (-1 = infinite);
+                                  reply 0 | -ETIMEDOUT (mutex re-acquired) */
+    SHIM_OP_COND_WAKE = 30,    /* args[0]=cond addr args[1]=all(0|1) */
+    SHIM_OP_SEM_INIT = 31,     /* args[0]=addr args[1]=initial value */
+    SHIM_OP_SEM_WAIT = 32,     /* args[0]=addr args[1]=try(0|1)
+                                  args[2]=timeout ns rel (-1 = infinite) */
+    SHIM_OP_SEM_POST = 33,     /* args[0]=addr; reply args[1]=new value */
+    SHIM_OP_SEM_GET = 34,      /* args[0]=addr; reply args[1]=value */
 };
 
 /* poll event bits (mirror Linux poll.h values) */

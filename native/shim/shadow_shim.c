@@ -57,9 +57,20 @@
 
 #include "../include/shadow_shim_abi.h"
 
+#include <pthread.h>
+#include <semaphore.h>
+
 #define SHIM_MAX_FDS 4096
 
 static shim_shmem *g_shm = NULL;
+/* Secondary threads exchange on their OWN channel (one per thread, exactly
+ * the reference's one-IPCData-per-ManagedThread, managed_thread.rs:355);
+ * the main thread and pre-thread code use g_shm. */
+static __thread shim_shmem *t_shm = NULL;
+static __thread int64_t t_vtid = 0; /* 0 = main thread */
+static __thread int t_exit_sent = 0;
+
+static shim_shmem *cur_shm(void) { return t_shm ? t_shm : g_shm; }
 static int g_ready = 0;
 /* exit code captured by the exit wrapper so the destructor's farewell can
  * report it (fork children are the PLUGIN's OS children; the manager
@@ -208,8 +219,9 @@ static int64_t shim_call(uint32_t op, const int64_t args[6], const void *out,
     }
     sigset_t sig_old;
     sigprocmask(SIG_SETMASK, &sig_blk, &sig_old);
-    shim_msg *tx = &g_shm->to_shadow;
-    shim_msg *rx = &g_shm->to_shim;
+    shim_shmem *shm = cur_shm();
+    shim_msg *tx = &shm->to_shadow;
+    shim_msg *rx = &shm->to_shim;
     tx->op = op;
     for (int i = 0; i < 6; i++) tx->args[i] = args ? args[i] : 0;
     if (out_len > SHIM_PAYLOAD_MAX) out_len = SHIM_PAYLOAD_MAX;
@@ -255,19 +267,22 @@ static void shim_warn(const char *what) {
     (void)!real_write(2, "\n", 1);
 }
 
-static void shim_attach(const char *path) {
+static shim_shmem *shim_map(const char *path) {
     int fd = open(path, O_RDWR);
-    if (fd < 0) shim_abort("cannot open SHADOW_TPU_SHM");
+    if (fd < 0) shim_abort("cannot open shim channel file");
     struct stat st;
     if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(shim_shmem))
         shim_abort("shm too small");
-    g_shm = mmap(NULL, sizeof(shim_shmem), PROT_READ | PROT_WRITE, MAP_SHARED,
-                 fd, 0);
+    shim_shmem *shm = mmap(NULL, sizeof(shim_shmem), PROT_READ | PROT_WRITE,
+                           MAP_SHARED, fd, 0);
     real_close(fd);
-    if (g_shm == MAP_FAILED) shim_abort("mmap failed");
-    if (g_shm->magic != SHIM_ABI_MAGIC || g_shm->abi_size != sizeof(shim_shmem))
+    if (shm == MAP_FAILED) shim_abort("mmap failed");
+    if (shm->magic != SHIM_ABI_MAGIC || shm->abi_size != sizeof(shim_shmem))
         shim_abort("ABI mismatch between shim and manager");
+    return shm;
 }
+
+static void shim_attach(const char *path) { g_shm = shim_map(path); }
 
 __attribute__((constructor)) static void shim_init(void) {
     const char *path = getenv("SHADOW_TPU_SHM");
@@ -283,7 +298,9 @@ __attribute__((constructor)) static void shim_init(void) {
 __attribute__((destructor)) static void shim_fini(void) {
     if (!g_ready) return;
     g_ready = 0;
-    shim_msg *tx = &g_shm->to_shadow;
+    /* exit() may run on a secondary thread: the manager is waiting on THAT
+     * thread's channel, so the farewell must ride it */
+    shim_msg *tx = &cur_shm()->to_shadow;
     tx->op = SHIM_OP_EXIT;
     tx->args[0] = g_exit_code;
     for (int i = 1; i < 6; i++) tx->args[i] = 0;
@@ -334,7 +351,9 @@ static void vfd_release(int fd) {
 /* --------------------------------------------------------------- time */
 
 static uint64_t sim_now_ns(void) {
-    return __atomic_load_n(&g_shm->sim_clock_ns, __ATOMIC_ACQUIRE);
+    /* each thread's channel clock is advanced on every reply to that
+     * thread, so the thread's own channel holds its freshest time */
+    return __atomic_load_n(&cur_shm()->sim_clock_ns, __ATOMIC_ACQUIRE);
 }
 
 int clock_gettime(clockid_t clk, struct timespec *ts) {
@@ -1345,6 +1364,312 @@ int gethostname(char *name, size_t len) {
 }
 
 
+/* ------------------------------------------------------------- threads */
+
+/* pthread support: each new thread gets its own futex channel via the
+ * PRETHREAD / THREAD_CREATED / THREAD_START handshake (the thread analog
+ * of the fork handshake below, mirroring the reference's per-thread
+ * IPCData + native_clone flow, managed_thread.rs:355).  The manager
+ * schedules thread turns like process turns, so a thread only runs while
+ * the simulation has handed it the turn.
+ *
+ * Mutexes, condvars, and unnamed semaphores are virtualized MANAGER-SIDE,
+ * keyed by object address (the futex-table analog, host/futex_table.rs):
+ * a native lock would block the OS thread outside the simulation and
+ * deadlock the turn.  Well-synchronized plugins stay deterministic;
+ * plugins with genuine data races were racy on real Linux too. */
+
+#define SHIM_MAX_THREADS 512
+static struct {
+    pthread_t th;
+    int64_t vtid;
+    int used;
+} thread_tab[SHIM_MAX_THREADS];
+
+static void shim_thread_table_reset(void) {
+    memset(thread_tab, 0, sizeof(thread_tab));
+}
+
+static int64_t thread_vtid_of(pthread_t th) {
+    for (int i = 0; i < SHIM_MAX_THREADS; i++)
+        if (thread_tab[i].used && pthread_equal(thread_tab[i].th, th))
+            return thread_tab[i].vtid;
+    return 0;
+}
+
+static void thread_table_remove(pthread_t th) {
+    for (int i = 0; i < SHIM_MAX_THREADS; i++)
+        if (thread_tab[i].used && pthread_equal(thread_tab[i].th, th))
+            thread_tab[i].used = 0;
+}
+
+/* fire-and-forget farewell on the exiting thread's own channel (the
+ * manager is blocked on it); no reply — the OS thread is on its way out */
+static void thread_send_exit(void *retval) {
+    if (t_exit_sent) return;
+    t_exit_sent = 1;
+    shim_msg *tx = &cur_shm()->to_shadow;
+    tx->op = SHIM_OP_THREAD_EXIT;
+    tx->args[0] = t_vtid;
+    tx->args[1] = (int64_t)(uintptr_t)retval;
+    for (int i = 2; i < 6; i++) tx->args[i] = 0;
+    tx->payload_len = 0;
+    msg_publish(tx);
+}
+
+typedef struct {
+    void *(*start)(void *);
+    void *arg;
+    shim_shmem *shm;
+    int64_t vtid;
+} shim_thread_boot;
+
+static void *shim_thread_tramp(void *p) {
+    shim_thread_boot boot = *(shim_thread_boot *)p;
+    free(p);
+    t_shm = boot.shm;
+    t_vtid = boot.vtid;
+    /* parks here until the thread's start event fires in the simulation */
+    int64_t args[6] = {boot.vtid, 0, 0, 0, 0, 0};
+    shim_call(SHIM_OP_THREAD_START, args, NULL, 0, NULL, NULL, NULL);
+    void *ret = boot.start(boot.arg);
+    thread_send_exit(ret);
+    return ret;
+}
+
+int pthread_create(pthread_t *th, const pthread_attr_t *attr,
+                   void *(*start)(void *), void *arg) {
+    static int (*real_create)(pthread_t *, const pthread_attr_t *,
+                              void *(*)(void *), void *);
+    if (!real_create) *(void **)&real_create = dlsym(RTLD_NEXT, "pthread_create");
+    if (!g_ready) return real_create(th, attr, start, arg);
+    char path[480];
+    uint32_t len = sizeof(path) - 1;
+    int64_t reply[6];
+    int64_t ret = shim_call(SHIM_OP_PRETHREAD, NULL, NULL, 0, path, &len, reply);
+    if (ret < 0) return (int)-ret;
+    path[len] = 0;
+    int64_t vtid = reply[1];
+    shim_thread_boot *boot = malloc(sizeof(*boot));
+    if (!boot) {
+        /* cancel so the manager frees the pending channel + file */
+        int64_t cargs[6] = {vtid, 1, 0, 0, 0, 0};
+        shim_call(SHIM_OP_THREAD_CREATED, cargs, NULL, 0, NULL, NULL, NULL);
+        return ENOMEM;
+    }
+    boot->start = start;
+    boot->arg = arg;
+    boot->shm = shim_map(path);
+    boot->vtid = vtid;
+    int r = real_create(th, attr, shim_thread_tramp, boot);
+    int64_t args[6] = {vtid, r != 0, 0, 0, 0, 0};
+    shim_call(SHIM_OP_THREAD_CREATED, args, NULL, 0, NULL, NULL, NULL);
+    if (r != 0) {
+        munmap(boot->shm, sizeof(shim_shmem));
+        free(boot);
+        return r;
+    }
+    for (int i = 0; i < SHIM_MAX_THREADS; i++) {
+        if (!thread_tab[i].used) {
+            thread_tab[i].th = *th;
+            thread_tab[i].vtid = vtid;
+            thread_tab[i].used = 1;
+            break;
+        }
+    }
+    return 0;
+}
+
+int pthread_join(pthread_t th, void **retval) {
+    static int (*real_join)(pthread_t, void **);
+    if (!real_join) *(void **)&real_join = dlsym(RTLD_NEXT, "pthread_join");
+    if (!g_ready) return real_join(th, retval);
+    int64_t vtid = thread_vtid_of(th);
+    if (!vtid) return real_join(th, retval); /* created pre-init: native */
+    int64_t args[6] = {vtid, 0, 0, 0, 0, 0};
+    int64_t reply[6];
+    int64_t ret = shim_call(SHIM_OP_THREAD_JOIN, args, NULL, 0, NULL, NULL, reply);
+    if (ret < 0) return (int)-ret; /* pthread API returns the error code */
+    if (retval) *retval = (void *)(uintptr_t)reply[1];
+    thread_table_remove(th);
+    /* reap the OS thread: it exits right after its farewell, so this
+     * blocks microseconds of wall time, never simulated time */
+    return real_join(th, NULL);
+}
+
+int pthread_detach(pthread_t th) {
+    static int (*real_detach)(pthread_t);
+    if (!real_detach) *(void **)&real_detach = dlsym(RTLD_NEXT, "pthread_detach");
+    if (!g_ready) return real_detach(th);
+    int64_t vtid = thread_vtid_of(th);
+    if (vtid) {
+        int64_t args[6] = {vtid, 1, 0, 0, 0, 0};
+        shim_call(SHIM_OP_THREAD_JOIN, args, NULL, 0, NULL, NULL, NULL);
+        thread_table_remove(th);
+    }
+    return real_detach(th);
+}
+
+void pthread_exit(void *retval) {
+    static void (*real_pexit)(void *) __attribute__((noreturn));
+    if (!real_pexit) *(void **)&real_pexit = dlsym(RTLD_NEXT, "pthread_exit");
+    /* vtid 0 = the MAIN thread retiring while others run: the manager
+     * stops servicing its channel and waits for the process farewell */
+    if (g_ready) thread_send_exit(retval);
+    real_pexit(retval);
+    __builtin_unreachable();
+}
+
+/* -- virtualized sync primitives -------------------------------------- */
+
+static int sync_call2(uint32_t op, int64_t a0, int64_t a1, int64_t a2,
+                      int64_t reply[6]) {
+    int64_t args[6] = {a0, a1, a2, 0, 0, 0};
+    int64_t ret = shim_call(op, args, NULL, 0, NULL, NULL, reply);
+    return ret < 0 ? (int)-ret : 0;
+}
+
+/* absolute sim-clock timespec -> relative ns (floor 0); -1 if null */
+static int64_t abs_to_rel_ns(const struct timespec *abstime) {
+    if (!abstime) return -1;
+    int64_t abs_ns =
+        (int64_t)abstime->tv_sec * 1000000000ll + abstime->tv_nsec;
+    int64_t now = (int64_t)sim_now_ns();
+    return abs_ns > now ? abs_ns - now : 0;
+}
+
+int pthread_mutex_lock(pthread_mutex_t *m) {
+    static int (*real_lock)(pthread_mutex_t *);
+    if (!real_lock) *(void **)&real_lock = dlsym(RTLD_NEXT, "pthread_mutex_lock");
+    if (!g_ready) return real_lock(m);
+    return sync_call2(SHIM_OP_MUTEX_LOCK, (int64_t)(uintptr_t)m, 0, -1, NULL);
+}
+
+int pthread_mutex_trylock(pthread_mutex_t *m) {
+    static int (*real_try)(pthread_mutex_t *);
+    if (!real_try) *(void **)&real_try = dlsym(RTLD_NEXT, "pthread_mutex_trylock");
+    if (!g_ready) return real_try(m);
+    return sync_call2(SHIM_OP_MUTEX_LOCK, (int64_t)(uintptr_t)m, 1, -1, NULL);
+}
+
+int pthread_mutex_timedlock(pthread_mutex_t *m, const struct timespec *abstime) {
+    static int (*real_timed)(pthread_mutex_t *, const struct timespec *);
+    if (!real_timed) *(void **)&real_timed = dlsym(RTLD_NEXT, "pthread_mutex_timedlock");
+    if (!g_ready) return real_timed(m, abstime);
+    return sync_call2(SHIM_OP_MUTEX_LOCK, (int64_t)(uintptr_t)m, 0,
+                      abs_to_rel_ns(abstime), NULL);
+}
+
+int pthread_mutex_unlock(pthread_mutex_t *m) {
+    static int (*real_unlock)(pthread_mutex_t *);
+    if (!real_unlock) *(void **)&real_unlock = dlsym(RTLD_NEXT, "pthread_mutex_unlock");
+    if (!g_ready) return real_unlock(m);
+    return sync_call2(SHIM_OP_MUTEX_UNLOCK, (int64_t)(uintptr_t)m, 0, 0, NULL);
+}
+
+int pthread_cond_wait(pthread_cond_t *c, pthread_mutex_t *m) {
+    static int (*real_wait)(pthread_cond_t *, pthread_mutex_t *);
+    if (!real_wait) *(void **)&real_wait = dlsym(RTLD_NEXT, "pthread_cond_wait");
+    if (!g_ready) return real_wait(c, m);
+    return sync_call2(SHIM_OP_COND_WAIT, (int64_t)(uintptr_t)c,
+                      (int64_t)(uintptr_t)m, -1, NULL);
+}
+
+int pthread_cond_timedwait(pthread_cond_t *c, pthread_mutex_t *m,
+                           const struct timespec *abstime) {
+    static int (*real_twait)(pthread_cond_t *, pthread_mutex_t *,
+                             const struct timespec *);
+    if (!real_twait) *(void **)&real_twait = dlsym(RTLD_NEXT, "pthread_cond_timedwait");
+    if (!g_ready) return real_twait(c, m, abstime);
+    return sync_call2(SHIM_OP_COND_WAIT, (int64_t)(uintptr_t)c,
+                      (int64_t)(uintptr_t)m, abs_to_rel_ns(abstime), NULL);
+}
+
+int pthread_cond_signal(pthread_cond_t *c) {
+    static int (*real_sig)(pthread_cond_t *);
+    if (!real_sig) *(void **)&real_sig = dlsym(RTLD_NEXT, "pthread_cond_signal");
+    if (!g_ready) return real_sig(c);
+    return sync_call2(SHIM_OP_COND_WAKE, (int64_t)(uintptr_t)c, 0, 0, NULL);
+}
+
+int pthread_cond_broadcast(pthread_cond_t *c) {
+    static int (*real_bcast)(pthread_cond_t *);
+    if (!real_bcast) *(void **)&real_bcast = dlsym(RTLD_NEXT, "pthread_cond_broadcast");
+    if (!g_ready) return real_bcast(c);
+    return sync_call2(SHIM_OP_COND_WAKE, (int64_t)(uintptr_t)c, 1, 0, NULL);
+}
+
+/* unnamed semaphores (sem_open named ones stay native) */
+int sem_init(sem_t *s, int pshared, unsigned int value) {
+    static int (*real_init)(sem_t *, int, unsigned int);
+    if (!real_init) *(void **)&real_init = dlsym(RTLD_NEXT, "sem_init");
+    if (!g_ready) return real_init(s, pshared, value);
+    (void)pshared; /* threads of one process only */
+    int e = sync_call2(SHIM_OP_SEM_INIT, (int64_t)(uintptr_t)s, value, 0, NULL);
+    if (e) {
+        errno = e;
+        return -1;
+    }
+    return 0;
+}
+
+static int sem_wait_common(sem_t *s, int try_, int64_t timeout_ns) {
+    int64_t e = sync_call2(SHIM_OP_SEM_WAIT, (int64_t)(uintptr_t)s, try_,
+                           timeout_ns, NULL);
+    if (e) {
+        errno = (int)e;
+        return -1;
+    }
+    return 0;
+}
+
+int sem_wait(sem_t *s) {
+    static int (*real_wait)(sem_t *);
+    if (!real_wait) *(void **)&real_wait = dlsym(RTLD_NEXT, "sem_wait");
+    if (!g_ready) return real_wait(s);
+    return sem_wait_common(s, 0, -1);
+}
+
+int sem_trywait(sem_t *s) {
+    static int (*real_try)(sem_t *);
+    if (!real_try) *(void **)&real_try = dlsym(RTLD_NEXT, "sem_trywait");
+    if (!g_ready) return real_try(s);
+    return sem_wait_common(s, 1, -1);
+}
+
+int sem_timedwait(sem_t *s, const struct timespec *abstime) {
+    static int (*real_timed)(sem_t *, const struct timespec *);
+    if (!real_timed) *(void **)&real_timed = dlsym(RTLD_NEXT, "sem_timedwait");
+    if (!g_ready) return real_timed(s, abstime);
+    return sem_wait_common(s, 0, abs_to_rel_ns(abstime));
+}
+
+int sem_post(sem_t *s) {
+    static int (*real_post)(sem_t *);
+    if (!real_post) *(void **)&real_post = dlsym(RTLD_NEXT, "sem_post");
+    if (!g_ready) return real_post(s);
+    int e = sync_call2(SHIM_OP_SEM_POST, (int64_t)(uintptr_t)s, 0, 0, NULL);
+    if (e) {
+        errno = e;
+        return -1;
+    }
+    return 0;
+}
+
+int sem_getvalue(sem_t *s, int *sval) {
+    static int (*real_get)(sem_t *, int *);
+    if (!real_get) *(void **)&real_get = dlsym(RTLD_NEXT, "sem_getvalue");
+    if (!g_ready) return real_get(s, sval);
+    int64_t reply[6];
+    int e = sync_call2(SHIM_OP_SEM_GET, (int64_t)(uintptr_t)s, 0, 0, reply);
+    if (e) {
+        errno = e;
+        return -1;
+    }
+    *sval = (int)reply[1];
+    return 0;
+}
+
 /* ---------------------------------------------------------- fork / wait */
 
 void exit(int status) {
@@ -1378,6 +1703,12 @@ pid_t fork(void) {
     if (pid < 0) return pid;
     if (pid == 0) {
         setenv("SHADOW_TPU_SHM", path, 1);
+        /* only the calling thread exists in the child (POSIX): it becomes
+         * the main thread of a fresh single-threaded process */
+        t_shm = NULL;
+        t_vtid = 0;
+        t_exit_sent = 0;
+        shim_thread_table_reset();
         shim_attach(path);
         int64_t args[6] = {getpid(), 0, 0, 0, 0, 0};
         /* parks here until the child's start event fires in the sim */

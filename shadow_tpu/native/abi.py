@@ -36,13 +36,29 @@ OP_PREFORK = 18
 OP_FORKED = 19
 OP_CHILD_START = 20
 OP_WAITPID = 21
+OP_PRETHREAD = 22
+OP_THREAD_CREATED = 23
+OP_THREAD_START = 24
+OP_THREAD_EXIT = 25
+OP_THREAD_JOIN = 26
+OP_MUTEX_LOCK = 27
+OP_MUTEX_UNLOCK = 28
+OP_COND_WAIT = 29
+OP_COND_WAKE = 30
+OP_SEM_INIT = 31
+OP_SEM_WAIT = 32
+OP_SEM_POST = 33
+OP_SEM_GET = 34
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
     6: "sendto", 7: "recvfrom", 8: "close", 9: "connect", 10: "getsockname",
     11: "listen", 12: "accept", 13: "shutdown", 14: "getpeername",
     15: "sockerr", 16: "poll", 17: "fionread", 18: "prefork", 19: "forked",
-    20: "child-start", 21: "waitpid",
+    20: "child-start", 21: "waitpid", 22: "prethread", 23: "thread-created",
+    24: "thread-start", 25: "thread-exit", 26: "thread-join",
+    27: "mutex-lock", 28: "mutex-unlock", 29: "cond-wait", 30: "cond-wake",
+    31: "sem-init", 32: "sem-wait", 33: "sem-post", 34: "sem-get",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)

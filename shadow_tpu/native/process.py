@@ -56,9 +56,9 @@ MAX_UNAPPLIED_LATENCY_NS = 100_000  # forced yield every ~100 calls
 # errno values the manager hands back over the channel (Linux numbers via
 # the stdlib so the table can't drift)
 from errno import (  # noqa: E402
-    EADDRINUSE, EAGAIN, EALREADY, EBADF, ECHILD, ECONNREFUSED, ECONNRESET,
-    EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINVAL, EISCONN, ENOSYS,
-    ENOTCONN, EPIPE, ETIMEDOUT,
+    EADDRINUSE, EAGAIN, EALREADY, EBADF, EBUSY, ECHILD, ECONNREFUSED,
+    ECONNRESET, EDEADLK, EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINVAL,
+    EISCONN, ENOSYS, ENOTCONN, EPIPE, ESRCH, ETIMEDOUT,
 )
 
 
@@ -115,16 +115,22 @@ class _VSocket:
 
 
 class _Proc:
-    """One OS process of a managed app: the root (spawned by the manager)
-    or a fork child (spawned by the plugin; registered via the PREFORK /
-    FORKED / CHILD_START handshake).  Each has its own channel, blocked-op
-    slot, and fd namespace (fork copies the parent's table, sharing the
+    """One schedulable plugin entity: an OS process — the root (spawned by
+    the manager) or a fork child (registered via the PREFORK / FORKED /
+    CHILD_START handshake) — or one THREAD of such a process (registered
+    via PRETHREAD / THREAD_CREATED / THREAD_START, the reference's
+    one-ManagedThread-per-thread model, managed_thread.rs:355).  Each has
+    its own channel and blocked-op slot; threads SHARE their process's fd
+    namespace (the same dict object), fork children copy it (sharing the
     refcounted socket objects, exactly like kernel fd inheritance)."""
 
     __slots__ = ("chan", "os_pid", "popen", "parent", "blocked", "sockets",
-                 "dead", "label", "saw_start", "cpu_lat")
+                 "dead", "label", "saw_start", "cpu_lat", "kind", "vtid",
+                 "os_proc", "detached", "main_exited", "mutexes", "conds",
+                 "sems", "thread_retvals")
 
-    def __init__(self, chan, os_pid=None, popen=None, parent=None, label="root"):
+    def __init__(self, chan, os_pid=None, popen=None, parent=None, label="root",
+                 kind="proc", vtid=0, os_proc=None):
         self.saw_start = False
         self.cpu_lat = 0  # unapplied syscall latency (cpu model)
         self.chan = chan
@@ -132,17 +138,35 @@ class _Proc:
         self.popen = popen  # root only
         self.parent = parent  # _Proc or None
         self.blocked: Optional[tuple] = None
-        self.sockets: dict[int, _VSocket] = {}
         self.dead = False
         self.label = label
+        self.kind = kind  # "proc" | "thread"
+        self.vtid = vtid  # thread only (>0)
+        self.os_proc = os_proc if os_proc is not None else self  # owning process
+        self.detached = False  # thread only
+        self.main_exited = False  # proc only: main thread pthread_exit'd
+        if kind == "thread":
+            self.sockets = os_proc.sockets  # same object: shared fd table
+        else:
+            self.sockets: dict[int, _VSocket] = {}
+            # sync-primitive tables, keyed by object address in the plugin —
+            # the manager-side futex table (host/futex_table.rs analog)
+            self.mutexes: dict[int, list] = {}  # addr -> [owner|None, waiters]
+            self.conds: dict[int, list] = {}  # addr -> [(thread, mutex_addr)]
+            self.sems: dict[int, list] = {}  # addr -> [value, waiters]
+            self.thread_retvals: dict[int, int] = {}  # zombie vtid -> retval
 
     @property
     def pid(self) -> int:
+        if self.kind == "thread":
+            return self.os_proc.pid
         return self.popen.pid if self.popen is not None else self.os_pid
 
     def alive(self) -> bool:
         if self.dead:
             return False
+        if self.kind == "thread":
+            return self.os_proc.alive()
         if self.popen is not None:
             return self.popen.poll() is None
         # fork children are the plugin's OS children: they stay zombies
@@ -173,6 +197,8 @@ class ManagedApp:
         self.zombies: list[tuple[int, int, _Proc]] = []  # (pid, wstatus, parent)
         self._pending_chans: list = []  # channels built at PREFORK
         self._child_idx = 0
+        self._vtid_next = 1  # virtual tids, app-wide (thread labels/joins)
+        self._pending_thread_chans: dict[int, object] = {}  # vtid -> channel
         self._cur: Optional[_Proc] = None  # proc whose turn is being serviced
         self.finished = False
         self.exit_code: Optional[int] = None
@@ -381,6 +407,37 @@ class ManagedApp:
             proc.blocked = None
             self._reply_poll(api, entries)  # whatever is ready now (maybe 0)
             self._service(api, proc)
+        elif kind == "mutex" and proc.blocked[3] == deadline:
+            m = self._mutex(proc.os_proc, proc.blocked[1])
+            if proc in m[1]:
+                m[1].remove(proc)
+            proc.blocked = None
+            self._reply(api, "mutex-lock", -ETIMEDOUT)
+            self._service(api, proc)
+        elif kind == "cond" and proc.blocked[3] == deadline:
+            # POSIX: a timed-out cond wait re-acquires the mutex before
+            # returning ETIMEDOUT
+            c_addr, m_addr = proc.blocked[1], proc.blocked[2]
+            os_p = proc.os_proc
+            waiters = os_p.conds.get(c_addr, [])
+            if proc in waiters:
+                waiters.remove(proc)
+            m = self._mutex(os_p, m_addr)
+            if m[0] is None and not m[1]:
+                m[0] = proc
+                proc.blocked = None
+                self._reply(api, "cond-wait", -ETIMEDOUT)
+                self._service(api, proc)
+            else:
+                proc.blocked = ("mutex", m_addr, -ETIMEDOUT, None, "cond-wait")
+                m[1].append(proc)
+        elif kind == "sem" and proc.blocked[2] == deadline:
+            s = self._sem(proc.os_proc, proc.blocked[1])
+            if proc in s[1]:
+                s[1].remove(proc)
+            proc.blocked = None
+            self._reply(api, "sem-wait", -ETIMEDOUT)
+            self._service(api, proc)
 
     def on_delivery(
         self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None
@@ -447,15 +504,17 @@ class ManagedApp:
                     proc.chan.wait_recv(proc.alive)
                 pending = False
             except abi.PluginDied:
-                if proc.parent is None:
-                    self._finish(api, unexpected=True)
-                else:
-                    self._child_exit(api, proc, 9, unexpected=True)  # SIGKILL
+                self._entity_died(api, proc)
                 return
             if (
                 self._cpu_model
                 and proc.cpu_lat >= MAX_UNAPPLIED_LATENCY_NS
-                and proc.chan.req.op not in (abi.OP_EXIT, abi.OP_START)
+                # farewell / first-turn messages cannot be delayed: EXIT and
+                # THREAD_EXIT never get a reply at all
+                and proc.chan.req.op not in (
+                    abi.OP_EXIT, abi.OP_START, abi.OP_THREAD_EXIT,
+                    abi.OP_THREAD_START, abi.OP_CHILD_START,
+                )
             ):
                 # apply the accumulated syscall latency: the pending call is
                 # serviced only after cpu_lat of simulated time passes
@@ -476,11 +535,16 @@ class ManagedApp:
                 proc.saw_start = True
                 self._reply(api, "start", 0)
             elif op == abi.OP_EXIT:
-                if proc.parent is None:
+                # exit() may run on any thread's channel: it always means
+                # the whole OS process is going down
+                os_proc = proc.os_proc
+                if proc.kind == "thread":
+                    proc.dead = True
+                if os_proc.parent is None:
                     self._finish(api, unexpected=False)
                 else:
                     code = int(req.args[0]) & 0xFF
-                    self._child_exit(api, proc, code << 8, unexpected=False)
+                    self._child_exit(api, os_proc, code << 8, unexpected=False)
                 return
             elif op == abi.OP_NANOSLEEP:
                 ns = req.args[0]
@@ -528,6 +592,37 @@ class ManagedApp:
             elif op == abi.OP_WAITPID:
                 if not self._op_waitpid(api, req):
                     return
+            elif op == abi.OP_PRETHREAD:
+                self._op_prethread(api, req)
+            elif op == abi.OP_THREAD_CREATED:
+                self._op_thread_created(api, req)
+            elif op == abi.OP_THREAD_EXIT:
+                # fire-and-forget: no reply (the OS thread is exiting)
+                if self._thread_exit_msg(api, proc, req):
+                    continue  # main retired, no threads left: await farewell
+                return
+            elif op == abi.OP_THREAD_JOIN:
+                if not self._op_thread_join(api, req):
+                    return
+            elif op == abi.OP_MUTEX_LOCK:
+                if not self._op_mutex_lock(api, req):
+                    return
+            elif op == abi.OP_MUTEX_UNLOCK:
+                self._op_mutex_unlock(api, req)
+            elif op == abi.OP_COND_WAIT:
+                self._op_cond_wait(api, req)
+                return  # always parks (reply arrives at wake/timeout)
+            elif op == abi.OP_COND_WAKE:
+                self._op_cond_wake(api, req)
+            elif op == abi.OP_SEM_INIT:
+                self._op_sem_init(api, req)
+            elif op == abi.OP_SEM_WAIT:
+                if not self._op_sem_wait(api, req):
+                    return
+            elif op == abi.OP_SEM_POST:
+                self._op_sem_post(api, req)
+            elif op == abi.OP_SEM_GET:
+                self._op_sem_get(api, req)
             elif op == abi.OP_CLOSE:
                 self._op_close(api, req)
             else:
@@ -573,7 +668,8 @@ class ManagedApp:
         """Parent returned from fork: register the child process, inherit
         the fd table (shared refcounted sockets), and schedule its first
         turn at the current instant."""
-        parent = self._cur
+        # children belong to the OS PROCESS, even when a thread forked
+        parent = self._cur.os_proc
         child_pid = int(req.args[0])
         chan = self._pending_chans.pop(0)
         child = _Proc(chan, os_pid=child_pid, parent=parent,
@@ -602,7 +698,8 @@ class ManagedApp:
     def _op_waitpid(self, api: HostApi, req) -> bool:
         pid = int(req.args[0])
         nohang = bool(req.args[1])
-        proc = self._cur
+        # children belong to the OS process; any of its threads may wait
+        proc = self._cur.os_proc
         z = self._match_zombie(proc, pid)
         if z is not None:
             self.zombies.remove(z)
@@ -610,12 +707,14 @@ class ManagedApp:
             return True
         if pid > 0:
             known = any(
-                p.parent is proc and not p.dead and p.pid == pid
+                p.kind == "proc" and p.parent is proc and not p.dead
+                and p.pid == pid
                 for p in self.procs
             )
         else:
             known = any(
-                p.parent is proc and not p.dead for p in self.procs
+                p.kind == "proc" and p.parent is proc and not p.dead
+                for p in self.procs
             ) or any(zp is proc for _pid, _st, zp in self.zombies)
         if not known:
             self._reply(api, "waitpid", -ECHILD)
@@ -638,6 +737,7 @@ class ManagedApp:
         and complete a parked waitpid in the parent (if any)."""
         proc.dead = True
         proc.blocked = None
+        self._reap_entity_threads(proc)
         for sock in list(proc.sockets.values()):
             self._drop_socket_ref(api, sock)
         proc.sockets.clear()
@@ -646,22 +746,354 @@ class ManagedApp:
         api.count("managed_child_exit_unexpected" if unexpected
                   else "managed_child_exit_clean")
         parent = proc.parent
-        if (parent is not None and not parent.dead
-                and parent.blocked is not None
-                and parent.blocked[0] == "waitpid"):
-            want = parent.blocked[1]
-            z = self._match_zombie(parent, want)
-            if z is not None:
-                self.zombies.remove(z)
-                parent.blocked = None
-                self._cur = parent
-                self._reply(api, "waitpid", z[0], args=[0, z[1]])
-                self._service(api, parent)
+        if parent is None or parent.dead:
+            return
+        # any thread of the parent process may hold the parked waitpid
+        for waiter in self.procs:
+            if (not waiter.dead and waiter.os_proc is parent
+                    and waiter.blocked is not None
+                    and waiter.blocked[0] == "waitpid"):
+                want = waiter.blocked[1]
+                z = self._match_zombie(parent, want)
+                if z is not None:
+                    self.zombies.remove(z)
+                    waiter.blocked = None
+                    self._cur = waiter
+                    self._reply(api, "waitpid", z[0], args=[0, z[1]])
+                    self._service(api, waiter)
+                return
+
+    def _reap_entity_threads(self, os_p: "_Proc") -> None:
+        """Mark every thread of a dead OS process dead and drop channels."""
+        for p in self.procs:
+            if p.kind == "thread" and p.os_proc is os_p and not p.dead:
+                p.dead = True
+                p.blocked = None
+                if p.chan is not None:
+                    p.chan.close()
+                    p.chan = None
 
     def _drop_socket_ref(self, api, sock: _VSocket) -> None:
         sock.refs -= 1
         if sock.refs <= 0:
             self._teardown_vsocket(api, sock)
+
+    # -- threads (the reference's one-ManagedThread-per-thread model,
+    # managed_thread.rs:355; sync primitives are the manager-side futex
+    # table, host/futex_table.rs) ------------------------------------------
+
+    def _live_threads(self, os_p: "_Proc", exclude=None) -> list:
+        return [
+            p for p in self.procs
+            if p.kind == "thread" and p.os_proc is os_p and not p.dead
+            and p is not exclude
+        ]
+
+    def _op_prethread(self, api: HostApi, req) -> None:
+        """A thread is about to be created: build its channel now and hand
+        back the path + virtual tid (the thread analog of PREFORK)."""
+        vtid = self._vtid_next
+        self._vtid_next += 1
+        path = (
+            self._host_dir_path / f"{self._stem}.{os.getpid()}.t{vtid}.shm"
+        )
+        seed = (
+            self._proc_seed(api) ^ (vtid * 0xD1B54A32D192ED03)
+        ) & ((1 << 64) - 1)
+        chan = abi.ShmChannel(
+            str(path),
+            seed=seed,
+            sndbuf=self._exp.socket_send_buffer if self._exp else None,
+            rcvbuf=self._exp.socket_recv_buffer if self._exp else None,
+        )
+        chan.set_clock(stime.sim_to_emu(api.now))
+        self._pending_thread_chans[vtid] = chan
+        self._reply(api, "prethread", 0, args=[0, vtid],
+                    payload=str(path).encode())
+
+    def _op_thread_created(self, api: HostApi, req) -> None:
+        """Creator returned from pthread_create: register the thread and
+        schedule its first turn (args[1]=1 cancels a failed create)."""
+        vtid = int(req.args[0])
+        failed = bool(req.args[1])
+        chan = self._pending_thread_chans.pop(vtid, None)
+        if failed or chan is None:
+            if chan is not None:
+                chan.close()
+            self._reply(api, "thread-created", 0)
+            return
+        os_p = self._cur.os_proc
+        t = _Proc(chan, os_pid=os_p.pid, parent=self._cur, label=f"t{vtid}",
+                  kind="thread", vtid=vtid, os_proc=os_p)
+        self.procs.append(t)
+        api.count("managed_threads")
+        api.schedule_at(api.now, lambda h, th=t: self._start_thread(h, th))
+        self._reply(api, "thread-created", 0)
+
+    def _start_thread(self, api, t: "_Proc") -> None:
+        """The thread's first turn: consume its THREAD_START and run it."""
+        if t.dead or self.finished:
+            return
+        self._cur = t
+        try:
+            t.chan.wait_recv(t.alive)
+        except abi.PluginDied:
+            self._entity_died(api, t)
+            return
+        self._reply(api, "thread-start", 0)
+        self._service(api, t)
+
+    def _entity_died(self, api, proc: "_Proc") -> None:
+        """The OS process behind an entity died without a farewell."""
+        os_p = proc.os_proc
+        if os_p.parent is None:
+            self._finish(api, unexpected=True)
+        else:
+            self._child_exit(api, os_p, 9, unexpected=True)  # SIGKILL
+
+    def _thread_exit_msg(self, api: HostApi, proc: "_Proc", req) -> bool:
+        """A THREAD_EXIT farewell arrived on ``proc``'s channel (no reply:
+        the OS thread is on its way out).  True = the whole OS process is
+        about to exit naturally and its farewell will arrive on this SAME
+        channel, so the caller should keep waiting on it."""
+        vtid = int(req.args[0])
+        retval = int(req.args[1])
+        os_p = proc.os_proc
+        if vtid == 0:
+            # the MAIN thread retired via pthread_exit: the process lives
+            # while other threads run; its channel goes quiet
+            os_p.main_exited = True
+            os_p.blocked = None
+            self._thread_release_locks(api, os_p)  # abandon held mutexes
+            api.count("managed_thread_main_retired")
+            return not self._live_threads(os_p)
+        self._thread_release_locks(api, proc)
+        proc.blocked = None
+        api.count("managed_thread_exits")
+        if os_p.main_exited and not self._live_threads(os_p, exclude=proc):
+            # last thread out after main retired: glibc exit(0) is
+            # imminent — keep the channel serviceable for the farewell
+            if not proc.detached:
+                os_p.thread_retvals[proc.vtid] = retval
+            return True
+        proc.dead = True
+        if not proc.detached:
+            os_p.thread_retvals[proc.vtid] = retval
+            self._wake_joiner(api, os_p, proc.vtid)
+        if proc.chan is not None:
+            proc.chan.close()
+            proc.chan = None
+        return False
+
+    def _resume_granted(self, api, proc: "_Proc", opname: str, ret: int,
+                        args=None) -> None:
+        """Complete a parked call whose state is already settled (ownership
+        granted, retval popped).  The reply + resume are DEFERRED to an
+        engine event at the current instant so the currently-active thread
+        parks first — preserving strict turn-taking: at most one plugin
+        entity runs natively at any moment (the shim ABI invariant the
+        determinism guarantee rests on)."""
+
+        def fire(h, p=proc):
+            if p.dead or self.finished:
+                return
+            self._cur = p
+            self._reply(h, opname, ret, args=args)
+            self._service(h, p)
+
+        api.schedule_at(api.now, fire)
+
+    def _wake_joiner(self, api, os_p: "_Proc", vtid: int) -> None:
+        for p in self.procs:
+            if (not p.dead and p.os_proc is os_p and p.blocked is not None
+                    and p.blocked[0] == "join" and p.blocked[1] == vtid):
+                rv = os_p.thread_retvals.pop(vtid, 0)
+                p.blocked = None
+                self._resume_granted(api, p, "thread-join", 0, args=[0, rv])
+                return
+
+    def _op_thread_join(self, api: HostApi, req) -> bool:
+        vtid = int(req.args[0])
+        detach = bool(req.args[1])
+        os_p = self._cur.os_proc
+        if not detach and vtid == self._cur.vtid:
+            # join(self) would park forever; glibc returns EDEADLK
+            self._reply(api, "thread-join", -EDEADLK)
+            return True
+        if detach:
+            if vtid in os_p.thread_retvals:
+                os_p.thread_retvals.pop(vtid)
+            else:
+                for p in self._live_threads(os_p):
+                    if p.vtid == vtid:
+                        p.detached = True
+            self._reply(api, "thread-detach", 0)
+            return True
+        if vtid in os_p.thread_retvals:
+            rv = os_p.thread_retvals.pop(vtid)
+            self._reply(api, "thread-join", 0, args=[0, rv])
+            return True
+        if any(p.vtid == vtid for p in self._live_threads(os_p)):
+            self._park(api, ("join", vtid), None)
+            return False
+        self._reply(api, "thread-join", -ESRCH)
+        return True
+
+    def _thread_release_locks(self, api, proc: "_Proc") -> None:
+        """An exiting thread abandons its mutexes: hand them to the next
+        waiter so the simulation cannot deadlock on a dead owner."""
+        os_p = proc.os_proc
+        for addr, m in list(os_p.mutexes.items()):
+            if m[0] is proc:
+                m[0] = None
+                self._mutex_grant_next(api, os_p, addr)
+
+    # -- virtualized sync primitives (address-keyed, per OS process) -------
+
+    @staticmethod
+    def _mutex(os_p: "_Proc", addr: int) -> list:
+        return os_p.mutexes.setdefault(addr, [None, []])
+
+    @staticmethod
+    def _sem(os_p: "_Proc", addr: int) -> list:
+        return os_p.sems.setdefault(addr, [0, []])
+
+    def _op_mutex_lock(self, api: HostApi, req) -> bool:
+        addr = int(req.args[0])
+        try_ = bool(req.args[1])
+        timeout = int(req.args[2])
+        cur = self._cur
+        m = self._mutex(cur.os_proc, addr)
+        if m[0] is None:
+            m[0] = cur
+            self._reply(api, "mutex-lock", 0)
+            return True
+        if try_:
+            # POSIX: trylock reports EBUSY for ANY held mutex, self-held too
+            self._reply(api, "mutex-lock", -EBUSY)
+            return True
+        if m[0] is cur:
+            # non-recursive: the honest error beats hanging the simulation
+            self._reply(api, "mutex-lock", -EDEADLK)
+            return True
+        deadline = None if timeout < 0 else api.now + timeout
+        m[1].append(cur)
+        self._park(api, ("mutex", addr, 0, deadline, "mutex-lock"), deadline)
+        return False
+
+    def _mutex_grant_next(self, api, os_p: "_Proc", addr: int) -> None:
+        """Hand a free mutex to its first waiter (FIFO — deterministic)
+        and resume that thread (deferred: see _resume_granted)."""
+        m = os_p.mutexes.get(addr)
+        if m is None or m[0] is not None:
+            return
+        while m[1]:
+            nxt = m[1].pop(0)
+            if nxt.dead or nxt.blocked is None or nxt.blocked[0] != "mutex":
+                continue
+            # grant_ret is 0, or -ETIMEDOUT for a timed-out cond wait
+            # re-acquiring its mutex; the opname keeps strace honest about
+            # which PLUGIN call is being completed
+            _kind, _addr, grant_ret, _dl, opname = nxt.blocked
+            m[0] = nxt
+            nxt.blocked = None
+            self._resume_granted(api, nxt, opname, grant_ret)
+            return
+
+    def _op_mutex_unlock(self, api: HostApi, req) -> None:
+        addr = int(req.args[0])
+        cur = self._cur
+        os_p = cur.os_proc
+        m = os_p.mutexes.get(addr)
+        self._reply(api, "mutex-unlock", 0)  # unlocker resumes first
+        if m is not None and m[0] is cur:
+            m[0] = None
+            self._mutex_grant_next(api, os_p, addr)
+
+    def _op_cond_wait(self, api: HostApi, req) -> None:
+        """Atomically: park on the condvar, then release the mutex (waking
+        its next waiter).  Always parks; the reply arrives at wake or
+        timeout.  POSIX re-acquire-before-return is honored by routing the
+        wake through the mutex wait queue."""
+        c_addr = int(req.args[0])
+        m_addr = int(req.args[1])
+        timeout = int(req.args[2])
+        cur = self._cur
+        os_p = cur.os_proc
+        deadline = None if timeout < 0 else api.now + timeout
+        os_p.conds.setdefault(c_addr, []).append(cur)
+        self._park(api, ("cond", c_addr, m_addr, deadline), deadline)
+        m = os_p.mutexes.get(m_addr)
+        if m is not None and m[0] is cur:
+            m[0] = None
+            self._mutex_grant_next(api, os_p, m_addr)
+
+    def _op_cond_wake(self, api: HostApi, req) -> None:
+        c_addr = int(req.args[0])
+        wake_all = bool(req.args[1])
+        os_p = self._cur.os_proc
+        waiters = os_p.conds.get(c_addr, [])
+        take = list(waiters) if wake_all else waiters[:1]
+        del waiters[: len(take)]
+        self._reply(api, "cond-wake", 0)  # signaler resumes first
+        for w in take:
+            if w.dead or w.blocked is None or w.blocked[0] != "cond":
+                continue
+            m_addr = w.blocked[2]
+            m = self._mutex(os_p, m_addr)
+            if m[0] is None and not m[1]:
+                m[0] = w
+                w.blocked = None
+                self._resume_granted(api, w, "cond-wait", 0)
+            else:
+                # mutex busy (usually held by the signaler): queue for it
+                w.blocked = ("mutex", m_addr, 0, None, "cond-wait")
+                m[1].append(w)
+
+    def _op_sem_init(self, api: HostApi, req) -> None:
+        addr = int(req.args[0])
+        value = int(req.args[1])
+        self._cur.os_proc.sems[addr] = [value, []]
+        self._reply(api, "sem-init", 0)
+
+    def _op_sem_wait(self, api: HostApi, req) -> bool:
+        addr = int(req.args[0])
+        try_ = bool(req.args[1])
+        timeout = int(req.args[2])
+        cur = self._cur
+        s = self._sem(cur.os_proc, addr)
+        if s[0] > 0:
+            s[0] -= 1
+            self._reply(api, "sem-wait", 0)
+            return True
+        if try_:
+            self._reply(api, "sem-wait", -EAGAIN)
+            return True
+        deadline = None if timeout < 0 else api.now + timeout
+        s[1].append(cur)
+        self._park(api, ("sem", addr, deadline), deadline)
+        return False
+
+    def _op_sem_post(self, api: HostApi, req) -> None:
+        addr = int(req.args[0])
+        os_p = self._cur.os_proc
+        s = self._sem(os_p, addr)
+        woken = None
+        while s[1]:
+            w = s[1].pop(0)
+            if not w.dead and w.blocked is not None and w.blocked[0] == "sem":
+                woken = w
+                break
+        if woken is None:
+            s[0] += 1
+        self._reply(api, "sem-post", 0, args=[0, s[0]])
+        if woken is not None:
+            woken.blocked = None
+            self._resume_granted(api, woken, "sem-wait", 0)
+
+    def _op_sem_get(self, api: HostApi, req) -> None:
+        s = self._sem(self._cur.os_proc, int(req.args[0]))
+        self._reply(api, "sem-get", 0, args=[0, s[0]])
 
     # -- socket ops --------------------------------------------------------
 
@@ -1245,6 +1677,8 @@ class ManagedApp:
             if app is self:
                 del ports[port]
         for proc in self.procs:
+            if proc.kind == "thread":
+                continue  # shares its process's fd table (same object)
             for sock in list(proc.sockets.values()):
                 if sock.kind in ("tcp", "listen"):
                     self._teardown_vsocket(api, sock)
@@ -1253,16 +1687,21 @@ class ManagedApp:
     def _kill_children(self) -> None:
         """Fork children are the PLUGIN's OS children; at teardown they are
         killed directly (their zombies reparent to init when the root
-        exits)."""
+        exits).  Threads die with their OS process — just drop their
+        channels."""
         for proc in self.procs[1:]:
-            if not proc.dead:
-                proc.dead = True
-                proc.blocked = None
+            if proc.dead:
+                continue
+            proc.dead = True
+            proc.blocked = None
+            if proc.kind == "proc":
                 try:
                     os.kill(proc.os_pid, _signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
+            if proc.chan is not None:
                 proc.chan.close()
+                proc.chan = None
 
     def _close_files(self) -> None:
         if self._stdout_file:
@@ -1274,6 +1713,9 @@ class ManagedApp:
         for chan in self._pending_chans:
             chan.close()
         self._pending_chans.clear()
+        for chan in self._pending_thread_chans.values():
+            chan.close()
+        self._pending_thread_chans.clear()
         if self.procs and self.procs[0].chan is not None:
             self.procs[0].chan.close()
             self.procs[0].chan = None
