@@ -1,0 +1,73 @@
+/* rawsys: deliberately bypasses the LD_PRELOAD layer — raw syscall(2)
+ * invocations and vDSO-direct time reads — to exercise the seccomp SIGSYS
+ * backstop and the vDSO patch (the reference's shim_seccomp.c /
+ * patch_vdso.c coverage, tested there via src/test/time + golang raw
+ * callers).
+ *
+ * Every number printed derives from the simulated clock / deterministic
+ * entropy, so output is bit-identical run-to-run when the backstops work,
+ * and wall-clock garbage when they don't.
+ */
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+static long raw(long nr, long a1, long a2, long a3, long a4) {
+    return syscall(nr, a1, a2, a3, a4);
+}
+
+static uint64_t raw_now_ns(void) {
+    struct timespec ts;
+    raw(SYS_clock_gettime, CLOCK_REALTIME, (long)&ts, 0, 0);
+    return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+static int run_raw(void) {
+    uint64_t t0 = raw_now_ns();
+    struct timespec req = {0, 50 * 1000000L}; /* 50ms raw nanosleep */
+    raw(SYS_nanosleep, (long)&req, 0, 0, 0);
+    uint64_t t1 = raw_now_ns();
+    unsigned char buf[8];
+    long n = raw(SYS_getrandom, (long)buf, sizeof buf, 0, 0);
+    printf("raw: t0=%llu slept_ms=%llu getrandom_n=%ld bytes=",
+           (unsigned long long)t0, (unsigned long long)((t1 - t0) / 1000000ull),
+           n);
+    for (int i = 0; i < 8; i++) printf("%02x", buf[i]);
+    printf("\n");
+    return 0;
+}
+
+static int run_vdso(void) {
+    /* resolve glibc's own clock_gettime/gettimeofday (RTLD_NEXT from the
+     * main binary skips the shim), which dispatch through the vDSO: only
+     * the patched vDSO can make these return simulated time */
+    int (*libc_cg)(clockid_t, struct timespec *) =
+        (int (*)(clockid_t, struct timespec *))dlsym(RTLD_NEXT,
+                                                     "clock_gettime");
+    int (*libc_gtod)(struct timeval *, void *) =
+        (int (*)(struct timeval *, void *))dlsym(RTLD_NEXT, "gettimeofday");
+    if (!libc_cg || !libc_gtod) {
+        fprintf(stderr, "dlsym failed\n");
+        return 1;
+    }
+    struct timespec ts;
+    libc_cg(CLOCK_REALTIME, &ts);
+    struct timeval tv;
+    libc_gtod(&tv, NULL);
+    printf("vdso: sec=%lld usec_sec=%lld\n", (long long)ts.tv_sec,
+           (long long)tv.tv_sec);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IOLBF, 0);
+    if (argc >= 2 && strcmp(argv[1], "raw") == 0) return run_raw();
+    if (argc >= 2 && strcmp(argv[1], "vdso") == 0) return run_vdso();
+    fprintf(stderr, "usage: rawsys <raw|vdso>\n");
+    return 2;
+}

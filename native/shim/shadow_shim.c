@@ -284,12 +284,369 @@ static shim_shmem *shim_map(const char *path) {
 
 static void shim_attach(const char *path) { g_shm = shim_map(path); }
 
+/* --------------------------------------- interposition backstops.
+ * LD_PRELOAD only catches PLT calls; two further layers close the gaps the
+ * reference closes (shim/shim_seccomp.c, shim/patch_vdso.c):
+ *
+ *   1. vDSO patching: glibc-internal time reads and runtime-direct vDSO
+ *      calls never hit a syscall at all.  The vDSO entry points are
+ *      overwritten with jumps into sim-clock implementations.
+ *   2. seccomp SIGSYS trap: raw `syscall(...)` invocations of the time/
+ *      sleep/entropy set are trapped and emulated; anything else raw runs
+ *      natively.  The BPF filter allows syscalls issued from THIS .so's
+ *      text segment (instruction-pointer range), so the shim services
+ *      traps with its own raw-syscall helper without re-trapping —
+ *      the reference's allow-own-text discipline (shim_seccomp.c:36-70).
+ */
+
+static uint64_t sim_now_ns(void);      /* defined in the time section */
+static uint64_t splitmix64_next(void); /* defined in the random section */
+
+/* deterministic entropy fill, shared by the getrandom interposer and the
+ * SIGSYS arm (needs only g_shm, so it stays valid during the destructor) */
+static void fill_entropy(uint8_t *p, size_t left) {
+    while (left) {
+        uint64_t v = splitmix64_next();
+        size_t n = left < 8 ? left : 8;
+        memcpy(p, &v, n);
+        p += n;
+        left -= n;
+    }
+}
+
+static long shim_raw_syscall6(long nr, long a1, long a2, long a3, long a4,
+                              long a5, long a6) {
+    register long r10 __asm__("r10") = a4;
+    register long r8 __asm__("r8") = a5;
+    register long r9 __asm__("r9") = a6;
+    long ret;
+    __asm__ volatile("syscall"
+                     : "=a"(ret)
+                     : "a"(nr), "D"(a1), "S"(a2), "d"(a3), "r"(r10), "r"(r8),
+                       "r"(r9)
+                     : "rcx", "r11", "memory");
+    return ret;
+}
+
+/* -- vDSO patch -------------------------------------------------------- */
+
+#include <elf.h>
+#include <link.h>
+#include <sys/auxv.h>
+
+static int vdso_repl_clock_gettime(clockid_t clk, struct timespec *ts) {
+    if (!g_shm)
+        return (int)shim_raw_syscall6(SYS_clock_gettime, clk, (long)ts, 0, 0,
+                                      0, 0);
+    uint64_t now = sim_now_ns();
+    if (ts) {
+        ts->tv_sec = (time_t)(now / 1000000000ull);
+        ts->tv_nsec = (long)(now % 1000000000ull);
+    }
+    return 0;
+}
+
+static int vdso_repl_gettimeofday(struct timeval *tv, void *tz) {
+    if (!g_shm)
+        return (int)shim_raw_syscall6(SYS_gettimeofday, (long)tv, (long)tz, 0,
+                                      0, 0, 0);
+    uint64_t now = sim_now_ns();
+    if (tv) {
+        tv->tv_sec = (time_t)(now / 1000000000ull);
+        tv->tv_usec = (suseconds_t)((now % 1000000000ull) / 1000);
+    }
+    return 0;
+}
+
+static time_t vdso_repl_time(time_t *tloc) {
+    if (!g_shm)
+        return (time_t)shim_raw_syscall6(SYS_time, (long)tloc, 0, 0, 0, 0, 0);
+    time_t t = (time_t)(sim_now_ns() / 1000000000ull);
+    if (tloc) *tloc = t;
+    return t;
+}
+
+static int vdso_repl_clock_getres(clockid_t clk, struct timespec *ts) {
+    (void)clk;
+    if (ts) {
+        ts->tv_sec = 0;
+        ts->tv_nsec = 1; /* the simulated clock is integer nanoseconds */
+    }
+    return 0;
+}
+
+static long vdso_repl_getcpu(unsigned *cpu, unsigned *node, void *unused) {
+    (void)unused; /* deterministic: every plugin sees cpu 0 / node 0 */
+    if (cpu) *cpu = 0;
+    if (node) *node = 0;
+    return 0;
+}
+
+/* minimal in-memory vDSO symbol lookup (the classic parse_vdso walk:
+ * program headers -> PT_DYNAMIC -> DT_SYMTAB/DT_STRTAB/DT_HASH) */
+static void *vdso_sym(unsigned long base, const char *name) {
+    const Elf64_Ehdr *eh = (const Elf64_Ehdr *)base;
+    const Elf64_Phdr *ph = (const Elf64_Phdr *)(base + eh->e_phoff);
+    const Elf64_Dyn *dyn = NULL;
+    unsigned long load_off = base;
+    for (int i = 0; i < eh->e_phnum; i++) {
+        if (ph[i].p_type == PT_DYNAMIC)
+            dyn = (const Elf64_Dyn *)(base + ph[i].p_offset);
+        else if (ph[i].p_type == PT_LOAD)
+            load_off = base + ph[i].p_offset - ph[i].p_vaddr;
+    }
+    if (!dyn) return NULL;
+    const Elf64_Sym *symtab = NULL;
+    const char *strtab = NULL;
+    const uint32_t *hash = NULL;
+    for (const Elf64_Dyn *d = dyn; d->d_tag != DT_NULL; d++) {
+        void *p = (void *)(load_off + d->d_un.d_ptr);
+        if (d->d_tag == DT_SYMTAB) symtab = p;
+        else if (d->d_tag == DT_STRTAB) strtab = p;
+        else if (d->d_tag == DT_HASH) hash = p;
+    }
+    if (!symtab || !strtab || !hash) return NULL;
+    uint32_t nchain = hash[1];
+    for (uint32_t i = 0; i < nchain; i++) {
+        if (symtab[i].st_name && strcmp(strtab + symtab[i].st_name, name) == 0
+            && symtab[i].st_shndx != SHN_UNDEF)
+            return (void *)(load_off + symtab[i].st_value);
+    }
+    return NULL;
+}
+
+static void vdso_hijack(unsigned long base, const char *name, void *target) {
+    uint8_t *sym = vdso_sym(base, name);
+    if (!sym) return;
+    /* mov rax, imm64; jmp rax — 12 bytes, may straddle a page boundary */
+    unsigned long page = (unsigned long)sym & ~0xFFFul;
+    size_t span = ((unsigned long)sym + 12 > page + 0x1000) ? 0x2000 : 0x1000;
+    if (mprotect((void *)page, span, PROT_READ | PROT_WRITE | PROT_EXEC) != 0)
+        return;
+    uint8_t code[12] = {0x48, 0xB8, 0, 0, 0, 0, 0, 0, 0, 0, 0xFF, 0xE0};
+    memcpy(code + 2, &target, 8);
+    memcpy(sym, code, sizeof(code));
+    mprotect((void *)page, span, PROT_READ | PROT_EXEC);
+}
+
+static void patch_vdso(void) {
+    unsigned long base = getauxval(AT_SYSINFO_EHDR);
+    if (!base) return; /* no vDSO mapped: nothing to bypass us */
+    vdso_hijack(base, "__vdso_clock_gettime", (void *)vdso_repl_clock_gettime);
+    vdso_hijack(base, "__vdso_gettimeofday", (void *)vdso_repl_gettimeofday);
+    vdso_hijack(base, "__vdso_time", (void *)vdso_repl_time);
+    vdso_hijack(base, "__vdso_clock_getres", (void *)vdso_repl_clock_getres);
+    vdso_hijack(base, "__vdso_getcpu", (void *)vdso_repl_getcpu);
+}
+
+/* -- seccomp SIGSYS backstop ------------------------------------------- */
+
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
+#include <sys/prctl.h>
+#include <ucontext.h>
+
+static unsigned long g_text_lo, g_text_hi;
+static int g_seccomp_on; /* filter actually installed in THIS process */
+
+static int text_range_cb(struct dl_phdr_info *info, size_t sz, void *data) {
+    (void)sz;
+    (void)data;
+    unsigned long probe = (unsigned long)(void *)&shim_raw_syscall6;
+    for (int i = 0; i < info->dlpi_phnum; i++) {
+        const Elf64_Phdr *p = &info->dlpi_phdr[i];
+        if (p->p_type != PT_LOAD || !(p->p_flags & PF_X)) continue;
+        unsigned long lo = info->dlpi_addr + p->p_vaddr;
+        unsigned long hi = lo + p->p_memsz;
+        if (probe >= lo && probe < hi) {
+            g_text_lo = lo;
+            g_text_hi = hi;
+            return 1;
+        }
+    }
+    return 0;
+}
+
+static void sigsys_handler(int sig, siginfo_t *si, void *uctx) {
+    (void)sig;
+    (void)si;
+    int saved_errno = errno; /* handlers must be errno-transparent */
+    ucontext_t *uc = uctx;
+    greg_t *gr = uc->uc_mcontext.gregs;
+    long nr = gr[REG_RAX];
+    long a1 = gr[REG_RDI], a2 = gr[REG_RSI], a3 = gr[REG_RDX];
+    long a4 = gr[REG_R10], a5 = gr[REG_R8], a6 = gr[REG_R9];
+    long ret;
+    /* Guard on g_shm, not g_ready: during the destructor (g_ready==0, shm
+     * still mapped) emulation keeps working, and NOTHING in the trapped
+     * set re-executes natively — a stale filter from a previous exec
+     * generation traps the new shim's text too, so a native re-execution
+     * of a trapped nr could re-trap and recurse. */
+    if (!g_shm) {
+        ret = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
+    } else
+        switch (nr) {
+            case SYS_clock_gettime:
+                ret = vdso_repl_clock_gettime((clockid_t)a1,
+                                              (struct timespec *)a2);
+                break;
+            case SYS_gettimeofday:
+                ret = vdso_repl_gettimeofday((struct timeval *)a1, (void *)a2);
+                break;
+            case SYS_time:
+                ret = vdso_repl_time((time_t *)a1);
+                break;
+            case SYS_nanosleep:
+            case SYS_clock_nanosleep: {
+                const struct timespec *req;
+                struct timespec *rem;
+                int64_t ns;
+                if (nr == SYS_nanosleep) {
+                    req = (const struct timespec *)a1;
+                    rem = (struct timespec *)a2;
+                } else {
+                    req = (const struct timespec *)a3;
+                    rem = (struct timespec *)a4;
+                }
+                if (!req) {
+                    ret = -EFAULT;
+                    break;
+                }
+                ns = (int64_t)req->tv_sec * 1000000000ll + req->tv_nsec;
+                if (nr == SYS_clock_nanosleep && (a2 & 1 /* TIMER_ABSTIME */)) {
+                    ns -= (int64_t)sim_now_ns();
+                    if (ns < 0) ns = 0;
+                }
+                if (g_ready) {
+                    int64_t args[6] = {ns, 0, 0, 0, 0, 0};
+                    shim_call(SHIM_OP_NANOSLEEP, args, NULL, 0, NULL, NULL,
+                              NULL);
+                } /* else: dying process, nobody services the channel */
+                if (rem && nr == SYS_nanosleep) {
+                    rem->tv_sec = 0;
+                    rem->tv_nsec = 0;
+                }
+                ret = 0;
+                break;
+            }
+            case SYS_getrandom: {
+                uint8_t *p = (uint8_t *)a1;
+                size_t left = (size_t)a2;
+                if (!p && left) {
+                    ret = -EFAULT;
+                    break;
+                }
+                ret = (long)left;
+                fill_entropy(p, left);
+                break;
+            }
+            default:
+                /* not simulation-owned: run it natively (our helper's
+                 * syscall insn is inside the allowed text range) */
+                ret = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
+        }
+    gr[REG_RAX] = ret;
+    errno = saved_errno;
+}
+
+static void install_seccomp(void) {
+    if (!dl_iterate_phdr(text_range_cb, NULL) ||
+        (g_text_lo >> 32) != ((g_text_hi - 1) >> 32) ||
+        (uint32_t)g_text_hi == 0) {
+        shim_warn("seccomp backstop disabled: shim text range not usable");
+        return;
+    }
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = sigsys_handler;
+    sa.sa_flags = SA_SIGINFO | SA_RESTART;
+    sigemptyset(&sa.sa_mask);
+    static int (*real_sigaction_)(int, const struct sigaction *,
+                                  struct sigaction *);
+    if (!real_sigaction_)
+        *(void **)&real_sigaction_ = dlsym(RTLD_NEXT, "sigaction");
+    if (real_sigaction_(SIGSYS, &sa, NULL) != 0) {
+        shim_warn("seccomp backstop disabled: cannot install SIGSYS handler");
+        return;
+    }
+    uint32_t ip_off = 8; /* offsetof(struct seccomp_data, instruction_pointer) */
+    uint32_t ip_hi = (uint32_t)(g_text_lo >> 32);
+    uint32_t lo_start = (uint32_t)g_text_lo;
+    uint32_t lo_end = (uint32_t)g_text_hi;
+#ifndef SECCOMP_RET_KILL_PROCESS
+#define SECCOMP_RET_KILL_PROCESS 0x80000000U
+#endif
+    /* non-x86_64 arch (int 0x80 compat) and x32-ABI syscalls would use a
+     * different nr numbering and silently bypass the trap set: kill, as
+     * the reference's filter does for mismatched arch */
+    struct sock_filter filt[] = {
+        /* 0 */ BPF_STMT(BPF_LD | BPF_W | BPF_ABS, 4 /* arch */),
+        /* 1 */ BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, AUDIT_ARCH_X86_64, 1, 0),
+        /* 2 */ BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS),
+        /* 3 */ BPF_STMT(BPF_LD | BPF_W | BPF_ABS, ip_off + 4),
+        /* 4 */ BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, ip_hi, 0, 4),
+        /* 5 */ BPF_STMT(BPF_LD | BPF_W | BPF_ABS, ip_off),
+        /* 6 */ BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, lo_start, 0, 2),
+        /* 7 */ BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, lo_end, 1, 0),
+        /* 8 */ BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW),
+        /* 9 */ BPF_STMT(BPF_LD | BPF_W | BPF_ABS, 0 /* nr */),
+        /* 10 */ BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, 0x40000000 /* x32 */, 8, 0),
+        /* 11 */ BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_clock_gettime, 6, 0),
+        /* 12 */ BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_gettimeofday, 5, 0),
+        /* 13 */ BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_time, 4, 0),
+        /* 14 */ BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_nanosleep, 3, 0),
+        /* 15 */ BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_clock_nanosleep, 2, 0),
+        /* 16 */ BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_getrandom, 1, 0),
+        /* 17 */ BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW),
+        /* 18 */ BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_TRAP),
+        /* 19 */ BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS),
+    };
+    struct sock_fprog prog = {sizeof(filt) / sizeof(filt[0]), filt};
+    if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0 ||
+        prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &prog) != 0) {
+        shim_warn("seccomp backstop disabled: filter install failed");
+        return;
+    }
+    g_seccomp_on = 1;
+}
+
+/* The app must not displace the SIGSYS backstop — but only when the
+ * filter is actually installed here; otherwise apps that sandbox
+ * themselves (own seccomp + SIGSYS handler) must keep working. */
+int sigaction(int signum, const struct sigaction *act,
+              struct sigaction *oldact) {
+    static int (*real_sa)(int, const struct sigaction *, struct sigaction *);
+    if (!real_sa) *(void **)&real_sa = dlsym(RTLD_NEXT, "sigaction");
+    if (g_seccomp_on && signum == SIGSYS && act != NULL) {
+        if (oldact) memset(oldact, 0, sizeof(*oldact));
+        return 0; /* accepted and ignored: the backstop stays */
+    }
+    return real_sa(signum, act, oldact);
+}
+
+/* glibc's signal() resolves through internal __sigaction, bypassing the
+ * sigaction interposer — cover it directly */
+sighandler_t signal(int signum, sighandler_t handler) {
+    static sighandler_t (*real_signal)(int, sighandler_t);
+    if (!real_signal) *(void **)&real_signal = dlsym(RTLD_NEXT, "signal");
+    if (g_seccomp_on && signum == SIGSYS) return SIG_DFL;
+    return real_signal(signum, handler);
+}
+
 __attribute__((constructor)) static void shim_init(void) {
     const char *path = getenv("SHADOW_TPU_SHM");
     resolve_reals();
     if (!path) return; /* not under the simulator: become a no-op */
     shim_attach(path);
     g_ready = 1;
+    /* backstops before the first handshake (the reference's init order:
+     * shmem -> seccomp -> vdso, shim.c:108-122); default on, disabled via
+     * experimental.use_vdso_patching / use_seccomp */
+    const char *vd = getenv("SHADOW_TPU_VDSO");
+    if (!vd || strcmp(vd, "0") != 0) patch_vdso();
+    const char *sc = getenv("SHADOW_TPU_SECCOMP");
+    if (!sc || strcmp(sc, "0") != 0) install_seccomp();
     /* report in and wait for the go signal: from here on the plugin only
      * runs while the manager has handed it the turn */
     shim_call(SHIM_OP_START, NULL, NULL, 0, NULL, NULL, NULL);
@@ -356,37 +713,28 @@ static uint64_t sim_now_ns(void) {
     return __atomic_load_n(&cur_shm()->sim_clock_ns, __ATOMIC_ACQUIRE);
 }
 
+/* the libc-level symbols delegate to the single vDSO-repl implementations
+ * (one copy of the clock semantics for PLT, vDSO, and SIGSYS paths),
+ * converting kernel-style negative returns to errno */
 int clock_gettime(clockid_t clk, struct timespec *ts) {
-    if (!g_ready) {
-        /* pre-init or unmanaged: raw syscall (cannot recurse into us) */
-        return syscall(SYS_clock_gettime, clk, ts);
+    long r = vdso_repl_clock_gettime(clk, ts);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
     }
-    uint64_t now = sim_now_ns();
-    ts->tv_sec = now / 1000000000ull;
-    ts->tv_nsec = now % 1000000000ull;
     return 0;
 }
 
 int gettimeofday(struct timeval *tv, void *tz) {
-    (void)tz;
-    if (!g_ready) return syscall(SYS_gettimeofday, tv, tz);
-    uint64_t now = sim_now_ns();
-    tv->tv_sec = now / 1000000000ull;
-    tv->tv_usec = (now % 1000000000ull) / 1000;
+    long r = vdso_repl_gettimeofday(tv, tz);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
     return 0;
 }
 
-time_t time(time_t *tloc) {
-    if (!g_ready) {
-        struct timespec ts;
-        syscall(SYS_clock_gettime, CLOCK_REALTIME, &ts);
-        if (tloc) *tloc = ts.tv_sec;
-        return ts.tv_sec;
-    }
-    time_t t = (time_t)(sim_now_ns() / 1000000000ull);
-    if (tloc) *tloc = t;
-    return t;
-}
+time_t time(time_t *tloc) { return vdso_repl_time(tloc); }
 
 /* -------------------------------------------------------------- sleep */
 
@@ -427,16 +775,16 @@ static uint64_t splitmix64_next(void) {
 }
 
 ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
-    if (!g_ready) return syscall(SYS_getrandom, buf, buflen, flags);
-    uint8_t *p = buf;
-    size_t left = buflen;
-    while (left) {
-        uint64_t v = splitmix64_next();
-        size_t n = left < 8 ? left : 8;
-        memcpy(p, &v, n);
-        p += n;
-        left -= n;
+    if (!g_shm) {
+        long r = shim_raw_syscall6(SYS_getrandom, (long)buf, (long)buflen,
+                                   flags, 0, 0, 0);
+        if (r < 0) {
+            errno = (int)-r;
+            return -1;
+        }
+        return (ssize_t)r;
     }
+    fill_entropy(buf, buflen);
     return (ssize_t)buflen;
 }
 

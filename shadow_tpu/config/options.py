@@ -86,6 +86,11 @@ class ExperimentalOptions:
     interface_qdisc: str = "fifo"  # | "round-robin"
     # strace-style logging
     strace_logging_mode: str = "off"  # off | standard | deterministic
+    # managed-process interposition backstops (the reference's seccomp
+    # SIGSYS trap, shim_seccomp.c, and vDSO patching, patch_vdso.c):
+    # catch raw syscalls and vDSO-direct time reads that bypass LD_PRELOAD
+    use_seccomp: bool = True
+    use_vdso_patching: bool = True
     # fork features: interactive run-control console (pause/step/restart at
     # window boundaries) and [window-agg]/[host-exec-agg] telemetry
     run_control: bool = False

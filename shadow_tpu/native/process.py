@@ -374,6 +374,11 @@ class ManagedApp:
         if hosts_file is not None:
             env["SHADOW_TPU_HOSTS_FILE"] = str(hosts_file)
         env["SHADOW_TPU_HOSTNAME"] = api.hostname
+        # interposition backstops (default on; see ExperimentalOptions)
+        if self._exp is not None and not self._exp.use_seccomp:
+            env["SHADOW_TPU_SECCOMP"] = "0"
+        if self._exp is not None and not self._exp.use_vdso_patching:
+            env["SHADOW_TPU_VDSO"] = "0"
         self._stdout_file = open(host_dir / f"{stem}.stdout", "wb")
         self.proc = subprocess.Popen(
             self.argv,
