@@ -1,0 +1,115 @@
+/* fdsurf: exercises the fd-surface breadth of simulated sockets — dup/
+ * dup2 aliasing, scatter-gather I/O (writev/readv/sendmsg/recvmsg), and
+ * MSG_PEEK (the reference's dup + uio + socket/send_recv test dirs,
+ * src/test/{dup,uio,socket}).
+ *
+ * udp mode (against a pingpong echo server): fdsurf udp <ip> <port>
+ *   1. socket -> connect -> dup -> close(original) -> send/recv via dup
+ *   2. writev ["scatter ","gather"] -> readv echo into two buffers
+ *   3. sendmsg 2 iovecs + msg_name -> recvmsg with MSG_PEEK, then consume
+ *   4. dup2 to fd 100 -> ping via fd 100
+ * tcp mode (against a tcpecho server): fdsurf tcp <ip> <port>
+ *   send "peekme" -> recv(4, MSG_PEEK) -> recv(64) must still see all 6
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+static struct sockaddr_in peer_addr(const char *ip, int port) {
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, ip, &a.sin_addr);
+    return a;
+}
+
+static int run_udp(const char *ip, int port) {
+    struct sockaddr_in peer = peer_addr(ip, port);
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0 || connect(fd, (struct sockaddr *)&peer, sizeof peer) != 0) {
+        perror("socket/connect");
+        return 1;
+    }
+    /* 1: alias via dup; recv BLOCKS on the alias while the original fd is
+     * still open (the parked call must be completed for the alias's fd
+     * number, not the first number that maps to the socket), then the
+     * original closes and the alias keeps working */
+    int alias = dup(fd);
+    if (alias < 0) { perror("dup"); return 1; }
+    char buf[256];
+    if (send(alias, "via-dup", 7, 0) != 7) { perror("send dup"); return 1; }
+    ssize_t n = recv(alias, buf, sizeof buf, 0);
+    close(fd);
+    printf("dup: sent=7 echoed=%zd %.7s\n", n, buf);
+
+    /* 2: scatter-gather */
+    struct iovec out[2] = {{"scatter ", 8}, {"gather", 6}};
+    if (writev(alias, out, 2) != 14) { perror("writev"); return 1; }
+    char b1[8], b2[16];
+    struct iovec in[2] = {{b1, 8}, {b2, sizeof b2}};
+    n = readv(alias, in, 2);
+    printf("iov: echoed=%zd %.8s%.6s\n", n, b1, b2);
+
+    /* 3: msghdr + MSG_PEEK (peek must not consume the datagram) */
+    struct iovec mo[2] = {{"msg-", 4}, {"hdr", 3}};
+    struct msghdr mh = {0};
+    mh.msg_name = &peer;
+    mh.msg_namelen = sizeof peer;
+    mh.msg_iov = mo;
+    mh.msg_iovlen = 2;
+    if (sendmsg(alias, &mh, 0) != 7) { perror("sendmsg"); return 1; }
+    char pb[16] = {0};
+    struct iovec pi = {pb, sizeof pb};
+    struct sockaddr_in from = {0};
+    struct msghdr ph = {0};
+    ph.msg_name = &from;
+    ph.msg_namelen = sizeof from;
+    ph.msg_iov = &pi;
+    ph.msg_iovlen = 1;
+    ssize_t pn = recvmsg(alias, &ph, MSG_PEEK);
+    char cb[16] = {0};
+    ssize_t cn = recv(alias, cb, sizeof cb, 0);
+    printf("msg: peeked=%zd %.7s consumed=%zd %.7s same_port=%d\n", pn, pb,
+           cn, cb, ntohs(from.sin_port) == port);
+
+    /* 4: dup2 onto a chosen fd number */
+    if (dup2(alias, 100) != 100) { perror("dup2"); return 1; }
+    close(alias);
+    if (send(100, "via-100", 7, 0) != 7) { perror("send 100"); return 1; }
+    n = recv(100, buf, sizeof buf, 0);
+    printf("dup2: echoed=%zd %.7s\n", n, buf);
+    close(100);
+    return 0;
+}
+
+static int run_tcp(const char *ip, int port) {
+    struct sockaddr_in peer = peer_addr(ip, port);
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0 || connect(fd, (struct sockaddr *)&peer, sizeof peer) != 0) {
+        perror("socket/connect");
+        return 1;
+    }
+    if (send(fd, "peekme", 6, 0) != 6) { perror("send"); return 1; }
+    char pb[8] = {0};
+    ssize_t pn = recv(fd, pb, 4, MSG_PEEK); /* blocks until the echo lands */
+    char cb[64] = {0};
+    ssize_t cn = recv(fd, cb, sizeof cb, 0);
+    printf("tcp-peek: peeked=%zd %.4s consumed=%zd %.6s\n", pn, pb, cn, cb);
+    close(fd);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IOLBF, 0);
+    if (argc >= 4 && strcmp(argv[1], "udp") == 0)
+        return run_udp(argv[2], atoi(argv[3]));
+    if (argc >= 4 && strcmp(argv[1], "tcp") == 0)
+        return run_tcp(argv[2], atoi(argv[3]));
+    fprintf(stderr, "usage: fdsurf <udp|tcp> <ip> <port>\n");
+    return 2;
+}

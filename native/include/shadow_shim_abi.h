@@ -39,7 +39,8 @@ enum {
     SHIM_OP_BIND = 5,      /* args[0] = fd, args[1] = port (host order) */
     SHIM_OP_SENDTO = 6,    /* args[0]=fd args[1]=dst_ip(BE u32) args[2]=dst_port
                               args[3]=nb; payload = data */
-    SHIM_OP_RECVFROM = 7,  /* args[0]=fd args[1]=max_len args[2]=nb;
+    SHIM_OP_RECVFROM = 7,  /* args[0]=fd args[1]=max_len args[2]=nb
+                              args[3]=peek (MSG_PEEK: don't consume);
                               reply payload + args[1]=src ip args[2]=src port */
     SHIM_OP_CLOSE = 8,     /* args[0] = fd */
     SHIM_OP_CONNECT = 9,   /* args[0]=fd args[1]=ip(BE) args[2]=port args[3]=nb */
@@ -90,6 +91,10 @@ enum {
                                   args[2]=timeout ns rel (-1 = infinite) */
     SHIM_OP_SEM_POST = 33,     /* args[0]=addr; reply args[1]=new value */
     SHIM_OP_SEM_GET = 34,      /* args[0]=addr; reply args[1]=value */
+    SHIM_OP_DUP = 35,          /* args[0]=old fd args[1]=new reserved fd:
+                                  both numbers now alias one socket
+                                  (manager-side refcount, like fork
+                                  inheritance) */
 };
 
 /* poll event bits (mirror Linux poll.h values) */

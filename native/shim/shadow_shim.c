@@ -990,19 +990,17 @@ static ssize_t vfd_sendto(int fd, const void *buf, size_t n, int flags,
 }
 
 static ssize_t vfd_recvfrom(int fd, void *buf, size_t n, int flags,
-                            struct sockaddr *addr, socklen_t *alen) {
-    if (flags & MSG_PEEK) {
-        /* honest failure beats silently consuming the peeked bytes */
-        errno = EINVAL;
-        return -1;
-    }
+                            struct sockaddr *addr, socklen_t *alen,
+                            int *trunc_out) {
     int nb = vfd_nonblock[fd] || (flags & MSG_DONTWAIT);
-    int waitall = vfd_stream[fd] && (flags & MSG_WAITALL) && !nb;
+    int peek = (flags & MSG_PEEK) != 0;
+    int waitall = vfd_stream[fd] && (flags & MSG_WAITALL) && !nb && !peek;
     size_t off = 0;
+    if (trunc_out) *trunc_out = 0;
     for (;;) {
         size_t want = n - off;
         if (want > SHIM_PAYLOAD_MAX) want = SHIM_PAYLOAD_MAX;
-        int64_t args[6] = {fd, (int64_t)want, nb, 0, 0, 0};
+        int64_t args[6] = {fd, (int64_t)want, nb, peek, 0, 0};
         int64_t reply[6];
         uint32_t got = (uint32_t)want;
         int64_t ret = shim_call(SHIM_OP_RECVFROM, args, NULL, 0,
@@ -1012,12 +1010,48 @@ static ssize_t vfd_recvfrom(int fd, void *buf, size_t n, int flags,
             errno = (int)-ret;
             return -1;
         }
-        if (off == 0) fill_sockaddr(addr, alen, (uint32_t)reply[1],
-                                    (uint16_t)reply[2]);
+        if (off == 0) {
+            fill_sockaddr(addr, alen, (uint32_t)reply[1], (uint16_t)reply[2]);
+            if (trunc_out) *trunc_out = (int)reply[3]; /* datagram cut short */
+        }
         off += (size_t)ret;
-        if (ret == 0 || off >= n || !waitall) break;
+        /* peek never consumes, so looping would re-read the same bytes */
+        if (ret == 0 || off >= n || !waitall || peek) break;
     }
     return (ssize_t)off;
+}
+
+/* flatten/scatter helpers for iovec I/O over the single-buffer channel */
+#include <sys/uio.h>
+#include <limits.h>
+
+/* -1 = invalid set (count out of range or lengths overflow SSIZE_MAX,
+ * Linux's EINVAL conditions) */
+static ssize_t iov_total(const struct iovec *iov, int cnt) {
+    if (cnt < 0 || cnt > IOV_MAX) return -1;
+    size_t total = 0;
+    for (int i = 0; i < cnt; i++) {
+        if (iov[i].iov_len > (size_t)SSIZE_MAX - total) return -1;
+        total += iov[i].iov_len;
+    }
+    return (ssize_t)total;
+}
+
+static void iov_gather(const struct iovec *iov, int cnt, char *dst) {
+    for (int i = 0; i < cnt; i++) {
+        memcpy(dst, iov[i].iov_base, iov[i].iov_len);
+        dst += iov[i].iov_len;
+    }
+}
+
+static void iov_scatter(const struct iovec *iov, int cnt, const char *src,
+                        size_t n) {
+    for (int i = 0; i < cnt && n; i++) {
+        size_t take = iov[i].iov_len < n ? iov[i].iov_len : n;
+        memcpy(iov[i].iov_base, src, take);
+        src += take;
+        n -= take;
+    }
 }
 
 ssize_t sendto(int fd, const void *buf, size_t n, int flags,
@@ -1056,7 +1090,7 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
         maybe_yield(fd, POLLIN, flags & MSG_DONTWAIT);
         return real_recvfrom(fd, buf, n, flags, addr, alen);
     }
-    return vfd_recvfrom(fd, buf, n, flags, addr, alen);
+    return vfd_recvfrom(fd, buf, n, flags, addr, alen, NULL);
 }
 
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
@@ -1088,7 +1122,7 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
         if (yieldable) pipe_wait(fd, POLLIN);
         return real_recv(fd, buf, n, flags);
     }
-    return vfd_recvfrom(fd, buf, n, flags, NULL, NULL);
+    return vfd_recvfrom(fd, buf, n, flags, NULL, NULL, NULL);
 }
 
 ssize_t read(int fd, void *buf, size_t n) {
@@ -1096,7 +1130,7 @@ ssize_t read(int fd, void *buf, size_t n) {
         maybe_yield(fd, POLLIN, 0);
         return real_read(fd, buf, n);
     }
-    return vfd_recvfrom(fd, buf, n, 0, NULL, NULL);
+    return vfd_recvfrom(fd, buf, n, 0, NULL, NULL, NULL);
 }
 
 int shutdown(int fd, int how) {
@@ -2203,15 +2237,44 @@ int uname(struct utsname *buf) {
 }
 
 
-/* msghdr I/O: same yield discipline (AF_UNIX datagrams and SCM_RIGHTS
- * riders use these).  Simulated INET sockets do not support msghdr I/O
- * yet; fail loudly instead of bypassing the simulation. */
+/* msghdr I/O: simulated sockets flatten the iovec over the channel
+ * (ancillary/control data is not carried — SCM_RIGHTS over a simulated
+ * INET socket has no meaning); real fds keep the yield discipline. */
 ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
     static ssize_t (*real_recvmsg)(int, struct msghdr *, int);
     if (!real_recvmsg) *(void **)&real_recvmsg = dlsym(RTLD_NEXT, "recvmsg");
     if (is_vfd(fd)) {
-        errno = ENOSYS;
-        return -1;
+        if (!msg) {
+            errno = EFAULT;
+            return -1;
+        }
+        ssize_t total = iov_total(msg->msg_iov, (int)msg->msg_iovlen);
+        if (total < 0) {
+            errno = EINVAL;
+            return -1;
+        }
+        int single = msg->msg_iovlen == 1; /* common case: no bounce copy */
+        char *buf = single ? msg->msg_iov[0].iov_base
+                           : malloc(total > 0 ? (size_t)total : 1);
+        if (!buf && !single) {
+            errno = ENOMEM;
+            return -1;
+        }
+        socklen_t slen = msg->msg_namelen;
+        int trunc = 0;
+        ssize_t r = vfd_recvfrom(fd, buf, (size_t)total, flags,
+                                 (struct sockaddr *)msg->msg_name,
+                                 msg->msg_name ? &slen : NULL, &trunc);
+        if (r >= 0) {
+            if (!single)
+                iov_scatter(msg->msg_iov, (int)msg->msg_iovlen, buf,
+                            (size_t)r);
+            if (msg->msg_name) msg->msg_namelen = slen;
+            msg->msg_controllen = 0;
+            msg->msg_flags = trunc ? MSG_TRUNC : 0;
+        }
+        if (!single) free(buf);
+        return r;
     }
     maybe_yield(fd, POLLIN, flags & MSG_DONTWAIT);
     return real_recvmsg(fd, msg, flags);
@@ -2221,22 +2284,113 @@ ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
     static ssize_t (*real_sendmsg)(int, const struct msghdr *, int);
     if (!real_sendmsg) *(void **)&real_sendmsg = dlsym(RTLD_NEXT, "sendmsg");
     if (is_vfd(fd)) {
-        errno = ENOSYS;
-        return -1;
+        if (!msg) {
+            errno = EFAULT;
+            return -1;
+        }
+        uint32_t ip = 0;
+        uint16_t port = 0;
+        if (msg->msg_name &&
+            addr_to_ip_port(msg->msg_name, msg->msg_namelen, &ip, &port) != 0)
+            return -1;
+        ssize_t total = iov_total(msg->msg_iov, (int)msg->msg_iovlen);
+        if (total < 0) {
+            errno = EINVAL;
+            return -1;
+        }
+        if (msg->msg_iovlen == 1)
+            return vfd_sendto(fd, msg->msg_iov[0].iov_base, (size_t)total,
+                              flags, ip, port);
+        char *buf = malloc(total > 0 ? (size_t)total : 1);
+        if (!buf) {
+            errno = ENOMEM;
+            return -1;
+        }
+        iov_gather(msg->msg_iov, (int)msg->msg_iovlen, buf);
+        ssize_t r = vfd_sendto(fd, buf, (size_t)total, flags, ip, port);
+        free(buf);
+        return r;
     }
     maybe_yield(fd, POLLOUT, flags & MSG_DONTWAIT);
     return real_sendmsg(fd, msg, flags);
 }
 
-/* dup family: keep the fifo cache honest; duplicating a SIMULATED socket
- * is not supported yet (two fd numbers would alias one manager-side
- * socket without refcounting the manager entry) — fail loudly. */
+ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
+    static ssize_t (*real_writev)(int, const struct iovec *, int);
+    if (!real_writev) *(void **)&real_writev = dlsym(RTLD_NEXT, "writev");
+    if (!is_vfd(fd)) {
+        maybe_yield(fd, POLLOUT, 0);
+        return real_writev(fd, iov, iovcnt);
+    }
+    ssize_t total = iov_total(iov, iovcnt);
+    if (total < 0) {
+        errno = EINVAL;
+        return -1;
+    }
+    if (iovcnt == 1)
+        return vfd_sendto(fd, iov[0].iov_base, (size_t)total, 0, 0, 0);
+    char *buf = malloc(total > 0 ? (size_t)total : 1);
+    if (!buf) {
+        errno = ENOMEM;
+        return -1;
+    }
+    iov_gather(iov, iovcnt, buf);
+    ssize_t r = vfd_sendto(fd, buf, (size_t)total, 0, 0, 0);
+    free(buf);
+    return r;
+}
+
+ssize_t readv(int fd, const struct iovec *iov, int iovcnt) {
+    static ssize_t (*real_readv)(int, const struct iovec *, int);
+    if (!real_readv) *(void **)&real_readv = dlsym(RTLD_NEXT, "readv");
+    if (!is_vfd(fd)) {
+        maybe_yield(fd, POLLIN, 0);
+        return real_readv(fd, iov, iovcnt);
+    }
+    ssize_t total = iov_total(iov, iovcnt);
+    if (total < 0) {
+        errno = EINVAL;
+        return -1;
+    }
+    if (iovcnt == 1)
+        return vfd_recvfrom(fd, iov[0].iov_base, (size_t)total, 0, NULL,
+                            NULL, NULL);
+    char *buf = malloc(total > 0 ? (size_t)total : 1);
+    if (!buf) {
+        errno = ENOMEM;
+        return -1;
+    }
+    ssize_t r = vfd_recvfrom(fd, buf, (size_t)total, 0, NULL, NULL, NULL);
+    if (r > 0) iov_scatter(iov, iovcnt, buf, (size_t)r);
+    free(buf);
+    return r;
+}
+
+/* dup family: duplicating a simulated socket registers the new fd number
+ * as an alias of the same manager-side socket (refcounted, like fork
+ * inheritance).  O_NONBLOCK is copied at dup time — it nominally lives on
+ * the shared open file description, a divergence only visible to apps
+ * that F_SETFL one alias and expect the other to change. */
+static int vfd_dup_common(int oldfd, int newfd) {
+    int64_t args[6] = {oldfd, newfd, 0, 0, 0, 0};
+    int64_t ret = shim_call(SHIM_OP_DUP, args, NULL, 0, NULL, NULL, NULL);
+    if (ret < 0) {
+        real_close(newfd);
+        errno = (int)-ret;
+        return -1;
+    }
+    vfd_register(newfd, vfd_nonblock[oldfd], vfd_stream[oldfd]);
+    vfd_listening[newfd] = vfd_listening[oldfd];
+    return newfd;
+}
+
 int dup(int oldfd) {
     static int (*real_dup)(int);
     if (!real_dup) *(void **)&real_dup = dlsym(RTLD_NEXT, "dup");
     if (is_vfd(oldfd)) {
-        errno = EBADF;
-        return -1;
+        int fd = reserve_fd();
+        if (fd < 0) return -1;
+        return vfd_dup_common(oldfd, fd);
     }
     int fd = real_dup(oldfd);
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
@@ -2246,10 +2400,25 @@ int dup(int oldfd) {
 int dup2(int oldfd, int newfd) {
     static int (*real_dup2)(int, int);
     if (!real_dup2) *(void **)&real_dup2 = dlsym(RTLD_NEXT, "dup2");
-    if (is_vfd(oldfd) || is_vfd(newfd)) {
-        errno = EBADF;
-        return -1;
+    if (is_vfd(oldfd)) {
+        if (oldfd == newfd) return newfd;
+        if (newfd < 0 || newfd >= SHIM_MAX_FDS) {
+            errno = EBADF;
+            return -1;
+        }
+        close(newfd); /* interposed: handles sim and real targets alike */
+        /* occupy newfd with an O_PATH reservation at that exact number;
+         * keep it CLOEXEC so the stub cannot leak into an exec'd image
+         * (simulated sockets never survive exec anyway) */
+        int tmp = open("/dev/null", O_PATH | O_CLOEXEC);
+        if (tmp < 0) return -1;
+        int r = real_dup2(tmp, newfd);
+        real_close(tmp);
+        if (r < 0) return -1;
+        real_fcntl(newfd, F_SETFD, FD_CLOEXEC);
+        return vfd_dup_common(oldfd, newfd);
     }
+    if (is_vfd(newfd)) close(newfd); /* real replaces a simulated socket */
     int fd = real_dup2(oldfd, newfd);
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
     if (fd >= 0 && g_ready) epoll_forget_fd(fd);
@@ -2259,10 +2428,14 @@ int dup2(int oldfd, int newfd) {
 int dup3(int oldfd, int newfd, int flags) {
     static int (*real_dup3)(int, int, int);
     if (!real_dup3) *(void **)&real_dup3 = dlsym(RTLD_NEXT, "dup3");
-    if (is_vfd(oldfd) || is_vfd(newfd)) {
-        errno = EBADF;
-        return -1;
+    if (is_vfd(oldfd)) {
+        if (oldfd == newfd) {
+            errno = EINVAL; /* dup3 rejects equal fds, unlike dup2 */
+            return -1;
+        }
+        return dup2(oldfd, newfd); /* CLOEXEC: vfds die at exec anyway */
     }
+    if (is_vfd(newfd)) close(newfd);
     int fd = real_dup3(oldfd, newfd, flags);
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
     if (fd >= 0 && g_ready) epoll_forget_fd(fd);

@@ -49,6 +49,7 @@ OP_SEM_INIT = 31
 OP_SEM_WAIT = 32
 OP_SEM_POST = 33
 OP_SEM_GET = 34
+OP_DUP = 35
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
@@ -59,6 +60,7 @@ OP_NAMES = {
     24: "thread-start", 25: "thread-exit", 26: "thread-join",
     27: "mutex-lock", 28: "mutex-unlock", 29: "cond-wait", 30: "cond-wake",
     31: "sem-init", 32: "sem-wait", 33: "sem-post", 34: "sem-get",
+    35: "dup",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)

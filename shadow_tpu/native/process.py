@@ -628,6 +628,8 @@ class ManagedApp:
                 self._op_sem_post(api, req)
             elif op == abi.OP_SEM_GET:
                 self._op_sem_get(api, req)
+            elif op == abi.OP_DUP:
+                self._op_dup(api, req)
             elif op == abi.OP_CLOSE:
                 self._op_close(api, req)
             else:
@@ -1289,13 +1291,14 @@ class ManagedApp:
         # stream consumes at most one payload per call (the caller loops)
         max_len = min(int(req.args[1]), abi.SHIM_PAYLOAD_MAX)
         nonblock = bool(req.args[2])
+        peek = bool(req.args[3])
         sock = self.sockets.get(vfd)
         if sock is None:
             self._reply(api, "recvfrom", -EBADF)
             return True
         if sock.kind == "udp":
             if sock.queue:
-                self._reply_udp_recv(api, vfd, max_len)
+                self._reply_udp_recv(api, vfd, max_len, peek)
                 return True
             if sock.recv_shut:
                 self._reply(api, "recvfrom", 0)  # SHUT_RD: EOF
@@ -1303,22 +1306,23 @@ class ManagedApp:
             if nonblock:
                 self._reply(api, "recvfrom", -EAGAIN)
                 return True
-            self._park(api, ("recvfrom", vfd, max_len), None)
+            self._park(api, ("recvfrom", vfd, max_len, peek), None)
             return False
         if sock.kind == "listen" or sock.sim is None:
             self._reply(api, "recvfrom", -ENOTCONN)
             return True
-        return self._stream_recv(api, vfd, max_len, nonblock)
+        return self._stream_recv(api, vfd, max_len, nonblock, peek)
 
     def _stream_recv(self, api: HostApi, vfd: int, max_len: int,
-                     nonblock: bool) -> bool:
+                     nonblock: bool, peek: bool = False) -> bool:
         sock = self.sockets[vfd]
         if max_len <= 0:  # POSIX: zero-length stream recv returns 0
             self._reply(api, "recv", 0)
             return True
-        data = sock.sim.recv(max_len)
+        data = sock.sim.peek(max_len) if peek else sock.sim.recv(max_len)
         if data:
-            api.count("managed_tcp_rx_bytes", len(data))
+            if not peek:
+                api.count("managed_tcp_rx_bytes", len(data))
             peer_ip = _u32be_to_shim_ip(sock.sim.tcp.remote_ip)
             self._reply(api, "recv", len(data),
                         args=[0, peer_ip, sock.sim.tcp.remote_port],
@@ -1334,16 +1338,21 @@ class ManagedApp:
         if nonblock:
             self._reply(api, "recv", -EAGAIN)
             return True
-        self._park(api, ("recv", vfd, max_len), None)
+        self._park(api, ("recv", vfd, max_len, peek), None)
         return False
 
-    def _reply_udp_recv(self, api: HostApi, vfd: int, max_len: int) -> None:
-        src_ip_be, src_port, data = self.sockets[vfd].queue.pop(0)
+    def _reply_udp_recv(self, api: HostApi, vfd: int, max_len: int,
+                        peek: bool = False) -> None:
+        queue = self.sockets[vfd].queue
+        src_ip_be, src_port, data = queue[0] if peek else queue.pop(0)
         # UDP truncation semantics: excess bytes of the datagram are
-        # discarded and the caller sees the truncated length
+        # discarded, the caller sees the truncated length, and recvmsg
+        # callers learn about it via MSG_TRUNC (reply args[3])
+        truncated = len(data) > max(max_len, 0)
         data = data[: max(max_len, 0)]
         self._reply(api, "recvfrom", len(data),
-                    args=[0, src_ip_be, src_port], payload=data)
+                    args=[0, src_ip_be, src_port, 1 if truncated else 0],
+                    payload=data)
 
     def _op_shutdown(self, api: HostApi, req) -> None:
         vfd, how = req.args[0], int(req.args[1])
@@ -1428,6 +1437,19 @@ class ManagedApp:
         else:
             n = 0
         self._reply(api, "fionread", 0, args=[0, n])
+
+    def _op_dup(self, api: HostApi, req) -> None:
+        """dup/dup2/dup3 of a simulated socket: the new fd number aliases
+        the same socket object, refcounted exactly like fork inheritance
+        (close() drops one reference)."""
+        old, new = int(req.args[0]), int(req.args[1])
+        sock = self.sockets.get(old)
+        if sock is None:
+            self._reply(api, "dup", -EBADF)
+            return
+        sock.refs += 1
+        self.sockets[new] = sock
+        self._reply(api, "dup", 0)
 
     def _op_close(self, api: HostApi, req) -> None:
         vfd = req.args[0]
@@ -1537,11 +1559,18 @@ class ManagedApp:
         for proc in list(self.procs):
             if proc.dead or proc.blocked is None:
                 continue
-            for vfd, s in proc.sockets.items():
-                if s is sock:
+            b = proc.blocked
+            # resolve the PARKED CALL's own fd: dup aliases mean several
+            # fd numbers can map to this socket, and only the one the call
+            # named may complete it
+            if b[0] in ("recvfrom", "recv", "send", "connect", "accept"):
+                if proc.sockets.get(b[1]) is sock:
                     self._cur = proc
-                    self._proc_socket_activity(api, proc, vfd)
-                    break
+                    self._proc_socket_activity(api, proc, b[1])
+            elif b[0] == "poll":
+                if any(proc.sockets.get(fd) is sock for fd, _ev in b[1]):
+                    self._cur = proc
+                    self._proc_socket_activity(api, proc, -1)
 
     def _proc_socket_activity(self, api: HostApi, proc: "_Proc", vfd: int) -> None:
         b = proc.blocked
@@ -1554,7 +1583,7 @@ class ManagedApp:
                 return
             if sock.queue:
                 self._blocked = None
-                self._reply_udp_recv(api, vfd, b[2])
+                self._reply_udp_recv(api, vfd, b[2], b[3])
                 self._service(api, proc)
             elif sock.recv_shut:
                 self._blocked = None
@@ -1564,11 +1593,14 @@ class ManagedApp:
             sock = self.sockets.get(vfd)
             if sock is None or sock.sim is None:
                 return
-            data = sock.sim.recv(max(b[2], 0))
+            peek = b[3]
+            data = (sock.sim.peek(max(b[2], 0)) if peek
+                    else sock.sim.recv(max(b[2], 0)))
             ps = sock.sim.poll()
             if data:
                 self._blocked = None
-                api.count("managed_tcp_rx_bytes", len(data))
+                if not peek:
+                    api.count("managed_tcp_rx_bytes", len(data))
                 peer_ip = _u32be_to_shim_ip(sock.sim.tcp.remote_ip)
                 self._reply(api, "recv", len(data),
                             args=[0, peer_ip, sock.sim.tcp.remote_port],

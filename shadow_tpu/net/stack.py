@@ -83,6 +83,10 @@ class SimTcpSocket:
             self.stack.flush_socket(self)  # window update may need to go out
         return out
 
+    def peek(self, max_len: int) -> bytes:
+        """MSG_PEEK: read without consuming (no window update)."""
+        return self.tcp.peek(max_len)
+
     def close(self) -> None:
         self.tcp.close(self.stack.host.now)
         self.stack.flush_socket(self)

@@ -289,6 +289,11 @@ class TcpState:
         """Bytes recv() would return right now (FIONREAD)."""
         return len(self._rcv_buf)
 
+    def peek(self, max_len: int) -> bytes:
+        """Read in-order received bytes without consuming them (MSG_PEEK:
+        no buffer drain, so no window update either)."""
+        return bytes(self._rcv_buf[:max_len])
+
     def recv(self, max_len: int) -> bytes:
         """Drain in-order received bytes (empty = would block or EOF;
         distinguish via poll())."""
