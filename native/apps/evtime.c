@@ -1,0 +1,138 @@
+/* evtime: timerfd + eventfd on the simulated clock (the reference's
+ * descriptor/timerfd.rs + eventfd.rs coverage, src/test/timerfd,
+ * src/test/eventfd).  All printed values derive from simulated time, so
+ * output is bit-identical run-to-run.
+ *
+ * modes:
+ *   evtime timer    one-shot + periodic expirations, coalescing, gettime,
+ *                   disarm, nonblocking EAGAIN
+ *   evtime epoll    epoll_wait readiness driven by a periodic timerfd
+ *   evtime event    eventfd handoff from a poster thread, semaphore mode,
+ *                   nonblocking EAGAIN when drained
+ */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/timerfd.h>
+#include <time.h>
+#include <unistd.h>
+
+static uint64_t now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000ull + (uint64_t)ts.tv_nsec / 1000000ull;
+}
+
+static int run_timer(void) {
+    int fd = timerfd_create(CLOCK_MONOTONIC, 0);
+    if (fd < 0) { perror("timerfd_create"); return 1; }
+    uint64_t t0 = now_ms();
+    /* itimerspec = {it_interval, it_value}: first tick 10ms, then 25ms */
+    struct itimerspec its = {{0, 25 * 1000000L}, {0, 10 * 1000000L}};
+    if (timerfd_settime(fd, 0, &its, NULL) != 0) {
+        perror("settime");
+        return 1;
+    }
+    uint64_t exp = 0, total = 0;
+    for (int i = 0; i < 3; i++) {
+        if (read(fd, &exp, 8) != 8) { perror("read"); return 1; }
+        total += exp;
+        printf("tick %d: expirations=%llu at_ms=%llu\n", i,
+               (unsigned long long)exp, (unsigned long long)(now_ms() - t0));
+    }
+    /* sleep past two expirations: the next read coalesces them */
+    struct timespec ns = {0, 30 * 1000000L};
+    nanosleep(&ns, NULL);
+    nanosleep(&ns, NULL);
+    if (read(fd, &exp, 8) != 8) { perror("read2"); return 1; }
+    printf("coalesced=%llu\n", (unsigned long long)exp);
+    struct itimerspec cur;
+    if (timerfd_gettime(fd, &cur) != 0) { perror("gettime"); return 1; }
+    printf("interval_ms=%ld armed=%d\n", cur.it_interval.tv_nsec / 1000000L,
+           cur.it_value.tv_sec > 0 || cur.it_value.tv_nsec > 0);
+    /* disarm, switch to nonblocking: read must EAGAIN */
+    struct itimerspec zero = {{0, 0}, {0, 0}};
+    timerfd_settime(fd, 0, &zero, NULL);
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    int r = (int)read(fd, &exp, 8);
+    printf("disarmed_read=%d eagain=%d\n", r, r < 0 && errno == EAGAIN);
+    close(fd);
+    return 0;
+}
+
+static int run_epoll(void) {
+    int fd = timerfd_create(CLOCK_MONOTONIC, 0);
+    struct itimerspec its = {{0, 20 * 1000000L}, {0, 20 * 1000000L}};
+    timerfd_settime(fd, 0, &its, NULL);
+    int ep = epoll_create1(0);
+    struct epoll_event ev = {EPOLLIN, {.fd = fd}};
+    epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
+    uint64_t t0 = now_ms();
+    for (int i = 0; i < 3; i++) {
+        struct epoll_event out[4];
+        int n = epoll_wait(ep, out, 4, 5000);
+        if (n != 1 || out[0].data.fd != fd) {
+            printf("epoll_wait bad n=%d\n", n);
+            return 1;
+        }
+        uint64_t exp;
+        (void)!read(fd, &exp, 8);
+        printf("epoll tick %d at_ms=%llu\n", i,
+               (unsigned long long)(now_ms() - t0));
+    }
+    close(ep);
+    close(fd);
+    return 0;
+}
+
+static void *poster(void *arg) {
+    int fd = *(int *)arg;
+    for (int i = 1; i <= 3; i++) {
+        usleep(5000);
+        eventfd_t v = (eventfd_t)i;
+        if (eventfd_write(fd, v) != 0) perror("eventfd_write");
+    }
+    return NULL;
+}
+
+static int run_event(void) {
+    int fd = eventfd(0, 0);
+    if (fd < 0) { perror("eventfd"); return 1; }
+    pthread_t th;
+    int arg = fd;
+    pthread_create(&th, NULL, poster, &arg);
+    uint64_t sum = 0;
+    eventfd_t v;
+    /* blocking reads park in simulated time until the poster writes;
+     * values may coalesce (1+2+3 arrive as >=1 reads summing to 6) */
+    while (sum < 6) {
+        if (eventfd_read(fd, &v) != 0) { perror("eventfd_read"); return 1; }
+        sum += v;
+    }
+    pthread_join(th, NULL);
+    printf("event sum=%llu\n", (unsigned long long)sum);
+    /* semaphore mode: each read takes exactly 1 */
+    int sfd = eventfd(3, EFD_SEMAPHORE | EFD_NONBLOCK);
+    int takes = 0;
+    while (eventfd_read(sfd, &v) == 0 && v == 1) takes++;
+    printf("sem takes=%d drained_eagain=%d\n", takes, errno == EAGAIN);
+    close(sfd);
+    close(fd);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IOLBF, 0);
+    if (argc >= 2 && strcmp(argv[1], "timer") == 0) return run_timer();
+    if (argc >= 2 && strcmp(argv[1], "epoll") == 0) return run_epoll();
+    if (argc >= 2 && strcmp(argv[1], "event") == 0) return run_event();
+    fprintf(stderr, "usage: evtime <timer|epoll|event>\n");
+    return 2;
+}

@@ -95,6 +95,19 @@ enum {
                                   both numbers now alias one socket
                                   (manager-side refcount, like fork
                                   inheritance) */
+    /* timerfd/eventfd on the SIMULATED clock (real ones tick wall time;
+     * the reference virtualizes both, descriptor/timerfd.rs, eventfd.rs).
+     * read/write/poll/close reuse the generic fd ops via kind dispatch. */
+    SHIM_OP_TIMERFD_CREATE = 36,  /* args[0]=reserved fd */
+    SHIM_OP_TIMERFD_SETTIME = 37, /* args[0]=fd args[1]=initial ns (REL,
+                                     the shim converts ABSTIME; 0=disarm)
+                                     args[2]=interval ns;
+                                     reply args[1]=old remaining
+                                     args[2]=old interval */
+    SHIM_OP_TIMERFD_GETTIME = 38, /* args[0]=fd; reply args[1]=remaining
+                                     args[2]=interval */
+    SHIM_OP_EVENTFD_CREATE = 39,  /* args[0]=reserved fd args[1]=initval
+                                     args[2]=EFD_SEMAPHORE(0|1) */
 };
 
 /* poll event bits (mirror Linux poll.h values) */

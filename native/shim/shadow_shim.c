@@ -1602,6 +1602,121 @@ int epoll_pwait(int epfd, struct epoll_event *events, int maxevents,
     return epoll_wait(epfd, events, maxevents, timeout);
 }
 
+/* ----------------------------------------------- timerfd / eventfd.
+ * Real timerfds tick WALL time — useless under a simulated clock — and a
+ * blocking eventfd read would stall the turn.  Both become manager-side
+ * virtual fds on the simulated clock (the reference's
+ * descriptor/timerfd.rs / eventfd.rs); read/write/poll/close reuse the
+ * generic fd ops via kind dispatch. */
+#include <sys/eventfd.h>
+#include <sys/timerfd.h>
+
+static int64_t ts_to_ns(const struct timespec *ts) {
+    return (int64_t)ts->tv_sec * 1000000000ll + ts->tv_nsec;
+}
+
+static void ns_to_ts(int64_t ns, struct timespec *ts) {
+    ts->tv_sec = ns / 1000000000ll;
+    ts->tv_nsec = ns % 1000000000ll;
+}
+
+int timerfd_create(int clockid, int flags) {
+    static int (*real_tfd)(int, int);
+    if (!real_tfd) *(void **)&real_tfd = dlsym(RTLD_NEXT, "timerfd_create");
+    if (!g_ready) return real_tfd(clockid, flags);
+    (void)clockid; /* every clock is the one simulated clock */
+    int fd = reserve_fd();
+    if (fd < 0) return -1;
+    int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+    int64_t ret =
+        shim_call(SHIM_OP_TIMERFD_CREATE, args, NULL, 0, NULL, NULL, NULL);
+    if (ret < 0) {
+        real_close(fd);
+        errno = (int)-ret;
+        return -1;
+    }
+    vfd_register(fd, (flags & TFD_NONBLOCK) != 0, 0);
+    return fd;
+}
+
+int timerfd_settime(int fd, int flags, const struct itimerspec *new_value,
+                    struct itimerspec *old_value) {
+    static int (*real_set)(int, int, const struct itimerspec *,
+                           struct itimerspec *);
+    if (!real_set) *(void **)&real_set = dlsym(RTLD_NEXT, "timerfd_settime");
+    if (!is_vfd(fd)) return real_set(fd, flags, new_value, old_value);
+    if (!new_value) {
+        errno = EFAULT;
+        return -1;
+    }
+    int64_t initial = ts_to_ns(&new_value->it_value);
+    if (initial && (flags & TFD_TIMER_ABSTIME)) {
+        initial -= (int64_t)sim_now_ns(); /* manager takes relative ns */
+        if (initial <= 0) initial = 1;    /* already due: fire at once */
+    }
+    int64_t args[6] = {fd, initial, ts_to_ns(&new_value->it_interval),
+                       0, 0, 0};
+    int64_t reply[6];
+    int64_t ret =
+        shim_call(SHIM_OP_TIMERFD_SETTIME, args, NULL, 0, NULL, NULL, reply);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    if (old_value) {
+        ns_to_ts(reply[1], &old_value->it_value);
+        ns_to_ts(reply[2], &old_value->it_interval);
+    }
+    return 0;
+}
+
+int timerfd_gettime(int fd, struct itimerspec *curr) {
+    static int (*real_get)(int, struct itimerspec *);
+    if (!real_get) *(void **)&real_get = dlsym(RTLD_NEXT, "timerfd_gettime");
+    if (!is_vfd(fd)) return real_get(fd, curr);
+    int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+    int64_t reply[6];
+    int64_t ret =
+        shim_call(SHIM_OP_TIMERFD_GETTIME, args, NULL, 0, NULL, NULL, reply);
+    if (ret < 0) {
+        errno = (int)-ret;
+        return -1;
+    }
+    if (curr) {
+        ns_to_ts(reply[1], &curr->it_value);
+        ns_to_ts(reply[2], &curr->it_interval);
+    }
+    return 0;
+}
+
+int eventfd(unsigned int initval, int flags) {
+    static int (*real_efd)(unsigned int, int);
+    if (!real_efd) *(void **)&real_efd = dlsym(RTLD_NEXT, "eventfd");
+    if (!g_ready) return real_efd(initval, flags);
+    int fd = reserve_fd();
+    if (fd < 0) return -1;
+    int64_t args[6] = {fd, initval, (flags & EFD_SEMAPHORE) != 0, 0, 0, 0};
+    int64_t ret =
+        shim_call(SHIM_OP_EVENTFD_CREATE, args, NULL, 0, NULL, NULL, NULL);
+    if (ret < 0) {
+        real_close(fd);
+        errno = (int)-ret;
+        return -1;
+    }
+    vfd_register(fd, (flags & EFD_NONBLOCK) != 0, 0);
+    return fd;
+}
+
+/* glibc's helpers resolve read/write internally; route them through the
+ * interposed fd ops so simulated eventfds work */
+int eventfd_read(int fd, eventfd_t *value) {
+    return read(fd, value, sizeof(*value)) == sizeof(*value) ? 0 : -1;
+}
+
+int eventfd_write(int fd, eventfd_t value) {
+    return write(fd, &value, sizeof(value)) == sizeof(value) ? 0 : -1;
+}
+
 /* ----------------------------------------------------- name resolution */
 
 /* getaddrinfo against the simulation's hosts file — the reference
@@ -2409,13 +2524,18 @@ int dup2(int oldfd, int newfd) {
         close(newfd); /* interposed: handles sim and real targets alike */
         /* occupy newfd with an O_PATH reservation at that exact number;
          * keep it CLOEXEC so the stub cannot leak into an exec'd image
-         * (simulated sockets never survive exec anyway) */
+         * (simulated sockets never survive exec anyway).  newfd is free
+         * now, so open() may hand back newfd ITSELF — then the
+         * reservation is already in place and dup2/close would destroy
+         * it (dup2(fd,fd) is a no-op, the close frees the number) */
         int tmp = open("/dev/null", O_PATH | O_CLOEXEC);
         if (tmp < 0) return -1;
-        int r = real_dup2(tmp, newfd);
-        real_close(tmp);
-        if (r < 0) return -1;
-        real_fcntl(newfd, F_SETFD, FD_CLOEXEC);
+        if (tmp != newfd) {
+            int r = real_dup2(tmp, newfd);
+            real_close(tmp);
+            if (r < 0) return -1;
+            real_fcntl(newfd, F_SETFD, FD_CLOEXEC);
+        }
         return vfd_dup_common(oldfd, newfd);
     }
     if (is_vfd(newfd)) close(newfd); /* real replaces a simulated socket */

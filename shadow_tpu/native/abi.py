@@ -50,6 +50,10 @@ OP_SEM_WAIT = 32
 OP_SEM_POST = 33
 OP_SEM_GET = 34
 OP_DUP = 35
+OP_TIMERFD_CREATE = 36
+OP_TIMERFD_SETTIME = 37
+OP_TIMERFD_GETTIME = 38
+OP_EVENTFD_CREATE = 39
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
@@ -60,7 +64,8 @@ OP_NAMES = {
     24: "thread-start", 25: "thread-exit", 26: "thread-join",
     27: "mutex-lock", 28: "mutex-unlock", 29: "cond-wait", 30: "cond-wake",
     31: "sem-init", 32: "sem-wait", 33: "sem-post", 34: "sem-get",
-    35: "dup",
+    35: "dup", 36: "timerfd-create", 37: "timerfd-settime",
+    38: "timerfd-gettime", 39: "eventfd-create",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)

@@ -58,7 +58,7 @@ MAX_UNAPPLIED_LATENCY_NS = 100_000  # forced yield every ~100 calls
 from errno import (  # noqa: E402
     EADDRINUSE, EAGAIN, EALREADY, EBADF, EBUSY, ECHILD, ECONNREFUSED,
     ECONNRESET, EDEADLK, EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINVAL,
-    EISCONN, ENOSYS, ENOTCONN, EPIPE, ESRCH, ETIMEDOUT,
+    EISCONN, ENOSYS, ENOTCONN, ENOTSOCK, EPIPE, ESRCH, ETIMEDOUT,
 )
 
 
@@ -94,17 +94,22 @@ def require_dynamic_elf(path: str) -> None:
     )
 
 
+EVENTFD_MAX = 0xFFFFFFFFFFFFFFFE  # Linux: counter saturates at 2^64 - 2
+
+
 class _VSocket:
-    """One virtual socket of a managed process (fd number chosen by the
-    shim — a reserved real kernel fd, so it can't collide in the plugin)."""
+    """One virtual fd of a managed process (fd number chosen by the
+    shim — a reserved real kernel fd, so it can't collide in the plugin).
+    Besides sockets this also models virtual timerfds and eventfds."""
 
     __slots__ = ("vfd", "kind", "port", "default_dst", "queue", "sim",
-                 "listener", "accept_q", "recv_shut", "refs")
+                 "listener", "accept_q", "recv_shut", "refs",
+                 "count", "t_next", "t_interval", "t_gen", "e_sem")
 
     def __init__(self, vfd: int, kind: str) -> None:
         self.refs = 1  # fork shares the socket across processes
         self.vfd = vfd
-        self.kind = kind  # "udp" | "tcp" | "listen"
+        self.kind = kind  # "udp" | "tcp" | "listen" | "timer" | "event"
         self.port: Optional[int] = None
         self.default_dst: Optional[tuple[int, int]] = None  # (ip_be, port)
         self.queue: list[tuple[int, int, bytes]] = []  # udp: (src_ip_be, src_port, data)
@@ -112,6 +117,12 @@ class _VSocket:
         self.listener = None  # SimTcpListener (listen)
         self.accept_q: list = []  # SimTcpSockets awaiting accept()
         self.recv_shut = False  # SHUT_RD: reads return EOF / accept EINVAL
+        # timer: expirations since last read/settime; event: the counter
+        self.count = 0
+        self.t_next: Optional[int] = None  # next expiry (sim ns)
+        self.t_interval = 0  # re-arm period, 0 = one-shot
+        self.t_gen = 0  # settime/close generation: cancels stale fires
+        self.e_sem = False  # EFD_SEMAPHORE mode
 
 
 class _Proc:
@@ -630,6 +641,20 @@ class ManagedApp:
                 self._op_sem_get(api, req)
             elif op == abi.OP_DUP:
                 self._op_dup(api, req)
+            elif op == abi.OP_TIMERFD_CREATE:
+                self.sockets[int(req.args[0])] = _VSocket(
+                    int(req.args[0]), "timer")
+                self._reply(api, "timerfd-create", 0)
+            elif op == abi.OP_TIMERFD_SETTIME:
+                self._op_timerfd_settime(api, req)
+            elif op == abi.OP_TIMERFD_GETTIME:
+                self._op_timerfd_gettime(api, req)
+            elif op == abi.OP_EVENTFD_CREATE:
+                ev = _VSocket(int(req.args[0]), "event")
+                ev.count = int(req.args[1])
+                ev.e_sem = bool(req.args[2])
+                self.sockets[int(req.args[0])] = ev
+                self._reply(api, "eventfd-create", 0)
             elif op == abi.OP_CLOSE:
                 self._op_close(api, req)
             else:
@@ -1119,6 +1144,9 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "bind", -EBADF)
             return
+        if sock.kind in ("timer", "event"):
+            self._reply(api, "bind", -ENOTSOCK)
+            return
         if sock.kind == "udp":
             ports = self._host_ports(api)
             if port == 0:
@@ -1138,8 +1166,10 @@ class ManagedApp:
     def _op_listen(self, api: HostApi, req) -> None:
         vfd, backlog = req.args[0], int(req.args[1])
         sock = self.sockets.get(vfd)
-        if sock is None or sock.kind == "udp":
-            self._reply(api, "listen", -EBADF if sock is None else -EINVAL)
+        if sock is None or sock.kind in ("udp", "timer", "event"):
+            self._reply(api, "listen",
+                        -EBADF if sock is None else
+                        -EINVAL if sock.kind == "udp" else -ENOTSOCK)
             return
         if sock.kind == "listen":
             self._reply(api, "listen", 0)  # already listening
@@ -1161,6 +1191,9 @@ class ManagedApp:
         sock = self.sockets.get(vfd)
         if sock is None:
             self._reply(api, "connect", -EBADF)
+            return True
+        if sock.kind in ("timer", "event"):
+            self._reply(api, "connect", -ENOTSOCK)
             return True
         ip_be = int(req.args[1]) & 0xFFFFFFFF
         port = int(req.args[2])
@@ -1232,6 +1265,11 @@ class ManagedApp:
             self._reply(api, "sendto", -EBADF)
             return True
         data = self.chan.req_payload()
+        if sock.kind == "event":
+            return self._event_write(api, sock, data, bool(req.args[3]), vfd)
+        if sock.kind == "timer":
+            self._reply(api, "write", -EINVAL)  # timerfds are read-only
+            return True
         if sock.kind == "udp":
             self._udp_send(api, sock, req, data)
             return True
@@ -1296,6 +1334,8 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "recvfrom", -EBADF)
             return True
+        if sock.kind in ("timer", "event"):
+            return self._counter_read(api, sock, max_len, nonblock, vfd)
         if sock.kind == "udp":
             if sock.queue:
                 self._reply_udp_recv(api, vfd, max_len, peek)
@@ -1360,6 +1400,9 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "shutdown", -EBADF)
             return
+        if sock.kind in ("timer", "event"):
+            self._reply(api, "shutdown", -ENOTSOCK)
+            return
         if sock.kind == "udp":
             if sock.default_dst is None:
                 self._reply(api, "shutdown", -ENOTCONN)
@@ -1394,6 +1437,9 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "getsockname", -EBADF)
             return
+        if sock.kind in ("timer", "event"):
+            self._reply(api, "getsockname", -ENOTSOCK)
+            return
         ip_be = _ip_to_be(api.ip_of(api.host_id))
         port = sock.port or 0
         if sock.kind == "tcp" and sock.sim is not None:
@@ -1404,6 +1450,9 @@ class ManagedApp:
         sock = self.sockets.get(req.args[0])
         if sock is None:
             self._reply(api, "getpeername", -EBADF)
+            return
+        if sock.kind in ("timer", "event"):
+            self._reply(api, "getpeername", -ENOTSOCK)
             return
         if sock.kind == "tcp" and sock.sim is not None:
             self._reply(api, "getpeername", 0,
@@ -1420,6 +1469,9 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "sockerr", -EBADF)
             return
+        if sock.kind in ("timer", "event"):
+            self._reply(api, "sockerr", -ENOTSOCK)
+            return
         err = 0
         if sock.kind == "tcp" and sock.sim is not None:
             err = _tcp_errno(sock.sim.tcp)
@@ -1434,6 +1486,9 @@ class ManagedApp:
             n = len(sock.queue[0][2]) if sock.queue else 0
         elif sock.kind == "tcp" and sock.sim is not None:
             n = sock.sim.tcp.available()
+        elif sock.kind in ("timer", "event"):
+            self._reply(api, "fionread", -EINVAL)  # Linux rejects FIONREAD here
+            return
         else:
             n = 0
         self._reply(api, "fionread", 0, args=[0, n])
@@ -1451,6 +1506,100 @@ class ManagedApp:
         self.sockets[new] = sock
         self._reply(api, "dup", 0)
 
+    # -- timerfd / eventfd (simulated-clock virtual fds) -------------------
+
+    def _op_timerfd_settime(self, api: HostApi, req) -> None:
+        sock = self.sockets.get(int(req.args[0]))
+        if sock is None or sock.kind != "timer":
+            self._reply(api, "timerfd-settime", -EINVAL)
+            return
+        initial = int(req.args[1])  # relative ns; 0 = disarm
+        interval = int(req.args[2])
+        old_rem = max(sock.t_next - api.now, 0) if sock.t_next else 0
+        old_int = sock.t_interval
+        sock.t_gen += 1
+        sock.count = 0  # Linux: settime resets the expiration counter
+        if initial > 0:
+            sock.t_next = api.now + initial
+            sock.t_interval = max(interval, 0)
+            gen = sock.t_gen
+            api.schedule_at(sock.t_next,
+                            lambda h, s=sock, g=gen: self._timer_fire(h, s, g))
+        else:
+            sock.t_next = None
+            sock.t_interval = 0
+        self._reply(api, "timerfd-settime", 0, args=[0, old_rem, old_int])
+
+    def _timer_fire(self, api, sock: _VSocket, gen: int) -> None:
+        """A timerfd expiry event (engine-scheduled on the simulated
+        clock); stale fires are cancelled by the generation counter."""
+        if self.finished or sock.t_gen != gen or sock.refs <= 0:
+            return
+        sock.count += 1
+        if sock.t_interval > 0:
+            sock.t_next = api.now + sock.t_interval
+            api.schedule_at(sock.t_next,
+                            lambda h, s=sock, g=gen: self._timer_fire(h, s, g))
+        else:
+            sock.t_next = None
+        self._socket_activity_obj(api, sock)
+
+    def _op_timerfd_gettime(self, api: HostApi, req) -> None:
+        sock = self.sockets.get(int(req.args[0]))
+        if sock is None or sock.kind != "timer":
+            self._reply(api, "timerfd-gettime", -EINVAL)
+            return
+        rem = max(sock.t_next - api.now, 0) if sock.t_next else 0
+        self._reply(api, "timerfd-gettime", 0, args=[0, rem, sock.t_interval])
+
+    def _counter_read(self, api: HostApi, sock: _VSocket, max_len: int,
+                      nonblock: bool, vfd: int) -> bool:
+        """read() on a timerfd/eventfd: an 8-byte counter value."""
+        if max_len < 8:
+            self._reply(api, "read", -EINVAL)
+            return True
+        if sock.count > 0:
+            self._reply_counter(api, sock)
+            return True
+        if nonblock:
+            self._reply(api, "read", -EAGAIN)
+            return True
+        self._park(api, ("recvfrom", vfd, max_len, False), None)
+        return False
+
+    def _reply_counter(self, api: HostApi, sock: _VSocket) -> None:
+        if sock.kind == "event" and sock.e_sem:
+            value = 1
+            sock.count -= 1
+        else:
+            value = sock.count
+            sock.count = 0
+        self._reply(api, "read", 8, payload=value.to_bytes(8, "little"))
+        if sock.kind == "event":
+            # room opened up: wake a writer parked on overflow
+            self._socket_activity_obj(api, sock)
+
+    def _event_write(self, api: HostApi, sock: _VSocket, data: bytes,
+                     nonblock: bool, vfd: int) -> bool:
+        if len(data) != 8:
+            self._reply(api, "write", -EINVAL)
+            return True
+        value = int.from_bytes(data, "little")
+        if value == 0xFFFFFFFFFFFFFFFF:
+            self._reply(api, "write", -EINVAL)
+            return True
+        if sock.count + value > EVENTFD_MAX:
+            if nonblock:
+                self._reply(api, "write", -EAGAIN)
+                return True
+            self._park(api, ("send", vfd, data, 8), None)
+            return False
+        sock.count += value
+        self._reply(api, "write", 8)
+        if value:
+            self._socket_activity_obj(api, sock)  # wake parked readers
+        return True
+
     def _op_close(self, api: HostApi, req) -> None:
         vfd = req.args[0]
         sock = self.sockets.pop(vfd, None)
@@ -1461,6 +1610,9 @@ class ManagedApp:
         self._reply(api, "close", 0)
 
     def _teardown_vsocket(self, api, sock: _VSocket) -> None:
+        if sock.kind in ("timer", "event"):
+            sock.t_gen += 1  # cancels any scheduled fire
+            return
         if sock.kind == "udp":
             if sock.port is not None:
                 self._host_ports(api).pop(sock.port, None)
@@ -1501,7 +1653,15 @@ class ManagedApp:
         if sock is None:
             return abi.POLLNVAL
         ready = 0
-        if sock.kind == "udp":
+        if sock.kind == "timer":
+            if sock.count > 0:
+                ready |= abi.POLLIN
+        elif sock.kind == "event":
+            if sock.count > 0:
+                ready |= abi.POLLIN
+            if sock.count < EVENTFD_MAX:
+                ready |= abi.POLLOUT
+        elif sock.kind == "udp":
             if sock.queue or sock.recv_shut:
                 ready |= abi.POLLIN
             ready |= abi.POLLOUT
@@ -1581,6 +1741,12 @@ class ManagedApp:
             sock = self.sockets.get(vfd)
             if sock is None:
                 return
+            if sock.kind in ("timer", "event"):
+                if sock.count > 0:
+                    self._blocked = None
+                    self._reply_counter(api, sock)
+                    self._service(api, proc)
+                return
             if sock.queue:
                 self._blocked = None
                 self._reply_udp_recv(api, vfd, b[2], b[3])
@@ -1616,7 +1782,19 @@ class ManagedApp:
                 self._service(api, proc)
         elif kind == "send" and b[1] == vfd:
             sock = self.sockets.get(vfd)
-            if sock is None or sock.sim is None:
+            if sock is None:
+                return
+            if sock.kind == "event":
+                value = int.from_bytes(b[2], "little")
+                if sock.count + value <= EVENTFD_MAX:
+                    self._blocked = None
+                    sock.count += value
+                    self._reply(api, "write", 8)
+                    if value:
+                        self._socket_activity_obj(api, sock)
+                    self._service(api, proc)
+                return
+            if sock.sim is None:
                 return
             ps = sock.sim.poll()
             if ps & PollState.ERROR:
