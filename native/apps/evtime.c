@@ -92,6 +92,37 @@ static int run_epoll(void) {
     return 0;
 }
 
+static int run_abstime(void) {
+    /* overdue TFD_TIMER_ABSTIME: missed expirations readable at once,
+     * later ticks stay on the ABSOLUTE it_value + k*interval grid */
+    struct timespec now;
+    clock_gettime(CLOCK_REALTIME, &now);
+    int fd = timerfd_create(CLOCK_REALTIME, 0);
+    struct itimerspec its;
+    its.it_interval.tv_sec = 0;
+    its.it_interval.tv_nsec = 10 * 1000000L; /* 10ms grid */
+    its.it_value = now;
+    its.it_value.tv_nsec -= 25 * 1000000L; /* 25ms in the past */
+    if (its.it_value.tv_nsec < 0) {
+        its.it_value.tv_sec -= 1;
+        its.it_value.tv_nsec += 1000000000L;
+    }
+    if (timerfd_settime(fd, TFD_TIMER_ABSTIME, &its, NULL) != 0) {
+        perror("settime abs");
+        return 1;
+    }
+    uint64_t t0 = now_ms();
+    uint64_t exp = 0;
+    (void)!read(fd, &exp, 8); /* missed: -25,-15,-5 => 3 */
+    printf("overdue=%llu read_at_ms=%llu\n", (unsigned long long)exp,
+           (unsigned long long)(now_ms() - t0));
+    (void)!read(fd, &exp, 8); /* next grid point: +5ms */
+    printf("next=%llu at_ms=%llu\n", (unsigned long long)exp,
+           (unsigned long long)(now_ms() - t0));
+    close(fd);
+    return 0;
+}
+
 static void *poster(void *arg) {
     int fd = *(int *)arg;
     for (int i = 1; i <= 3; i++) {
@@ -131,6 +162,7 @@ static int run_event(void) {
 int main(int argc, char **argv) {
     setvbuf(stdout, NULL, _IOLBF, 0);
     if (argc >= 2 && strcmp(argv[1], "timer") == 0) return run_timer();
+    if (argc >= 2 && strcmp(argv[1], "abstime") == 0) return run_abstime();
     if (argc >= 2 && strcmp(argv[1], "epoll") == 0) return run_epoll();
     if (argc >= 2 && strcmp(argv[1], "event") == 0) return run_event();
     fprintf(stderr, "usage: evtime <timer|epoll|event>\n");

@@ -64,10 +64,44 @@ static int run_vdso(void) {
     return 0;
 }
 
+#include <arpa/inet.h>
+#include <ifaddrs.h>
+#include <net/if.h>
+#include <netinet/in.h>
+
+static int run_ifaddrs(void) {
+    struct ifaddrs *ifa0;
+    if (getifaddrs(&ifa0) != 0) {
+        perror("getifaddrs");
+        return 1;
+    }
+    for (struct ifaddrs *i = ifa0; i; i = i->ifa_next) {
+        char addr[32] = "-", mask[32] = "-";
+        if (i->ifa_addr && i->ifa_addr->sa_family == AF_INET)
+            inet_ntop(AF_INET,
+                      &((struct sockaddr_in *)i->ifa_addr)->sin_addr, addr,
+                      sizeof addr);
+        if (i->ifa_netmask && i->ifa_netmask->sa_family == AF_INET)
+            inet_ntop(AF_INET,
+                      &((struct sockaddr_in *)i->ifa_netmask)->sin_addr, mask,
+                      sizeof mask);
+        printf("if %s addr=%s mask=%s loop=%d up=%d\n", i->ifa_name, addr,
+               mask, (i->ifa_flags & IFF_LOOPBACK) != 0,
+               (i->ifa_flags & IFF_UP) != 0);
+    }
+    freeifaddrs(ifa0);
+    char name[IF_NAMESIZE];
+    printf("idx eth0=%u lo=%u name2=%s\n", if_nametoindex("eth0"),
+           if_nametoindex("lo"),
+           if_indextoname(2, name) ? name : "?");
+    return 0;
+}
+
 int main(int argc, char **argv) {
     setvbuf(stdout, NULL, _IOLBF, 0);
     if (argc >= 2 && strcmp(argv[1], "raw") == 0) return run_raw();
     if (argc >= 2 && strcmp(argv[1], "vdso") == 0) return run_vdso();
-    fprintf(stderr, "usage: rawsys <raw|vdso>\n");
+    if (argc >= 2 && strcmp(argv[1], "ifaddrs") == 0) return run_ifaddrs();
+    fprintf(stderr, "usage: rawsys <raw|vdso|ifaddrs>\n");
     return 2;
 }

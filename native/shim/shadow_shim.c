@@ -1650,12 +1650,16 @@ int timerfd_settime(int fd, int flags, const struct itimerspec *new_value,
         return -1;
     }
     int64_t initial = ts_to_ns(&new_value->it_value);
+    int is_abs = 0;
     if (initial && (flags & TFD_TIMER_ABSTIME)) {
-        initial -= (int64_t)sim_now_ns(); /* manager takes relative ns */
-        if (initial <= 0) initial = 1;    /* already due: fire at once */
+        /* manager takes relative ns; an overdue value may go <= 0 — the
+         * manager then counts the missed expirations and keeps later
+         * ticks on the absolute grid, as Linux does */
+        initial -= (int64_t)sim_now_ns();
+        is_abs = 1;
     }
     int64_t args[6] = {fd, initial, ts_to_ns(&new_value->it_interval),
-                       0, 0, 0};
+                       is_abs, 0, 0};
     int64_t reply[6];
     int64_t ret =
         shim_call(SHIM_OP_TIMERFD_SETTIME, args, NULL, 0, NULL, NULL, reply);
@@ -1847,6 +1851,93 @@ struct hostent *gethostbyname(const char *name) {
     he.h_length = sizeof(struct in_addr);
     he.h_addr_list = addr_list;
     return &he;
+}
+
+/* Interface enumeration: apps must see the SIMULATED interfaces (lo +
+ * eth0 with the host's simulated IP), not the real machine's — the
+ * reference answers these via its netlink socket emulation
+ * (descriptor/socket/netlink.rs) and getifaddrs preload
+ * (preload-libc ifaddrs wrappers). */
+#include <ifaddrs.h>
+#include <net/if.h>
+
+typedef struct {
+    struct ifaddrs ifa[2];
+    struct sockaddr_in addrs[6]; /* (addr, netmask, broadcast) x 2 */
+    char names[2][8];
+} shim_ifaddrs_blob;
+
+static void fill_sin(struct sockaddr_in *sin, uint32_t ip_be) {
+    memset(sin, 0, sizeof(*sin));
+    sin->sin_family = AF_INET;
+    sin->sin_addr.s_addr = ip_be;
+}
+
+int getifaddrs(struct ifaddrs **ifap) {
+    static int (*real_gifa)(struct ifaddrs **);
+    if (!real_gifa) *(void **)&real_gifa = dlsym(RTLD_NEXT, "getifaddrs");
+    if (!g_ready) return real_gifa(ifap);
+    uint32_t ip = 0;
+    const char *hn = getenv("SHADOW_TPU_HOSTNAME");
+    int have_ip = hn && hosts_lookup(hn, &ip) == 0;
+    shim_ifaddrs_blob *b = calloc(1, sizeof(*b));
+    if (!b) {
+        errno = ENOMEM;
+        return -1;
+    }
+    uint32_t mask = htonl(0xFF000000u); /* /8, the 11.0.0.0/8 assignment */
+    strcpy(b->names[0], "lo");
+    b->ifa[0].ifa_name = b->names[0];
+    b->ifa[0].ifa_flags = IFF_UP | IFF_RUNNING | IFF_LOOPBACK;
+    fill_sin(&b->addrs[0], htonl(INADDR_LOOPBACK));
+    fill_sin(&b->addrs[1], mask);
+    b->ifa[0].ifa_addr = (struct sockaddr *)&b->addrs[0];
+    b->ifa[0].ifa_netmask = (struct sockaddr *)&b->addrs[1];
+    if (have_ip) {
+        b->ifa[0].ifa_next = &b->ifa[1];
+        strcpy(b->names[1], "eth0");
+        b->ifa[1].ifa_name = b->names[1];
+        b->ifa[1].ifa_flags =
+            IFF_UP | IFF_RUNNING | IFF_BROADCAST | IFF_MULTICAST;
+        fill_sin(&b->addrs[2], ip);
+        fill_sin(&b->addrs[3], mask);
+        fill_sin(&b->addrs[4], ip | ~mask);
+        b->ifa[1].ifa_addr = (struct sockaddr *)&b->addrs[2];
+        b->ifa[1].ifa_netmask = (struct sockaddr *)&b->addrs[3];
+        b->ifa[1].ifa_broadaddr = (struct sockaddr *)&b->addrs[4];
+    }
+    *ifap = &b->ifa[0];
+    return 0;
+}
+
+void freeifaddrs(struct ifaddrs *ifa) {
+    static void (*real_fifa)(struct ifaddrs *);
+    if (!real_fifa) *(void **)&real_fifa = dlsym(RTLD_NEXT, "freeifaddrs");
+    if (!g_ready) {
+        real_fifa(ifa);
+        return;
+    }
+    free(ifa); /* the blob starts at ifa[0] */
+}
+
+unsigned int if_nametoindex(const char *name) {
+    static unsigned int (*real_nti)(const char *);
+    if (!real_nti) *(void **)&real_nti = dlsym(RTLD_NEXT, "if_nametoindex");
+    if (!g_ready) return real_nti(name);
+    if (strcmp(name, "lo") == 0) return 1;
+    if (strcmp(name, "eth0") == 0) return 2;
+    errno = ENODEV;
+    return 0;
+}
+
+char *if_indextoname(unsigned int ifindex, char ifname[IF_NAMESIZE]) {
+    static char *(*real_itn)(unsigned int, char *);
+    if (!real_itn) *(void **)&real_itn = dlsym(RTLD_NEXT, "if_indextoname");
+    if (!g_ready) return real_itn(ifindex, ifname);
+    if (ifindex == 1) return strcpy(ifname, "lo");
+    if (ifindex == 2) return strcpy(ifname, "eth0");
+    errno = ENXIO;
+    return NULL;
 }
 
 /* the local hostname is the simulated one */

@@ -798,13 +798,16 @@ def _append_log(p: LaneParams, s: LaneState, recs: dict) -> LaneState:
     )
 
 
-def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
-    """Build the raw (un-jitted) one-round advance: state -> (state, done).
+def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
+    """Build the raw one-ITERATION advance (pop ≤K, process, merge) against
+    the window already in ``state.now_window_end``.  The step driver wraps
+    it in a per-round while (window fixed across iterations); the fused
+    full run folds the window advance into a single flat loop.
 
-    ``guard_done=True`` (the step driver) preserves the pre-round state when
-    the simulation already finished — a full-state ``where``.  The fused
-    full-run loop terminates via its own ``cond`` instead and skips that
-    copy (``guard_done=False``)."""
+    ``pure_dataflow=True`` (the fused device run) removes every
+    ``lax.cond`` skip path: device control flow costs a host round-trip
+    per decision on the tunneled runtime, so unconditional masked work is
+    faster there.  The step driver keeps the skips — on CPU they pay."""
 
     k = p.pops_per_iter
 
@@ -845,8 +848,16 @@ def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
             )
         )
 
+        # the stream tier's slot body is large: inlining it per slot blows
+        # up XLA compile time, so slot-level conds stay when it's present
+        slot_dataflow = pure_dataflow and not p.stream_present
+
         def scan_body(carry, slot_cols):
             st = carry
+            if slot_dataflow:
+                # _process_slot is fully masked by `act`: unconditional
+                # masked work beats a control decision on the device
+                return _process_slot(p, tb, st, slot_cols, window_end)
 
             def live(st_):
                 return _process_slot(p, tb, st_, slot_cols, window_end)
@@ -863,33 +874,38 @@ def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
                     nb, z64, z64, z64, z64, z64, z64,
                 )
 
-            st, emit = lax.cond(jnp.any(slot_cols["act"]), live, dead, st)
-            return st, emit
+            return lax.cond(jnp.any(slot_cols["act"]), live, dead, st)
 
         slots = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), popped)  # [K, N]
         # full unroll: K is small and static; unrolling removes the scan
         # loop's per-step kernel boundaries so XLA fuses across slots
         s, emits = lax.scan(scan_body, s, slots, unroll=k)
 
-        # the merge (exchange + wide row sort) is the expensive step; on
-        # iterations that generated no events (e.g. windows that only pop
-        # deliveries) a plain row re-sort restores the sorted invariant the
-        # consumed->NEVER holes in the first K columns just broke
-        any_new = (
-            jnp.any(emits.ins_valid)
-            | jnp.any(emits.arm_valid)
-            | jnp.any(emits.arm2_valid)
-            | jnp.any(emits.out_valid)
-        )
+        if pure_dataflow:
+            # always merge: a merge whose insert channels are all empty
+            # reduces to the row re-sort that restores the sorted
+            # invariant, so one unconditional path replaces the cond
+            s, over_rec = _merge_append(p, s, emits)
+            s = _append_log(p, s, over_rec)
+        else:
+            # the merge (exchange + wide row sort) is the expensive step;
+            # iterations that generated nothing only need the invariant
+            # restored after the consumed->NEVER holes
+            any_new = (
+                jnp.any(emits.ins_valid)
+                | jnp.any(emits.arm_valid)
+                | jnp.any(emits.arm2_valid)
+                | jnp.any(emits.out_valid)
+            )
 
-        def do_merge(st: LaneState) -> LaneState:
-            st, over_rec = _merge_append(p, st, emits)
-            return _append_log(p, st, over_rec)
+            def do_merge(st: LaneState) -> LaneState:
+                st, over_rec = _merge_append(p, st, emits)
+                return _append_log(p, st, over_rec)
 
-        def do_sort(st: LaneState) -> LaneState:
-            return _sort_queues(st, with_pay=p.stream_present)
+            def do_sort(st: LaneState) -> LaneState:
+                return _sort_queues(st, with_pay=p.stream_present)
 
-        s = lax.cond(any_new, do_merge, do_sort, s)
+            s = lax.cond(any_new, do_merge, do_sort, s)
 
         per_slot = {
             "valid": emits.rec_valid.reshape(-1),
@@ -902,6 +918,16 @@ def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
         }
         s = _append_log(p, s, per_slot)
         return s
+
+    return iter_body
+
+
+def _build_round(p: LaneParams, tb: LaneTables):
+    """Build the raw (un-jitted) one-round advance: state -> (state, done)
+    for the STEP driver.  Preserves the pre-round state when the
+    simulation already finished (a full-state ``where``); the fused full
+    run uses ``_build_iter`` directly instead."""
+    iter_body = _build_iter(p, tb)
 
     def round_fn(s: LaneState) -> tuple[LaneState, jnp.ndarray]:
         start = jnp.min(s.q_time[:, 0])  # rows sorted: col 0 is the min
@@ -917,9 +943,8 @@ def _build_round(p: LaneParams, tb: LaneTables, guard_done: bool = True):
 
         s2 = lax.while_loop(cond, body, s)
         s2 = s2._replace(rounds=s2.rounds + 1)
-        if guard_done:
-            # keep the pre-round state when already done
-            s2 = jax.tree.map(lambda a, b: jnp.where(done, a, b), s, s2)
+        # keep the pre-round state when already done
+        s2 = jax.tree.map(lambda a, b: jnp.where(done, a, b), s, s2)
         return s2, done
 
     return round_fn
@@ -932,18 +957,36 @@ def make_round_fn(p: LaneParams, tb: LaneTables):
 
 
 def _build_full_run(p: LaneParams, tb: LaneTables):
-    """Raw (un-jitted) full-simulation run: ``lax.while_loop`` over rounds,
-    entirely on-device.  Shared by the single-device and sharded drivers.
-    Termination rides the loop cond (queues drained or stop time reached),
-    so the round body never needs the full-state done-guard copy."""
-    round_fn = _build_round(p, tb, guard_done=False)
+    """Raw (un-jitted) full-simulation run, entirely on-device.
+
+    ONE flat ``lax.while_loop`` whose body both advances the window (only
+    when the previous window is exhausted — the identical window sequence
+    of the nested per-round form, so arrival bumps and event logs stay
+    bit-identical) and pops/processes/merges one iteration of events.
+    Collapsing the former rounds-while around an iterations-while matters
+    because each while iteration costs a host↔device round-trip on the
+    tunneled runtime (~350 µs): the common one-iteration window now pays
+    for one iteration, not three.  Shared by the single-device and sharded
+    drivers."""
+    iter_fn = _build_iter(p, tb, pure_dataflow=True)
 
     def full_run(s: LaneState) -> LaneState:
         def cond(st: LaneState):
             return jnp.min(st.q_time[:, 0]) < p.stop_time
 
         def body(st: LaneState):
-            return round_fn(st)[0]
+            min_next = jnp.min(st.q_time[:, 0])
+            fresh = min_next >= st.now_window_end  # previous window drained
+            window_end = jnp.where(
+                fresh,
+                jnp.minimum(min_next + p.runahead, p.stop_time),
+                st.now_window_end,
+            )
+            st = st._replace(
+                now_window_end=window_end,
+                rounds=st.rounds + fresh.astype(st.rounds.dtype),
+            )
+            return iter_fn(st)
 
         return lax.while_loop(cond, body, s)
 

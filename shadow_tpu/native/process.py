@@ -1515,11 +1515,29 @@ class ManagedApp:
             return
         initial = int(req.args[1])  # relative ns; 0 = disarm
         interval = int(req.args[2])
+        overdue_abs = bool(req.args[3]) and initial <= 0
         old_rem = max(sock.t_next - api.now, 0) if sock.t_next else 0
         old_int = sock.t_interval
         sock.t_gen += 1
         sock.count = 0  # Linux: settime resets the expiration counter
-        if initial > 0:
+        if overdue_abs:
+            # TFD_TIMER_ABSTIME with a past it_value: the missed
+            # expirations are readable at once, and later ticks stay on
+            # the ABSOLUTE grid (it_value + k*interval), as on Linux
+            if interval > 0:
+                late = -initial
+                sock.count = late // interval + 1
+                sock.t_interval = interval
+                sock.t_next = api.now + interval - (late % interval)
+                gen = sock.t_gen
+                api.schedule_at(
+                    sock.t_next,
+                    lambda h, s=sock, g=gen: self._timer_fire(h, s, g))
+            else:
+                sock.count = 1  # overdue one-shot: already expired
+                sock.t_next = None
+                sock.t_interval = 0
+        elif initial > 0:
             sock.t_next = api.now + initial
             sock.t_interval = max(interval, 0)
             gen = sock.t_gen
@@ -1529,6 +1547,8 @@ class ManagedApp:
             sock.t_next = None
             sock.t_interval = 0
         self._reply(api, "timerfd-settime", 0, args=[0, old_rem, old_int])
+        if sock.count > 0:
+            self._socket_activity_obj(api, sock)  # readers see it at once
 
     def _timer_fire(self, api, sock: _VSocket, gen: int) -> None:
         """A timerfd expiry event (engine-scheduled on the simulated
@@ -1579,6 +1599,15 @@ class ManagedApp:
             # room opened up: wake a writer parked on overflow
             self._socket_activity_obj(api, sock)
 
+    def _event_apply_write(self, api: HostApi, sock: _VSocket,
+                           value: int) -> None:
+        """Commit an eventfd write (room already checked): add, reply,
+        wake parked readers — shared by the direct and parked paths."""
+        sock.count += value
+        self._reply(api, "write", 8)
+        if value:
+            self._socket_activity_obj(api, sock)
+
     def _event_write(self, api: HostApi, sock: _VSocket, data: bytes,
                      nonblock: bool, vfd: int) -> bool:
         if len(data) != 8:
@@ -1594,10 +1623,7 @@ class ManagedApp:
                 return True
             self._park(api, ("send", vfd, data, 8), None)
             return False
-        sock.count += value
-        self._reply(api, "write", 8)
-        if value:
-            self._socket_activity_obj(api, sock)  # wake parked readers
+        self._event_apply_write(api, sock, value)
         return True
 
     def _op_close(self, api: HostApi, req) -> None:
@@ -1788,10 +1814,7 @@ class ManagedApp:
                 value = int.from_bytes(b[2], "little")
                 if sock.count + value <= EVENTFD_MAX:
                     self._blocked = None
-                    sock.count += value
-                    self._reply(api, "write", 8)
-                    if value:
-                        self._socket_activity_obj(api, sock)
+                    self._event_apply_write(api, sock, value)
                     self._service(api, proc)
                 return
             if sock.sim is None:

@@ -79,6 +79,18 @@ def test_vdso_time_patched(tmp_path):
     assert not result.process_errors
 
 
+def test_simulated_interface_identity(tmp_path):
+    """getifaddrs presents the SIMULATED interfaces — lo plus eth0 with
+    the host's 11.0.0.0/8 address — never the real machine's (the
+    reference's netlink/ifaddrs emulation surface)."""
+    result, out = _run_mode(tmp_path, "ifaddrs")
+    assert "if lo addr=127.0.0.1 mask=255.0.0.0 loop=1 up=1" in out
+    assert "if eth0 addr=11.0.0.1 mask=255.0.0.0 loop=0 up=1" in out
+    assert "idx eth0=2 lo=1 name2=eth0" in out
+    assert out.count("if ") == 2  # nothing real leaked
+    assert not result.process_errors
+
+
 def test_backstops_can_be_disabled(tmp_path):
     """experimental.use_seccomp/use_vdso_patching=false fall back to plain
     LD_PRELOAD: raw time reads then see the REAL clock (not year 2000),

@@ -65,6 +65,16 @@ def test_timerfd_epoll_readiness(tmp_path):
     assert not result.process_errors
 
 
+def test_timerfd_overdue_abstime(tmp_path):
+    """TFD_TIMER_ABSTIME with a past it_value: the missed expirations are
+    readable immediately and later ticks stay on the absolute grid
+    (it_value + k*interval), exactly as on Linux."""
+    result, out = _run_mode(tmp_path, "abstime")
+    assert "overdue=3 read_at_ms=0" in out  # missed at -25/-15/-5 ms
+    assert "next=1 at_ms=5" in out  # grid point +5ms, not +10ms
+    assert not result.process_errors
+
+
 def test_eventfd_across_threads(tmp_path):
     """A poster thread's eventfd_writes wake the main thread's blocking
     reads; EFD_SEMAPHORE hands out one unit per read then EAGAINs."""
