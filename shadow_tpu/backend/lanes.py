@@ -12,9 +12,11 @@ relay token buckets (relay/token_bucket.rs), and the per-host event queues
 
 - per-lane event queues: ``[N, C]`` arrays kept key-sorted by ``lax.sort``
   (the binary heap's batched equivalent).  The event key ``(time, kind,
-  src, seq)`` is packed into **two** int64 sort keys — ``time`` plus an
-  ``aux`` word holding ``kind|src|seq`` — so the comparator moves three
-  operands instead of five;
+  src, seq)`` lives in the int64 state as ``time`` + a packed ``aux``
+  word, but the SORT pipeline runs on order-preserving **int32 splits**
+  of both (``_t_split``/``_aux_split``): TPU has no native int64, so
+  int32 operands halve the emulation overhead and memory traffic of the
+  merge — the hot path;
 - the latency/loss lookup as gathers into the dense ``[G, G]`` tables from
   ``net.graph``;
 - Bernoulli loss via the counter-based threefry streams of ``core.rng``
@@ -636,26 +638,84 @@ def _window_gather(arrs, start, c):
     lanes — but as one *aligned row* gather plus a barrel shift, because TPU
     per-element gathers serialize (~20ns/elem) while row gathers and static
     rolls vectorize.  ``arrs`` is a list of flat [m] arrays sharing ``start``;
-    entries past m are garbage the caller must mask (segment counts do)."""
+    entries past m are garbage the caller must mask (segment counts do).
+    Arrays are processed in same-dtype groups at their NATIVE width — the
+    barrel passes are memory-bound, so int32 operands move half the bytes."""
     m = arrs[0].shape[0]
     # the barrel shift decomposes the offset over bits, so the row width
     # must be a power of two >= c (c itself is any user-chosen capacity)
     v = 1 << max(c - 1, 1).bit_length()
     pad = (-m) % v
     nrow = (m + pad) // v
-    i64 = jnp.int64
-    tab = jnp.stack([a.astype(i64) for a in arrs])  # [A, m]
-    tab = jnp.pad(tab, ((0, 0), (0, pad))).reshape(len(arrs), nrow, v)
     q = jnp.clip(start // v, 0, nrow - 1)
     rows = jnp.stack([q, jnp.clip(q + 1, 0, nrow - 1)], axis=1)  # [N, 2]
-    block = tab[:, rows].reshape(len(arrs), -1, 2 * v)  # [A, N, 2v]
-    sh = (start % v).astype(jnp.int32)
-    b = v >> 1
-    while b:
-        rolled = jnp.concatenate([block[:, :, b:], block[:, :, :b]], axis=2)
-        block = jnp.where(((sh & b) != 0)[None, :, None], rolled, block)
-        b >>= 1
-    return [block[i, :, :c] for i in range(len(arrs))]
+
+    def gather_group(group):
+        a = len(group)
+        tab = jnp.stack(group)  # [A, m], uniform dtype
+        tab = jnp.pad(tab, ((0, 0), (0, pad))).reshape(a, nrow, v)
+        block = tab[:, rows].reshape(a, -1, 2 * v)  # [A, N, 2v]
+        sh = (start % v).astype(jnp.int32)
+        b = v >> 1
+        while b:
+            rolled = jnp.concatenate([block[:, :, b:], block[:, :, :b]], axis=2)
+            block = jnp.where(((sh & b) != 0)[None, :, None], rolled, block)
+            b >>= 1
+        return [block[i, :, :c] for i in range(a)]
+
+    # group by dtype, preserving caller order in the result
+    by_dtype: dict = {}
+    for i, a in enumerate(arrs):
+        by_dtype.setdefault(a.dtype, []).append((i, a))
+    out = [None] * len(arrs)
+    for _dt, items in by_dtype.items():
+        gathered = gather_group([a for _i, a in items])
+        for (i, _a), g in zip(items, gathered):
+            out[i] = g
+    return out
+
+
+# int32 merge-path packing: TPU has no native int64 (every i64 op is an
+# emulated i32 pair with doubled memory traffic), so the sort/gather
+# pipeline runs on order-preserving int32 SPLITS of the window-relative
+# time and of the packed aux word.  State stays absolute int64, and the
+# split is exact for any event time (no horizon): the high word holds
+# rel >> 31, which only carries entropy for events more than ~2.1 s past
+# the window (long timers, RTO backoff, staggered starts).
+NEVER32 = 0x7FFFFFFF  # plain int: no device array at import time
+
+
+def _t_split(t, mbase):
+    """Absolute int64 ns -> (hi, lo) int32 words whose lexicographic order
+    equals the numeric order of ``t - mbase`` (which is >= 0 for every
+    real queued/emitted event).  NEVER maps to (NEVER32, NEVER32)."""
+    rel = t - mbase
+    never = t == NEVER
+    hi = jnp.where(never, NEVER32, rel >> 31).astype(jnp.int32)
+    lo = jnp.where(never, NEVER32, rel & 0x7FFFFFFF).astype(jnp.int32)
+    return hi, lo
+
+
+def _t_join(hi, lo, mbase):
+    """Inverse of _t_split.  A real event cannot reach hi == NEVER32 (that
+    would be ~2^62 ns past the window), so hi alone marks NEVER."""
+    rel = (hi.astype(jnp.int64) << 31) | lo.astype(jnp.int64)
+    return jnp.where(hi == NEVER32, NEVER, mbase + rel)
+
+
+def _aux_split(aux):
+    """One int64 aux (sign clear) -> two int32 words whose (hi, lo)
+    lexicographic order equals the int64 order.  The low half is biased
+    so its unsigned order survives the signed int32 comparison."""
+    hi = (aux >> 32).astype(jnp.int32)
+    lo = ((aux & 0xFFFFFFFF) - 0x80000000).astype(jnp.int32)
+    return hi, lo
+
+
+def _aux_join(hi, lo):
+    return (hi.astype(jnp.int64) << 32) | (
+        lo.astype(jnp.int64) + 0x80000000
+    )
 
 
 def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
@@ -672,6 +732,10 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
        the first C per lane — the queue's sorted invariant is maintained,
        so the pop phase needs no sort at all.
 
+    The whole pipeline runs on int32 (rel time, split aux — see
+    ``_rel32``/``_aux_split``), converting back to the absolute int64
+    state at the end.
+
     Events pushed past column C are capacity overflow: counted per lane
     (the engine raises in strict mode) and logged as DROP_QUEUE; the merge
     keeps the *earliest* C keys, so overflow sheds the latest events.
@@ -680,6 +744,10 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     n, c = p.n_lanes, p.capacity
     i64 = jnp.int64
     sp = p.stream_present
+    # merge base: the current window's start (window_end is clamped to
+    # stop_time, so this can undershoot the true start — harmless, rel
+    # offsets just grow by the difference)
+    mbase = s.now_window_end - p.runahead
 
     # -- same-lane block [N, 2K] (3K with the stream RTO channel) ----------
     self_parts = [emits.ins_valid.T, emits.arm_valid.T]
@@ -694,22 +762,26 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
         size_parts.append(jnp.full_like(emits.ins_size.T, lstr.SZ_RTO))
         pay_parts.append(emits.arm2_pay.T)
     self_valid = jnp.concatenate(self_parts, axis=1)
-    self_time = jnp.where(self_valid, jnp.concatenate(time_parts, axis=1), NEVER)
-    self_aux = jnp.concatenate(aux_parts, axis=1)
+    self_thi, self_tlo = _t_split(
+        jnp.where(self_valid, jnp.concatenate(time_parts, axis=1), NEVER),
+        mbase,
+    )
+    self_auxh, self_auxl = _aux_split(jnp.concatenate(aux_parts, axis=1))
     self_size = jnp.concatenate(size_parts, axis=1)
     self_pay = jnp.concatenate(pay_parts, axis=1)
 
     # -- cross-lane block [N, C] via sort-by-dst + segment gather ----------
     valid = emits.out_valid.reshape(-1)
     dst = jnp.where(valid, emits.out_dst.reshape(-1), jnp.int32(n))
-    m = dst.shape[0]
-    flat_ops = [dst, emits.out_time.reshape(-1), emits.out_aux.reshape(-1),
+    out_thi, out_tlo = _t_split(emits.out_time.reshape(-1), mbase)
+    out_auxh, out_auxl = _aux_split(emits.out_aux.reshape(-1))
+    flat_ops = [dst, out_thi, out_tlo, out_auxh, out_auxl,
                 emits.out_size.reshape(-1)]
     if sp:
         flat_ops.append(emits.out_pay.reshape(-1))
     sorted_ops = lax.sort(tuple(flat_ops), dimension=0, num_keys=1)
-    dst_s, time_s, aux_s, size_s = sorted_ops[:4]
-    pay_s = sorted_ops[4] if sp else None
+    dst_s, thi_s, tlo_s, auxh_s, auxl_s, size_s = sorted_ops[:6]
+    pay_s = sorted_ops[6] if sp else None
     # one search over [0..N]: start of lane n+1 is the end of lane n
     bounds = jnp.searchsorted(
         dst_s, jnp.arange(n + 1, dtype=dst_s.dtype), side="left"
@@ -718,30 +790,40 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     cnt = bounds[1:] - start
     r = jnp.arange(c, dtype=jnp.int32)[None, :]  # [1, C]
     in_seg = r < cnt[:, None]
-    gather_ops = [time_s, aux_s, size_s] + ([pay_s] if sp else [])
+    gather_ops = [thi_s, tlo_s, auxh_s, auxl_s, size_s] + ([pay_s] if sp else [])
     gathered = _window_gather(gather_ops, start, c)
-    g_time, g_aux, g_size = gathered[:3]
-    cross_time = jnp.where(in_seg, g_time, NEVER)
-    cross_aux = jnp.where(in_seg, g_aux, 0)
+    g_thi, g_tlo, g_auxh, g_auxl, g_size = gathered[:5]
+    cross_thi = jnp.where(in_seg, g_thi, NEVER32).astype(jnp.int32)
+    cross_tlo = jnp.where(in_seg, g_tlo, NEVER32).astype(jnp.int32)
+    cross_auxh = jnp.where(in_seg, g_auxh, 0).astype(jnp.int32)
+    cross_auxl = jnp.where(in_seg, g_auxl, 0).astype(jnp.int32)
     cross_size = jnp.where(in_seg, g_size, 0).astype(jnp.int32)
-    cross_pay = jnp.where(in_seg, gathered[3], 0) if sp else None
+    cross_pay = jnp.where(in_seg, gathered[5], 0) if sp else None
     # receivers of more than C events in one iteration lose the tail
     # before the merge even sees it; count those drops too
     lost_pre = jnp.maximum(cnt - c, 0).astype(i64)
 
     # -- merge [N, C + self + C], keep first C ----------------------------
-    mt = jnp.concatenate([s.q_time, self_time, cross_time], axis=1)
-    ma = jnp.concatenate([s.q_aux, self_aux, cross_aux], axis=1)
+    q_thi, q_tlo = _t_split(s.q_time, mbase)
+    q_auxh, q_auxl = _aux_split(s.q_aux)
+    mthi = jnp.concatenate([q_thi, self_thi, cross_thi], axis=1)
+    mtlo = jnp.concatenate([q_tlo, self_tlo, cross_tlo], axis=1)
+    mh = jnp.concatenate([q_auxh, self_auxh, cross_auxh], axis=1)
+    ml = jnp.concatenate([q_auxl, self_auxl, cross_auxl], axis=1)
     ms = jnp.concatenate([s.q_size, self_size, cross_size], axis=1)
     if sp:
         mpay = jnp.concatenate([s.q_pay, self_pay, cross_pay], axis=1)
-        mt, ma, ms, mpay = lax.sort((mt, ma, ms, mpay), dimension=1, num_keys=2)
+        mthi, mtlo, mh, ml, ms, mpay = lax.sort(
+            (mthi, mtlo, mh, ml, ms, mpay), dimension=1, num_keys=4
+        )
     else:
-        mt, ma, ms = lax.sort((mt, ma, ms), dimension=1, num_keys=2)
-    tail_mask = mt[:, c:] != NEVER
+        mthi, mtlo, mh, ml, ms = lax.sort(
+            (mthi, mtlo, mh, ml, ms), dimension=1, num_keys=4
+        )
+    tail_mask = mthi[:, c:] != NEVER32
     s = s._replace(
-        q_time=mt[:, :c],
-        q_aux=ma[:, :c],
+        q_time=_t_join(mthi[:, :c], mtlo[:, :c], mbase),
+        q_aux=_aux_join(mh[:, :c], ml[:, :c]),
         q_size=ms[:, :c],
         n_queue=s.n_queue + tail_mask.sum(axis=1) + lost_pre,
     )
@@ -750,8 +832,8 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
 
     # overflow log records from the merge tail (pre-gather losses surface
     # only in n_queue; both paths raise in strict mode)
-    t_tail = mt[:, c:]
-    _, o_src, o_seq = unpack_aux(ma[:, c:])
+    t_tail = _t_join(mthi[:, c:], mtlo[:, c:], mbase)
+    _, o_src, o_seq = unpack_aux(_aux_join(mh[:, c:], ml[:, c:]))
     rows = jnp.broadcast_to(
         jnp.arange(n, dtype=jnp.int64)[:, None], tail_mask.shape
     )

@@ -99,6 +99,24 @@ def test_tgen_mesh_parity():
     assert cpu.log_tuples() == tpu.log_tuples()
 
 
+FAR_TIMER = """
+general: {stop_time: 12s, seed: 9}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  cli: {network_node_id: 0, processes: [{path: ping, args: [--peer, srv, --count, "2", --interval, 5s]}]}
+  srv: {network_node_id: 0, processes: [{path: ping}]}
+"""
+
+
+def test_far_future_events_parity():
+    """Events queued >2.1 s past the window (a 5 s timer here; RTO backoff
+    and staggered starts hit the same path) exercise the high word of the
+    int32 time split — ordering and logs must stay exact, not saturate."""
+    cpu, tpu = both_logs(FAR_TIMER, mode="device")
+    assert len(cpu.event_log) >= 4  # two pings + echoes
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
 PING = """
 general: {stop_time: 2s, seed: 5}
 network: {graph: {type: 1_gbit_switch}}
