@@ -170,6 +170,11 @@ class LaneParams:
     # static: any edge with packet_loss > 0?  loss-free graphs skip the
     # per-send threefry draw entirely
     has_loss: bool = True
+    # window-advance+pop steps per fused while-loop trip (amortizes the
+    # ~350 us per-iteration host round-trip of the tunneled runtime).
+    # Multiplies XLA compile time with the body size — worth it for small
+    # slot bodies (the passive models), costly for phold/stream
+    unroll: int = 1
 
     @property
     def stream_present(self) -> bool:
@@ -1052,16 +1057,27 @@ def _build_full_run(p: LaneParams, tb: LaneTables):
     drivers."""
     iter_fn = _build_iter(p, tb, pure_dataflow=True)
 
+    # steps per while-loop trip (p.unroll, experimental.tpu_round_unroll):
+    # each loop iteration costs ~350 us of host round-trip on the tunneled
+    # runtime, so several window-advance+pop steps can run per trip.
+    # Steps past the end are harmless no-ops (the saturated window admits
+    # no pops), so no per-step guard is needed.
+    unroll = max(int(p.unroll), 1)
+
     def full_run(s: LaneState) -> LaneState:
         def cond(st: LaneState):
             return jnp.min(st.q_time[:, 0]) < p.stop_time
 
-        def body(st: LaneState):
+        def step(st: LaneState):
             min_next = jnp.min(st.q_time[:, 0])
-            fresh = min_next >= st.now_window_end  # previous window drained
+            live = min_next < p.stop_time
+            fresh = (min_next >= st.now_window_end) & live
             window_end = jnp.where(
                 fresh,
-                jnp.minimum(min_next + p.runahead, p.stop_time),
+                # clamp before adding: min_next may be NEVER on a no-op
+                # trailing step, and NEVER + runahead would wrap
+                jnp.minimum(jnp.minimum(min_next, p.stop_time) + p.runahead,
+                            p.stop_time),
                 st.now_window_end,
             )
             st = st._replace(
@@ -1069,6 +1085,11 @@ def _build_full_run(p: LaneParams, tb: LaneTables):
                 rounds=st.rounds + fresh.astype(st.rounds.dtype),
             )
             return iter_fn(st)
+
+        def body(st: LaneState):
+            for _ in range(unroll):
+                st = step(st)
+            return st
 
         return lax.while_loop(cond, body, s)
 

@@ -177,6 +177,7 @@ class TpuEngine:
             runahead=runahead,
             models_present=tuple(sorted(set(int(x) for x in model))),
             has_loss=bool(np.any(np.asarray(thresh) > 0)),
+            unroll=cfg.experimental.tpu_round_unroll,
         )
 
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)

@@ -99,6 +99,7 @@ class ExperimentalOptions:
     network_backend: str = "cpu"  # "cpu" | "tpu"
     tpu_lane_queue_capacity: int = 64  # per-host in-flight packet slots
     tpu_events_per_round: int = 8  # max pops per lane per inner step
+    tpu_round_unroll: int = 1  # fused-loop steps per device loop trip
     tpu_mesh_shape: Optional[tuple[int, ...]] = None  # None = all devices
 
 

@@ -108,6 +108,19 @@ hosts:
 """
 
 
+MESH_UNROLLED = MESH.replace(
+    "hosts:", "experimental: {tpu_round_unroll: 2}\nhosts:"
+)
+
+
+def test_unrolled_device_loop_parity():
+    """tpu_round_unroll > 1 runs several window steps per device-loop trip
+    (trailing no-op steps past the end included) — logs stay identical.
+    (2, not more: XLA CPU compile time grows steeply with body size.)"""
+    cpu, tpu = both_logs(MESH_UNROLLED, mode="device")
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
 def test_far_future_events_parity():
     """Events queued >2.1 s past the window (a 5 s timer here; RTO backoff
     and staggered starts hit the same path) exercise the high word of the
