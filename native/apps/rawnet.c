@@ -1,0 +1,244 @@
+/* rawnet: network + concurrency entirely through raw syscall(2) — no libc
+ * wrapper symbols for any simulation-owned operation.  This is the repo's
+ * stand-in for the reference's Go-runtime scenario (src/test/golang/): the
+ * Go runtime bypasses libc and issues socket/poll/futex syscalls directly,
+ * so only the raw-syscall backstop (syscall-user-dispatch here, the
+ * seccomp wrapper table in the reference, preload-libc/
+ * gen_syscall_wrappers_c.py) can pull such programs into the simulation.
+ *
+ * Modes:
+ *   server <port>          raw socket/bind/listen/epoll/accept4/read/write
+ *                          TCP echo server, epoll-driven
+ *   client <host> <port>   raw socket/connect/poll/write/read client; prints
+ *                          round-trip payloads and SIMULATED timing
+ *   udp <host> <port>      raw UDP sendto/recvfrom pingpong client
+ *   udpserve <port>        raw UDP echo server (recvfrom/sendto loop)
+ *   futex <n>              two pthreads handshake n times through raw
+ *                          FUTEX_WAIT/FUTEX_WAKE on shared words
+ *
+ * Every printed number derives from the simulated clock, so output is
+ * bit-identical run-to-run iff the backstop routes these calls into the
+ * simulation.
+ */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <errno.h>
+#include <linux/futex.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+static long raw(long nr, long a1, long a2, long a3, long a4, long a5,
+                long a6) {
+    register long r10 __asm__("r10") = a4;
+    register long r8 __asm__("r8") = a5;
+    register long r9 __asm__("r9") = a6;
+    long ret;
+    __asm__ volatile("syscall"
+                     : "=a"(ret)
+                     : "a"(nr), "D"(a1), "S"(a2), "d"(a3), "r"(r10), "r"(r8),
+                       "r"(r9)
+                     : "rcx", "r11", "memory");
+    return ret;
+}
+
+static uint64_t now_ms(void) {
+    struct timespec ts;
+    raw(SYS_clock_gettime, CLOCK_REALTIME, (long)&ts, 0, 0, 0, 0);
+    return (uint64_t)ts.tv_sec * 1000ull + (uint64_t)ts.tv_nsec / 1000000ull;
+}
+
+static struct sockaddr_in mkaddr(const char *ip, int port) {
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof a);
+    a.sin_family = AF_INET;
+    a.sin_port = htons((uint16_t)port);
+    if (ip)
+        inet_pton(AF_INET, ip, &a.sin_addr);
+    else
+        a.sin_addr.s_addr = INADDR_ANY;
+    return a;
+}
+
+/* ---- raw TCP echo server, epoll-driven ---- */
+static int run_server(int port) {
+    long ls = raw(SYS_socket, AF_INET, SOCK_STREAM, 0, 0, 0, 0);
+    if (ls < 0) return 1;
+    struct sockaddr_in a = mkaddr(NULL, port);
+    if (raw(SYS_bind, ls, (long)&a, sizeof a, 0, 0, 0) < 0) return 2;
+    if (raw(SYS_listen, ls, 8, 0, 0, 0, 0) < 0) return 3;
+    long ep = raw(SYS_epoll_create1, 0, 0, 0, 0, 0, 0);
+    struct epoll_event ev = {.events = EPOLLIN, .data = {.fd = (int)ls}};
+    raw(SYS_epoll_ctl, ep, EPOLL_CTL_ADD, ls, (long)&ev, 0, 0);
+    int served = 0;
+    for (;;) {
+        struct epoll_event evs[8];
+        long n = raw(SYS_epoll_wait, ep, (long)evs, 8, 30000, 0, 0);
+        if (n <= 0) break; /* idle timeout: no clients for 30 sim-s */
+        for (int i = 0; i < n; i++) {
+            if (evs[i].data.fd == (int)ls) {
+                long c = raw(SYS_accept4, ls, 0, 0, 0, 0, 0);
+                if (c >= 0) {
+                    struct epoll_event cev = {.events = EPOLLIN,
+                                              .data = {.fd = (int)c}};
+                    raw(SYS_epoll_ctl, ep, EPOLL_CTL_ADD, c, (long)&cev, 0,
+                        0);
+                }
+                continue;
+            }
+            char buf[2048];
+            long r = raw(SYS_read, evs[i].data.fd, (long)buf, sizeof buf, 0,
+                         0, 0);
+            if (r <= 0) {
+                raw(SYS_epoll_ctl, ep, EPOLL_CTL_DEL, evs[i].data.fd, 0, 0,
+                    0);
+                raw(SYS_close, evs[i].data.fd, 0, 0, 0, 0, 0);
+                served++;
+                continue;
+            }
+            long off = 0;
+            while (off < r) {
+                long w = raw(SYS_write, evs[i].data.fd, (long)buf + off,
+                             r - off, 0, 0, 0);
+                if (w <= 0) break;
+                off += w;
+            }
+        }
+    }
+    printf("server done served=%d\n", served);
+    return 0;
+}
+
+/* ---- raw TCP client ---- */
+static int run_client(const char *ip, int port) {
+    uint64_t t0 = now_ms();
+    long fd = raw(SYS_socket, AF_INET, SOCK_STREAM, 0, 0, 0, 0);
+    struct sockaddr_in a = mkaddr(ip, port);
+    long rc = raw(SYS_connect, fd, (long)&a, sizeof a, 0, 0, 0);
+    if (rc < 0) {
+        printf("connect errno=%ld\n", -rc);
+        return 1;
+    }
+    for (int i = 0; i < 3; i++) {
+        char msg[64];
+        int len = snprintf(msg, sizeof msg, "raw-ping-%d", i);
+        raw(SYS_write, fd, (long)msg, len, 0, 0, 0);
+        struct pollfd pfd = {(int)fd, POLLIN, 0};
+        long pr = raw(SYS_poll, (long)&pfd, 1, 10000, 0, 0, 0);
+        if (pr <= 0) {
+            printf("poll timeout at %d\n", i);
+            return 2;
+        }
+        char buf[128];
+        long r = raw(SYS_read, fd, (long)buf, sizeof buf - 1, 0, 0, 0);
+        if (r <= 0) return 3;
+        buf[r] = 0;
+        printf("echo %s at +%llu ms\n", buf,
+               (unsigned long long)(now_ms() - t0));
+    }
+    raw(SYS_close, fd, 0, 0, 0, 0, 0);
+    printf("client done\n");
+    return 0;
+}
+
+/* ---- raw UDP ---- */
+static int run_udpserve(int port) {
+    long fd = raw(SYS_socket, AF_INET, SOCK_DGRAM, 0, 0, 0, 0);
+    struct sockaddr_in a = mkaddr(NULL, port);
+    raw(SYS_bind, fd, (long)&a, sizeof a, 0, 0, 0);
+    for (int i = 0; i < 3; i++) {
+        char buf[512];
+        struct sockaddr_in peer;
+        unsigned plen = sizeof peer;
+        long r = raw(SYS_recvfrom, fd, (long)buf, sizeof buf, 0, (long)&peer,
+                     (long)&plen);
+        if (r < 0) return 1;
+        raw(SYS_sendto, fd, (long)buf, r, 0, (long)&peer, plen);
+    }
+    printf("udpserve done\n");
+    return 0;
+}
+
+static int run_udp(const char *ip, int port) {
+    uint64_t t0 = now_ms();
+    long fd = raw(SYS_socket, AF_INET, SOCK_DGRAM, 0, 0, 0, 0);
+    struct sockaddr_in a = mkaddr(ip, port);
+    for (int i = 0; i < 3; i++) {
+        char msg[64];
+        int len = snprintf(msg, sizeof msg, "raw-dgram-%d", i);
+        raw(SYS_sendto, fd, (long)msg, len, 0, (long)&a, sizeof a);
+        char buf[512];
+        long r = raw(SYS_recvfrom, fd, (long)buf, sizeof buf - 1, 0, 0, 0);
+        if (r < 0) return 1;
+        buf[r] = 0;
+        printf("dgram %s at +%llu ms\n", buf,
+               (unsigned long long)(now_ms() - t0));
+    }
+    printf("udp done\n");
+    return 0;
+}
+
+/* ---- raw futex handshake between two pthreads ---- */
+static uint32_t f_ping, f_pong;
+static int f_rounds;
+
+static void *futex_peer(void *arg) {
+    (void)arg;
+    for (int i = 1; i <= f_rounds; i++) {
+        while (__atomic_load_n(&f_ping, __ATOMIC_SEQ_CST) != (uint32_t)i) {
+            long r = raw(SYS_futex, (long)&f_ping, FUTEX_WAIT, i - 1, 0, 0,
+                         0);
+            (void)r; /* EAGAIN = already advanced */
+        }
+        __atomic_store_n(&f_pong, (uint32_t)i, __ATOMIC_SEQ_CST);
+        raw(SYS_futex, (long)&f_pong, FUTEX_WAKE, 1, 0, 0, 0);
+    }
+    return NULL;
+}
+
+static int run_futex(int n) {
+    f_rounds = n;
+    pthread_t th;
+    if (pthread_create(&th, NULL, futex_peer, NULL) != 0) return 1;
+    uint64_t t0 = now_ms();
+    for (int i = 1; i <= n; i++) {
+        __atomic_store_n(&f_ping, (uint32_t)i, __ATOMIC_SEQ_CST);
+        raw(SYS_futex, (long)&f_ping, FUTEX_WAKE, 1, 0, 0, 0);
+        while (__atomic_load_n(&f_pong, __ATOMIC_SEQ_CST) != (uint32_t)i) {
+            long r = raw(SYS_futex, (long)&f_pong, FUTEX_WAIT, i - 1, 0, 0,
+                         0);
+            (void)r;
+        }
+    }
+    pthread_join(th, NULL);
+    printf("futex done rounds=%d elapsed=%llu ms\n", n,
+           (unsigned long long)(now_ms() - t0));
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IOLBF, 0);
+    if (argc >= 3 && strcmp(argv[1], "server") == 0)
+        return run_server(atoi(argv[2]));
+    if (argc >= 4 && strcmp(argv[1], "client") == 0)
+        return run_client(argv[2], atoi(argv[3]));
+    if (argc >= 3 && strcmp(argv[1], "udpserve") == 0)
+        return run_udpserve(atoi(argv[2]));
+    if (argc >= 4 && strcmp(argv[1], "udp") == 0)
+        return run_udp(argv[2], atoi(argv[3]));
+    if (argc >= 3 && strcmp(argv[1], "futex") == 0)
+        return run_futex(atoi(argv[2]));
+    fprintf(stderr,
+            "usage: rawnet server <port> | client <ip> <port> | "
+            "udpserve <port> | udp <ip> <port> | futex <n>\n");
+    return 2;
+}

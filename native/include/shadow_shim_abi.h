@@ -108,6 +108,17 @@ enum {
                                      args[2]=interval */
     SHIM_OP_EVENTFD_CREATE = 39,  /* args[0]=reserved fd args[1]=initval
                                      args[2]=EFD_SEMAPHORE(0|1) */
+    /* raw futex virtualization (host/futex_table.rs + handler/futex.rs):
+     * the shim pre-checks *uaddr in the plugin's own address space (safe
+     * under strict turn-taking), the manager owns the wait queues */
+    SHIM_OP_FUTEX_WAIT = 40,    /* args[0]=addr args[1]=timeout ns rel
+                                   (-1 = infinite) args[2]=bitset;
+                                   reply 0 | -ETIMEDOUT */
+    SHIM_OP_FUTEX_WAKE = 41,    /* args[0]=addr args[1]=max args[2]=bitset;
+                                   reply ret = #woken */
+    SHIM_OP_FUTEX_REQUEUE = 42, /* args[0]=addr args[1]=max-wake
+                                   args[2]=dst addr args[3]=max-requeue;
+                                   reply ret = woken, args[1] = requeued */
 };
 
 /* poll event bits (mirror Linux poll.h values) */

@@ -20,11 +20,25 @@
  * the reference owning the plugin's descriptor table
  * (descriptor/descriptor_table.rs).
  *
- * Interposition here is symbol-level (LD_PRELOAD overrides the PLT), the
- * fast path the reference prefers over seccomp for the same reason
- * (preload-libc/: "faster than seccomp"); the seccomp SIGSYS backstop for
- * raw-syscall binaries is future work.  Static binaries are rejected by
- * the manager, as in the reference (src/test/static-bin).
+ * Interposition is layered (the reference's exact discipline,
+ * preload-libc/: "faster than seccomp"):
+ *
+ *   1. symbol-level LD_PRELOAD wrappers — the fast path for PLT calls;
+ *   2. vDSO patching for glibc-internal time reads;
+ *   3. a raw-syscall backstop for everything else: syscall-user-dispatch
+ *      (PR_SET_SYSCALL_USER_DISPATCH, the mechanism the reference's own
+ *      comments recommend migrating to, shim_seccomp.c "Better yet...")
+ *      dispatches EVERY syscall issued outside this .so's text into the
+ *      SIGSYS handler, which routes simulation-owned calls (sockets,
+ *      readiness, futex, time, fork) through the same wrapper logic and
+ *      re-executes the rest natively.  Unlike a seccomp filter, SUD is
+ *      reset by execve, so exec'd images re-install cleanly with no
+ *      stale-filter generation to dodge.  On kernels without SUD
+ *      (< 5.11) a narrow seccomp filter covering the time/sleep/entropy
+ *      set is installed instead (the round-1 behavior).
+ *
+ * Static binaries are rejected by the manager, as in the reference
+ * (src/test/static-bin).
  */
 #define _GNU_SOURCE
 #include <arpa/inet.h>
@@ -51,6 +65,9 @@
 #include <sys/syscall.h>
 #include <sys/resource.h>
 #include <sys/time.h>
+#include <sys/timerfd.h>
+#include <sys/uio.h>
+#include <sys/utsname.h>
 #include <sys/wait.h>
 #include <time.h>
 #include <unistd.h>
@@ -141,39 +158,139 @@ static int (*real_select)(int, fd_set *, fd_set *, fd_set *, struct timeval *);
 static int (*real_epoll_ctl)(int, int, int, struct epoll_event *);
 static int (*real_epoll_wait)(int, struct epoll_event *, int, int);
 
+/* Every fallback the wrappers use is a raw syscall issued from THIS
+ * object's text, never a dlsym'd libc function: (a) the backstop's allowed
+ * region is this .so's text, so shim-internal syscalls never trap; (b) a
+ * dlsym'd fallback reached from the SIGSYS handler would re-enter libc,
+ * whose syscall instruction traps again — unbounded recursion.  These are
+ * thin kernel wrappers with libc return conventions (-1 + errno). */
+static long shim_raw_syscall6(long nr, long a1, long a2, long a3, long a4,
+                              long a5, long a6);
+
+static long raw_ret(long r) {
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return r;
+}
+
+#define RAW1(rt, name, nr, t1)                                               \
+    static rt raw_##name(t1 a) {                                             \
+        return (rt)raw_ret(shim_raw_syscall6(nr, (long)a, 0, 0, 0, 0, 0));   \
+    }
+#define RAW2(rt, name, nr, t1, t2)                                           \
+    static rt raw_##name(t1 a, t2 b) {                                       \
+        return (rt)raw_ret(                                                  \
+            shim_raw_syscall6(nr, (long)a, (long)b, 0, 0, 0, 0));            \
+    }
+#define RAW3(rt, name, nr, t1, t2, t3)                                       \
+    static rt raw_##name(t1 a, t2 b, t3 c) {                                 \
+        return (rt)raw_ret(                                                  \
+            shim_raw_syscall6(nr, (long)a, (long)b, (long)c, 0, 0, 0));      \
+    }
+#define RAW4(rt, name, nr, t1, t2, t3, t4)                                   \
+    static rt raw_##name(t1 a, t2 b, t3 c, t4 d) {                           \
+        return (rt)raw_ret(shim_raw_syscall6(nr, (long)a, (long)b, (long)c,  \
+                                             (long)d, 0, 0));                \
+    }
+#define RAW5(rt, name, nr, t1, t2, t3, t4, t5)                               \
+    static rt raw_##name(t1 a, t2 b, t3 c, t4 d, t5 e) {                     \
+        return (rt)raw_ret(shim_raw_syscall6(nr, (long)a, (long)b, (long)c,  \
+                                             (long)d, (long)e, 0));          \
+    }
+#define RAW6_(rt, name, nr, t1, t2, t3, t4, t5, t6)                          \
+    static rt raw_##name(t1 a, t2 b, t3 c, t4 d, t5 e, t6 f) {               \
+        return (rt)raw_ret(shim_raw_syscall6(nr, (long)a, (long)b, (long)c,  \
+                                             (long)d, (long)e, (long)f));    \
+    }
+
+RAW3(int, socket, SYS_socket, int, int, int)
+RAW3(int, bind, SYS_bind, int, const struct sockaddr *, socklen_t)
+RAW3(int, connect, SYS_connect, int, const struct sockaddr *, socklen_t)
+RAW2(int, listen, SYS_listen, int, int)
+RAW4(int, accept4, SYS_accept4, int, struct sockaddr *, socklen_t *, int)
+RAW6_(ssize_t, sendto, SYS_sendto, int, const void *, size_t, int,
+      const struct sockaddr *, socklen_t)
+RAW6_(ssize_t, recvfrom, SYS_recvfrom, int, void *, size_t, int,
+      struct sockaddr *, socklen_t *)
+RAW1(int, close, SYS_close, int)
+RAW2(int, shutdown, SYS_shutdown, int, int)
+RAW3(int, getsockname, SYS_getsockname, int, struct sockaddr *, socklen_t *)
+RAW3(int, getpeername, SYS_getpeername, int, struct sockaddr *, socklen_t *)
+RAW5(int, setsockopt, SYS_setsockopt, int, int, int, const void *, socklen_t)
+RAW5(int, getsockopt, SYS_getsockopt, int, int, int, void *, socklen_t *)
+RAW3(ssize_t, read, SYS_read, int, void *, size_t)
+RAW3(ssize_t, write, SYS_write, int, const void *, size_t)
+RAW3(int, poll_, SYS_poll, struct pollfd *, nfds_t, int)
+RAW5(int, select, SYS_select, int, fd_set *, fd_set *, fd_set *,
+     struct timeval *)
+RAW4(int, epoll_ctl, SYS_epoll_ctl, int, int, int, struct epoll_event *)
+RAW4(int, epoll_wait, SYS_epoll_wait, int, struct epoll_event *, int, int)
+RAW3(ssize_t, recvmsg, SYS_recvmsg, int, struct msghdr *, int)
+RAW3(ssize_t, sendmsg, SYS_sendmsg, int, const struct msghdr *, int)
+RAW3(ssize_t, readv, SYS_readv, int, const struct iovec *, int)
+RAW3(ssize_t, writev, SYS_writev, int, const struct iovec *, int)
+RAW1(int, dup, SYS_dup, int)
+RAW2(int, dup2_, SYS_dup2, int, int)
+RAW3(int, dup3_, SYS_dup3, int, int, int)
+RAW2(int, timerfd_create, SYS_timerfd_create, int, int)
+RAW4(int, timerfd_settime, SYS_timerfd_settime, int, int,
+     const struct itimerspec *, struct itimerspec *)
+RAW2(int, timerfd_gettime, SYS_timerfd_gettime, int, struct itimerspec *)
+RAW2(int, eventfd2, SYS_eventfd2, unsigned int, int)
+RAW1(int, uname_, SYS_uname, struct utsname *)
+
+static int raw_fcntl(int fd, int cmd, ...) {
+    va_list ap;
+    va_start(ap, cmd);
+    long arg = va_arg(ap, long);
+    va_end(ap);
+    return (int)raw_ret(shim_raw_syscall6(SYS_fcntl, fd, cmd, arg, 0, 0, 0));
+}
+
+static int raw_ioctl(int fd, unsigned long req, ...) {
+    va_list ap;
+    va_start(ap, req);
+    long arg = va_arg(ap, long);
+    va_end(ap);
+    return (int)raw_ret(
+        shim_raw_syscall6(SYS_ioctl, fd, (long)req, arg, 0, 0, 0));
+}
+
 static void resolve_reals(void) {
     if (real_socket) return;
-    real_socket = dlsym(RTLD_NEXT, "socket");
-    real_bind = dlsym(RTLD_NEXT, "bind");
-    real_connect = dlsym(RTLD_NEXT, "connect");
-    real_listen = dlsym(RTLD_NEXT, "listen");
-    real_accept4 = dlsym(RTLD_NEXT, "accept4");
-    real_sendto = dlsym(RTLD_NEXT, "sendto");
-    real_recvfrom = dlsym(RTLD_NEXT, "recvfrom");
-    real_close = dlsym(RTLD_NEXT, "close");
-    real_shutdown = dlsym(RTLD_NEXT, "shutdown");
-    real_getsockname = dlsym(RTLD_NEXT, "getsockname");
-    real_getpeername = dlsym(RTLD_NEXT, "getpeername");
-    real_setsockopt = dlsym(RTLD_NEXT, "setsockopt");
-    real_getsockopt = dlsym(RTLD_NEXT, "getsockopt");
-    real_read = dlsym(RTLD_NEXT, "read");
-    real_write = dlsym(RTLD_NEXT, "write");
-    real_fcntl = dlsym(RTLD_NEXT, "fcntl");
-    real_ioctl = dlsym(RTLD_NEXT, "ioctl");
-    real_poll = dlsym(RTLD_NEXT, "poll");
-    real_select = dlsym(RTLD_NEXT, "select");
-    real_epoll_ctl = dlsym(RTLD_NEXT, "epoll_ctl");
-    real_epoll_wait = dlsym(RTLD_NEXT, "epoll_wait");
+    real_socket = raw_socket;
+    real_bind = raw_bind;
+    real_connect = raw_connect;
+    real_listen = raw_listen;
+    real_accept4 = raw_accept4;
+    real_sendto = raw_sendto;
+    real_recvfrom = raw_recvfrom;
+    real_close = raw_close;
+    real_shutdown = raw_shutdown;
+    real_getsockname = raw_getsockname;
+    real_getpeername = raw_getpeername;
+    real_setsockopt = raw_setsockopt;
+    real_getsockopt = raw_getsockopt;
+    real_read = raw_read;
+    real_write = raw_write;
+    real_fcntl = raw_fcntl;
+    real_ioctl = raw_ioctl;
+    real_poll = raw_poll_;
+    real_select = raw_select;
+    real_epoll_ctl = raw_epoll_ctl;
+    real_epoll_wait = raw_epoll_wait;
 }
 
 /* ---------------------------------------------------------------- futex */
 
 static void futex_wait(uint32_t *addr, uint32_t expected) {
-    syscall(SYS_futex, addr, FUTEX_WAIT, expected, NULL, NULL, 0);
+    shim_raw_syscall6(SYS_futex, (long)addr, FUTEX_WAIT, expected, 0, 0, 0);
 }
 
 static void futex_wake(uint32_t *addr) {
-    syscall(SYS_futex, addr, FUTEX_WAKE, 1, NULL, NULL, 0);
+    shim_raw_syscall6(SYS_futex, (long)addr, FUTEX_WAKE, 1, 0, 0, 0);
 }
 
 static void msg_publish(shim_msg *m) {
@@ -200,25 +317,21 @@ static void msg_await(shim_msg *m) {
 static int64_t shim_call(uint32_t op, const int64_t args[6], const void *out,
                          uint32_t out_len, void *in, uint32_t *in_len,
                          int64_t reply_args[6]) {
-    /* mask everything except termination/fault signals (built once):
-     * handler reentrancy is excluded wholesale, while a shutdown_signal
-     * can still kill a parked plugin and faults stay synchronous */
-    static sigset_t sig_blk;
-    static int sig_blk_ready;
-    if (!sig_blk_ready) {
-        sigfillset(&sig_blk);
-        sigdelset(&sig_blk, SIGTERM);
-        sigdelset(&sig_blk, SIGINT);
-        sigdelset(&sig_blk, SIGQUIT);
-        sigdelset(&sig_blk, SIGSEGV);
-        sigdelset(&sig_blk, SIGBUS);
-        sigdelset(&sig_blk, SIGILL);
-        sigdelset(&sig_blk, SIGFPE);
-        sigdelset(&sig_blk, SIGABRT);
-        sig_blk_ready = 1;
-    }
-    sigset_t sig_old;
-    sigprocmask(SIG_SETMASK, &sig_blk, &sig_old);
+    /* mask everything except termination/fault signals: handler
+     * reentrancy is excluded wholesale, while a shutdown_signal can still
+     * kill a parked plugin and faults stay synchronous.  Raw
+     * rt_sigprocmask on the 64-bit kernel sigset — libc's sigprocmask
+     * issues its syscall from libc text, which the dispatch backstop
+     * traps; the restore (with SIGSYS then blocked) would turn that trap
+     * into a forced-SIGSYS kill. */
+    static const uint64_t sig_blk =
+        ~((1ull << (SIGTERM - 1)) | (1ull << (SIGINT - 1)) |
+          (1ull << (SIGQUIT - 1)) | (1ull << (SIGSEGV - 1)) |
+          (1ull << (SIGBUS - 1)) | (1ull << (SIGILL - 1)) |
+          (1ull << (SIGFPE - 1)) | (1ull << (SIGABRT - 1)));
+    uint64_t sig_old = 0;
+    shim_raw_syscall6(SYS_rt_sigprocmask, SIG_SETMASK, (long)&sig_blk,
+                      (long)&sig_old, 8, 0, 0);
     shim_shmem *shm = cur_shm();
     shim_msg *tx = &shm->to_shadow;
     shim_msg *rx = &shm->to_shim;
@@ -237,7 +350,8 @@ static int64_t shim_call(uint32_t op, const int64_t args[6], const void *out,
         *in_len = n;
     }
     int64_t ret = rx->ret;
-    sigprocmask(SIG_SETMASK, &sig_old, NULL);
+    shim_raw_syscall6(SYS_rt_sigprocmask, SIG_SETMASK, (long)&sig_old, 0, 8,
+                      0, 0);
     return ret;
 }
 
@@ -468,6 +582,41 @@ static int text_range_cb(struct dl_phdr_info *info, size_t sz, void *data) {
     return 0;
 }
 
+/* Dispatch of trapped syscalls to the wrapper logic lives at the end of
+ * the file, after every wrapper it routes through. */
+static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
+                              long a5, long a6, int *handled);
+
+/* -- syscall-user-dispatch (primary backstop) --------------------------- */
+
+#ifndef PR_SET_SYSCALL_USER_DISPATCH
+#define PR_SET_SYSCALL_USER_DISPATCH 59
+#define PR_SYS_DISPATCH_OFF 0
+#define PR_SYS_DISPATCH_ON 1
+#define SYSCALL_DISPATCH_FILTER_ALLOW 0
+#define SYSCALL_DISPATCH_FILTER_BLOCK 1
+#endif
+
+/* One selector byte for the whole process (each thread registers the same
+ * address).  It stays BLOCK for the process's lifetime; the allowed text
+ * region — not selector flipping — is what lets the shim's own syscalls
+ * through, so there is no enable/disable race to manage.  The only
+ * exception is the pthread_create bracket (see there). */
+static volatile char g_sud_selector = SYSCALL_DISPATCH_FILTER_ALLOW;
+static int g_sud_on;
+
+/* SUD registration is per-thread and is NOT inherited by fork children or
+ * new threads (verified empirically; unlike a seccomp filter it is also
+ * reset by execve — the property that makes native exec workable).  Every
+ * fork child and pthread re-arms itself from shim text before running
+ * app code. */
+static int sud_arm(void) {
+    return (int)shim_raw_syscall6(SYS_prctl, PR_SET_SYSCALL_USER_DISPATCH,
+                                  PR_SYS_DISPATCH_ON, (long)g_text_lo,
+                                  (long)(g_text_hi - g_text_lo),
+                                  (long)&g_sud_selector, 0);
+}
+
 static void sigsys_handler(int sig, siginfo_t *si, void *uctx) {
     (void)sig;
     (void)si;
@@ -475,99 +624,84 @@ static void sigsys_handler(int sig, siginfo_t *si, void *uctx) {
     ucontext_t *uc = uctx;
     greg_t *gr = uc->uc_mcontext.gregs;
     long nr = gr[REG_RAX];
+    if (nr == SYS_rt_sigreturn) {
+        /* An app signal handler is returning: its libc restorer's
+         * rt_sigreturn was dispatched here, so the kernel would read the
+         * signal frame at OUR stack depth, not the original one.  Emulate
+         * in user space instead: at the original syscall insn, RSP points
+         * at the interrupted frame's ucontext (the restorer's return
+         * address has been consumed) — adopt that saved context, sigmask
+         * and fpstate pointer included, as this handler's own; our
+         * sigreturn then restores the state the app's frame described. */
+        ucontext_t *orig = (ucontext_t *)gr[REG_RSP];
+        *uc = *orig;
+        errno = saved_errno;
+        return;
+    }
     long a1 = gr[REG_RDI], a2 = gr[REG_RSI], a3 = gr[REG_RDX];
     long a4 = gr[REG_R10], a5 = gr[REG_R8], a6 = gr[REG_R9];
+    unsigned long insn_ip = (unsigned long)gr[REG_RIP] - 2; /* rip is past
+                                                the 2-byte syscall insn */
     long ret;
+    int handled = 0;
     /* Guard on g_shm, not g_ready: during the destructor (g_ready==0, shm
-     * still mapped) emulation keeps working, and NOTHING in the trapped
-     * set re-executes natively — a stale filter from a previous exec
-     * generation traps the new shim's text too, so a native re-execution
-     * of a trapped nr could re-trap and recurse. */
-    if (!g_shm) {
+     * still mapped) emulation keeps working.  A trap whose instruction
+     * pointer lies inside OUR OWN text is a raw helper call caught by a
+     * stale seccomp generation (a pre-exec filter whose allow range points
+     * at the previous image): straight to the kernel, never re-dispatched. */
+    if (!g_shm || (insn_ip >= g_text_lo && insn_ip < g_text_hi)) {
         ret = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
-    } else
-        switch (nr) {
-            case SYS_clock_gettime:
-                ret = vdso_repl_clock_gettime((clockid_t)a1,
-                                              (struct timespec *)a2);
-                break;
-            case SYS_gettimeofday:
-                ret = vdso_repl_gettimeofday((struct timeval *)a1, (void *)a2);
-                break;
-            case SYS_time:
-                ret = vdso_repl_time((time_t *)a1);
-                break;
-            case SYS_nanosleep:
-            case SYS_clock_nanosleep: {
-                const struct timespec *req;
-                struct timespec *rem;
-                int64_t ns;
-                if (nr == SYS_nanosleep) {
-                    req = (const struct timespec *)a1;
-                    rem = (struct timespec *)a2;
-                } else {
-                    req = (const struct timespec *)a3;
-                    rem = (struct timespec *)a4;
-                }
-                if (!req) {
-                    ret = -EFAULT;
-                    break;
-                }
-                ns = (int64_t)req->tv_sec * 1000000000ll + req->tv_nsec;
-                if (nr == SYS_clock_nanosleep && (a2 & 1 /* TIMER_ABSTIME */)) {
-                    ns -= (int64_t)sim_now_ns();
-                    if (ns < 0) ns = 0;
-                }
-                if (g_ready) {
-                    int64_t args[6] = {ns, 0, 0, 0, 0, 0};
-                    shim_call(SHIM_OP_NANOSLEEP, args, NULL, 0, NULL, NULL,
-                              NULL);
-                } /* else: dying process, nobody services the channel */
-                if (rem && nr == SYS_nanosleep) {
-                    rem->tv_sec = 0;
-                    rem->tv_nsec = 0;
-                }
-                ret = 0;
-                break;
-            }
-            case SYS_getrandom: {
-                uint8_t *p = (uint8_t *)a1;
-                size_t left = (size_t)a2;
-                if (!p && left) {
-                    ret = -EFAULT;
-                    break;
-                }
-                ret = (long)left;
-                fill_entropy(p, left);
-                break;
-            }
-            default:
-                /* not simulation-owned: run it natively (our helper's
-                 * syscall insn is inside the allowed text range) */
-                ret = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
-        }
+    } else {
+        ret = emu_owned_syscall(nr, a1, a2, a3, a4, a5, a6, &handled);
+        if (!handled) ret = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
+    }
     gr[REG_RAX] = ret;
     errno = saved_errno;
 }
 
+/* sigreturn must itself come from the allowed region: with the dispatch
+ * selector at BLOCK and SIGSYS masked inside the handler, a libc restorer
+ * would trap and the forced SIGSYS would kill the process. */
+__attribute__((naked, used)) static void shim_restore_rt(void) {
+    __asm__ volatile("mov $15, %%rax\n\t" /* SYS_rt_sigreturn */
+                     "syscall" ::: "memory");
+}
+
+/* kernel-facing sigaction (glibc's struct differs; the handler must be
+ * installed with OUR restorer, which libc sigaction does not allow) */
+struct shim_ksigaction {
+    void *handler;
+    unsigned long flags;
+    void (*restorer)(void);
+    uint64_t mask;
+};
+
+#define SHIM_SA_SIGINFO 4UL
+#define SHIM_SA_RESTORER 0x04000000UL
+#define SHIM_SA_ONSTACK 0x08000000UL
+#define SHIM_SA_RESTART 0x10000000UL
+#define SHIM_SA_NODEFER 0x40000000UL
+
+static int install_sigsys_handler(void) {
+    struct shim_ksigaction ksa;
+    memset(&ksa, 0, sizeof(ksa));
+    ksa.handler = (void *)sigsys_handler;
+    /* SA_NODEFER: the dispatcher's wrappers may reach libc internals
+     * (allocators, stdio) whose syscalls trap again — nested handling must
+     * work, as in the reference (shim_seccomp.c SA_NODEFER comment) */
+    ksa.flags = SHIM_SA_SIGINFO | SHIM_SA_RESTORER | SHIM_SA_RESTART |
+                SHIM_SA_NODEFER;
+    ksa.restorer = shim_restore_rt;
+    return (int)shim_raw_syscall6(SYS_rt_sigaction, SIGSYS, (long)&ksa, 0, 8,
+                                  0, 0);
+}
+
+/* -- legacy seccomp filter (fallback for kernels without SUD) ----------- */
+
 static void install_seccomp(void) {
-    if (!dl_iterate_phdr(text_range_cb, NULL) ||
-        (g_text_lo >> 32) != ((g_text_hi - 1) >> 32) ||
+    if ((g_text_lo >> 32) != ((g_text_hi - 1) >> 32) ||
         (uint32_t)g_text_hi == 0) {
         shim_warn("seccomp backstop disabled: shim text range not usable");
-        return;
-    }
-    struct sigaction sa;
-    memset(&sa, 0, sizeof(sa));
-    sa.sa_sigaction = sigsys_handler;
-    sa.sa_flags = SA_SIGINFO | SA_RESTART;
-    sigemptyset(&sa.sa_mask);
-    static int (*real_sigaction_)(int, const struct sigaction *,
-                                  struct sigaction *);
-    if (!real_sigaction_)
-        *(void **)&real_sigaction_ = dlsym(RTLD_NEXT, "sigaction");
-    if (real_sigaction_(SIGSYS, &sa, NULL) != 0) {
-        shim_warn("seccomp backstop disabled: cannot install SIGSYS handler");
         return;
     }
     uint32_t ip_off = 8; /* offsetof(struct seccomp_data, instruction_pointer) */
@@ -611,14 +745,37 @@ static void install_seccomp(void) {
     g_seccomp_on = 1;
 }
 
+/* -- backstop selection ------------------------------------------------- */
+
+static void install_backstop(void) {
+    if (!dl_iterate_phdr(text_range_cb, NULL)) {
+        shim_warn("raw-syscall backstop disabled: shim text not found");
+        return;
+    }
+    if (install_sigsys_handler() != 0) {
+        shim_warn("raw-syscall backstop disabled: cannot install SIGSYS "
+                  "handler");
+        return;
+    }
+    const char *no_sud = getenv("SHADOW_TPU_SUD");
+    if ((!no_sud || strcmp(no_sud, "0") != 0) && sud_arm() == 0) {
+        g_sud_on = 1;
+        g_sud_selector = SYSCALL_DISPATCH_FILTER_BLOCK;
+        return;
+    }
+    /* kernel without syscall-user-dispatch (< 5.11) or SHADOW_TPU_SUD=0:
+     * narrow seccomp trap of the time/sleep/entropy set only */
+    install_seccomp();
+}
+
 /* The app must not displace the SIGSYS backstop — but only when the
- * filter is actually installed here; otherwise apps that sandbox
+ * backstop is actually installed here; otherwise apps that sandbox
  * themselves (own seccomp + SIGSYS handler) must keep working. */
 int sigaction(int signum, const struct sigaction *act,
               struct sigaction *oldact) {
     static int (*real_sa)(int, const struct sigaction *, struct sigaction *);
     if (!real_sa) *(void **)&real_sa = dlsym(RTLD_NEXT, "sigaction");
-    if (g_seccomp_on && signum == SIGSYS && act != NULL) {
+    if ((g_seccomp_on || g_sud_on) && signum == SIGSYS && act != NULL) {
         if (oldact) memset(oldact, 0, sizeof(*oldact));
         return 0; /* accepted and ignored: the backstop stays */
     }
@@ -630,7 +787,7 @@ int sigaction(int signum, const struct sigaction *act,
 sighandler_t signal(int signum, sighandler_t handler) {
     static sighandler_t (*real_signal)(int, sighandler_t);
     if (!real_signal) *(void **)&real_signal = dlsym(RTLD_NEXT, "signal");
-    if (g_seccomp_on && signum == SIGSYS) return SIG_DFL;
+    if ((g_seccomp_on || g_sud_on) && signum == SIGSYS) return SIG_DFL;
     return real_signal(signum, handler);
 }
 
@@ -646,17 +803,19 @@ __attribute__((constructor)) static void shim_init(void) {
     const char *vd = getenv("SHADOW_TPU_VDSO");
     if (!vd || strcmp(vd, "0") != 0) patch_vdso();
     const char *sc = getenv("SHADOW_TPU_SECCOMP");
-    if (!sc || strcmp(sc, "0") != 0) install_seccomp();
+    if (!sc || strcmp(sc, "0") != 0) install_backstop();
     /* report in and wait for the go signal: from here on the plugin only
      * runs while the manager has handed it the turn */
     shim_call(SHIM_OP_START, NULL, NULL, 0, NULL, NULL, NULL);
 }
 
-__attribute__((destructor)) static void shim_fini(void) {
+/* exit() may run on a secondary thread: the manager is waiting on THAT
+ * thread's channel, so the farewell must ride it.  Also invoked by the
+ * raw-syscall dispatcher when an app calls exit_group directly (which
+ * skips destructors). */
+static void send_farewell(void) {
     if (!g_ready) return;
     g_ready = 0;
-    /* exit() may run on a secondary thread: the manager is waiting on THAT
-     * thread's channel, so the farewell must ride it */
     shim_msg *tx = &cur_shm()->to_shadow;
     tx->op = SHIM_OP_EXIT;
     tx->args[0] = g_exit_code;
@@ -664,6 +823,8 @@ __attribute__((destructor)) static void shim_fini(void) {
     tx->payload_len = 0;
     msg_publish(tx); /* no reply: the process is on its way out */
 }
+
+__attribute__((destructor)) static void shim_fini(void) { send_farewell(); }
 
 /* ----------------------------------------------------- virtual fd table */
 
@@ -949,10 +1110,9 @@ int accept4(int fd, struct sockaddr *addr, socklen_t *alen, int flags) {
 
 int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
     if (!is_vfd(fd)) {
-        static int (*real_accept)(int, struct sockaddr *, socklen_t *);
-        if (!real_accept) real_accept = dlsym(RTLD_NEXT, "accept");
         maybe_yield(fd, POLLIN, 0);
-        return real_accept(fd, addr, alen);
+        return (int)raw_ret(shim_raw_syscall6(SYS_accept, fd, (long)addr,
+                                              (long)alen, 0, 0, 0));
     }
     return accept4(fd, addr, alen, 0);
 }
@@ -1068,10 +1228,8 @@ ssize_t sendto(int fd, const void *buf, size_t n, int flags,
 
 ssize_t send(int fd, const void *buf, size_t n, int flags) {
     if (!is_vfd(fd)) {
-        static ssize_t (*real_send)(int, const void *, size_t, int);
-        if (!real_send) real_send = dlsym(RTLD_NEXT, "send");
         maybe_yield(fd, POLLOUT, flags & MSG_DONTWAIT);
-        return real_send(fd, buf, n, flags);
+        return (ssize_t)raw_sendto(fd, buf, n, flags, NULL, 0);
     }
     return vfd_sendto(fd, buf, n, flags, 0, 0);
 }
@@ -1095,8 +1253,8 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
 
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
     if (!is_vfd(fd)) {
-        static ssize_t (*real_recv)(int, void *, size_t, int);
-        if (!real_recv) real_recv = dlsym(RTLD_NEXT, "recv");
+#define real_recv(fd, buf, n, fl) \
+    ((ssize_t)raw_recvfrom(fd, buf, n, fl, NULL, NULL))
         int yieldable = g_ready && fd_is_fifo(fd) && !fd_nonblock(fd) &&
                         !(flags & MSG_DONTWAIT);
         int so_type = 0;
@@ -1121,6 +1279,7 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
         }
         if (yieldable) pipe_wait(fd, POLLIN);
         return real_recv(fd, buf, n, flags);
+#undef real_recv
     }
     return vfd_recvfrom(fd, buf, n, flags, NULL, NULL, NULL);
 }
@@ -1621,9 +1780,7 @@ static void ns_to_ts(int64_t ns, struct timespec *ts) {
 }
 
 int timerfd_create(int clockid, int flags) {
-    static int (*real_tfd)(int, int);
-    if (!real_tfd) *(void **)&real_tfd = dlsym(RTLD_NEXT, "timerfd_create");
-    if (!g_ready) return real_tfd(clockid, flags);
+    if (!g_ready) return (int)raw_timerfd_create(clockid, flags);
     (void)clockid; /* every clock is the one simulated clock */
     int fd = reserve_fd();
     if (fd < 0) return -1;
@@ -1641,10 +1798,8 @@ int timerfd_create(int clockid, int flags) {
 
 int timerfd_settime(int fd, int flags, const struct itimerspec *new_value,
                     struct itimerspec *old_value) {
-    static int (*real_set)(int, int, const struct itimerspec *,
-                           struct itimerspec *);
-    if (!real_set) *(void **)&real_set = dlsym(RTLD_NEXT, "timerfd_settime");
-    if (!is_vfd(fd)) return real_set(fd, flags, new_value, old_value);
+    if (!is_vfd(fd))
+        return (int)raw_timerfd_settime(fd, flags, new_value, old_value);
     if (!new_value) {
         errno = EFAULT;
         return -1;
@@ -1675,9 +1830,7 @@ int timerfd_settime(int fd, int flags, const struct itimerspec *new_value,
 }
 
 int timerfd_gettime(int fd, struct itimerspec *curr) {
-    static int (*real_get)(int, struct itimerspec *);
-    if (!real_get) *(void **)&real_get = dlsym(RTLD_NEXT, "timerfd_gettime");
-    if (!is_vfd(fd)) return real_get(fd, curr);
+    if (!is_vfd(fd)) return (int)raw_timerfd_gettime(fd, curr);
     int64_t args[6] = {fd, 0, 0, 0, 0, 0};
     int64_t reply[6];
     int64_t ret =
@@ -1694,9 +1847,7 @@ int timerfd_gettime(int fd, struct itimerspec *curr) {
 }
 
 int eventfd(unsigned int initval, int flags) {
-    static int (*real_efd)(unsigned int, int);
-    if (!real_efd) *(void **)&real_efd = dlsym(RTLD_NEXT, "eventfd");
-    if (!g_ready) return real_efd(initval, flags);
+    if (!g_ready) return (int)raw_eventfd2(initval, flags);
     int fd = reserve_fd();
     if (fd < 0) return -1;
     int64_t args[6] = {fd, initval, (flags & EFD_SEMAPHORE) != 0, 0, 0, 0};
@@ -2013,6 +2164,9 @@ typedef struct {
 } shim_thread_boot;
 
 static void *shim_thread_tramp(void *p) {
+    /* dispatch is per-thread: arm before anything else (we are in shim
+     * text, so nothing here can escape beforehand) */
+    if (g_sud_on) sud_arm();
     shim_thread_boot boot = *(shim_thread_boot *)p;
     free(p);
     t_shm = boot.shm;
@@ -2049,7 +2203,15 @@ int pthread_create(pthread_t *th, const pthread_attr_t *attr,
     boot->arg = arg;
     boot->shm = shim_map(path);
     boot->vtid = vtid;
+    /* glibc's pthread_create issues a CLONE_VM clone from libc text; that
+     * cannot be re-executed from the SIGSYS handler (the child would
+     * resume mid-handler on the new thread's stack).  Lift dispatch for
+     * the duration: no other simulation thread runs concurrently (strict
+     * turn-taking), and the new thread re-arms itself first thing in the
+     * trampoline. */
+    if (g_sud_on) g_sud_selector = SYSCALL_DISPATCH_FILTER_ALLOW;
     int r = real_create(th, attr, shim_thread_tramp, boot);
+    if (g_sud_on) g_sud_selector = SYSCALL_DISPATCH_FILTER_BLOCK;
     int64_t args[6] = {vtid, r != 0, 0, 0, 0, 0};
     shim_call(SHIM_OP_THREAD_CREATED, args, NULL, 0, NULL, NULL, NULL);
     if (r != 0) {
@@ -2274,6 +2436,12 @@ void exit(int status) {
  * turn-taking the reference enforces per managed thread
  * (managed_thread.rs native_clone).  The child env points at its own
  * channel so an exec'd program's fresh shim re-registers on it. */
+/* Inside glibc's fork the raw clone comes from libc text and traps; the
+ * dispatcher must re-execute it raw (re-arming dispatch on the child
+ * side) instead of recursing into this wrapper.  Thread-local flag
+ * distinguishes that inner clone from an app's own raw fork/clone. */
+static __thread int t_in_fork;
+
 pid_t fork(void) {
     static pid_t (*real_fork)(void);
     if (!real_fork) *(void **)&real_fork = dlsym(RTLD_NEXT, "fork");
@@ -2287,9 +2455,15 @@ pid_t fork(void) {
         return -1;
     }
     path[len] = 0;
+    t_in_fork = 1;
     pid_t pid = real_fork();
+    t_in_fork = 0;
     if (pid < 0) return pid;
     if (pid == 0) {
+        /* dispatch is per-thread state the child did not inherit; re-arm
+         * before any app code runs (under legacy seccomp the filter IS
+         * inherited and nothing is needed) */
+        if (g_sud_on) sud_arm();
         setenv("SHADOW_TPU_SHM", path, 1);
         /* only the calling thread exists in the child (POSIX): it becomes
          * the main thread of a fresh single-threaded process */
@@ -2307,6 +2481,11 @@ pid_t fork(void) {
     shim_call(SHIM_OP_FORKED, args, NULL, 0, NULL, NULL, NULL);
     return pid;
 }
+
+/* vfork's share-the-address-space semantics cannot coexist with the
+ * child-side channel attach; full fork semantics satisfy every correct
+ * vfork user (they may only exec or _exit) */
+pid_t vfork(void) { return fork(); }
 
 /* waitpid must park in SIMULATED time: the child only runs when the sim
  * schedules it, so a native blocking waitpid would deadlock the turn. */
@@ -2366,10 +2545,19 @@ int __libc_start_main(int (*m)(int, char **, char **), int argc, char **av,
  * its internal export list, not libc environ), which would carry the
  * PARENT's channel path into the child program.  Rewrite the env so the
  * exec'd program's fresh shim attaches THIS process's channel. */
+static int raw_execve(const char *path, char *const argv[],
+                      char *const envp[]) {
+    /* raw: reachable from the dispatcher (a raw SYS_execve still gets its
+     * environment rewritten), and SUD resets across exec so the new image
+     * starts clean */
+    return (int)raw_ret(shim_raw_syscall6(SYS_execve, (long)path, (long)argv,
+                                          (long)envp, 0, 0, 0));
+}
+
 static int shim_execve(const char *path, char *const argv[],
                        char *const envp[]) {
-    static int (*real_execve)(const char *, char *const[], char *const[]);
-    if (!real_execve) *(void **)&real_execve = dlsym(RTLD_NEXT, "execve");
+    static int (*real_execve)(const char *, char *const[], char *const[]) =
+        raw_execve;
     if (!g_ready) return real_execve(path, argv, envp);
     const char *shm = getenv("SHADOW_TPU_SHM");
     const char *preload = getenv("LD_PRELOAD");
@@ -2432,9 +2620,7 @@ int execvp(const char *file, char *const argv[]) {
 #include <sys/utsname.h>
 
 int uname(struct utsname *buf) {
-    static int (*real_uname)(struct utsname *);
-    if (!real_uname) *(void **)&real_uname = dlsym(RTLD_NEXT, "uname");
-    int r = real_uname(buf);
+    int r = (int)raw_uname_(buf);
     const char *simname = getenv("SHADOW_TPU_HOSTNAME");
     if (r == 0 && g_ready && simname) {
         snprintf(buf->nodename, sizeof(buf->nodename), "%s", simname);
@@ -2447,8 +2633,6 @@ int uname(struct utsname *buf) {
  * (ancillary/control data is not carried — SCM_RIGHTS over a simulated
  * INET socket has no meaning); real fds keep the yield discipline. */
 ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
-    static ssize_t (*real_recvmsg)(int, struct msghdr *, int);
-    if (!real_recvmsg) *(void **)&real_recvmsg = dlsym(RTLD_NEXT, "recvmsg");
     if (is_vfd(fd)) {
         if (!msg) {
             errno = EFAULT;
@@ -2483,12 +2667,10 @@ ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
         return r;
     }
     maybe_yield(fd, POLLIN, flags & MSG_DONTWAIT);
-    return real_recvmsg(fd, msg, flags);
+    return (ssize_t)raw_recvmsg(fd, msg, flags);
 }
 
 ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
-    static ssize_t (*real_sendmsg)(int, const struct msghdr *, int);
-    if (!real_sendmsg) *(void **)&real_sendmsg = dlsym(RTLD_NEXT, "sendmsg");
     if (is_vfd(fd)) {
         if (!msg) {
             errno = EFAULT;
@@ -2518,15 +2700,13 @@ ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
         return r;
     }
     maybe_yield(fd, POLLOUT, flags & MSG_DONTWAIT);
-    return real_sendmsg(fd, msg, flags);
+    return (ssize_t)raw_sendmsg(fd, msg, flags);
 }
 
 ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
-    static ssize_t (*real_writev)(int, const struct iovec *, int);
-    if (!real_writev) *(void **)&real_writev = dlsym(RTLD_NEXT, "writev");
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLOUT, 0);
-        return real_writev(fd, iov, iovcnt);
+        return (ssize_t)raw_writev(fd, iov, iovcnt);
     }
     ssize_t total = iov_total(iov, iovcnt);
     if (total < 0) {
@@ -2547,11 +2727,9 @@ ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
 }
 
 ssize_t readv(int fd, const struct iovec *iov, int iovcnt) {
-    static ssize_t (*real_readv)(int, const struct iovec *, int);
-    if (!real_readv) *(void **)&real_readv = dlsym(RTLD_NEXT, "readv");
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLIN, 0);
-        return real_readv(fd, iov, iovcnt);
+        return (ssize_t)raw_readv(fd, iov, iovcnt);
     }
     ssize_t total = iov_total(iov, iovcnt);
     if (total < 0) {
@@ -2591,8 +2769,7 @@ static int vfd_dup_common(int oldfd, int newfd) {
 }
 
 int dup(int oldfd) {
-    static int (*real_dup)(int);
-    if (!real_dup) *(void **)&real_dup = dlsym(RTLD_NEXT, "dup");
+#define real_dup(fd) ((int)raw_dup(fd))
     if (is_vfd(oldfd)) {
         int fd = reserve_fd();
         if (fd < 0) return -1;
@@ -2601,11 +2778,11 @@ int dup(int oldfd) {
     int fd = real_dup(oldfd);
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
     return fd;
+#undef real_dup
 }
 
 int dup2(int oldfd, int newfd) {
-    static int (*real_dup2)(int, int);
-    if (!real_dup2) *(void **)&real_dup2 = dlsym(RTLD_NEXT, "dup2");
+#define real_dup2(a, b) ((int)raw_dup2_(a, b))
     if (is_vfd(oldfd)) {
         if (oldfd == newfd) return newfd;
         if (newfd < 0 || newfd >= SHIM_MAX_FDS) {
@@ -2634,11 +2811,11 @@ int dup2(int oldfd, int newfd) {
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
     if (fd >= 0 && g_ready) epoll_forget_fd(fd);
     return fd;
+#undef real_dup2
 }
 
 int dup3(int oldfd, int newfd, int flags) {
-    static int (*real_dup3)(int, int, int);
-    if (!real_dup3) *(void **)&real_dup3 = dlsym(RTLD_NEXT, "dup3");
+#define real_dup3(a, b, c) ((int)raw_dup3_(a, b, c))
     if (is_vfd(oldfd)) {
         if (oldfd == newfd) {
             errno = EINVAL; /* dup3 rejects equal fds, unlike dup2 */
@@ -2651,4 +2828,417 @@ int dup3(int oldfd, int newfd, int flags) {
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
     if (fd >= 0 && g_ready) epoll_forget_fd(fd);
     return fd;
+#undef real_dup3
+}
+
+/* ------------------------------------------------- raw-syscall dispatch */
+
+/* Raw futex virtualization (the manager-side futex table, the reference's
+ * host/futex_table.rs + handler/futex.rs).  Strict turn-taking makes the
+ * classic check-then-park race vanish: no other simulation thread runs
+ * between this thread's value check and the manager parking it, so the
+ * shim can test *uaddr locally (same address space) and ship only the
+ * park/wake to the manager.  PI/robust variants are not virtualized —
+ * they re-execute natively (glibc's pthread surface is interposed at
+ * symbol level, so only exotic direct users reach them). */
+#include <sched.h>
+
+static long shim_futex_emu(long uaddr, long op, long val, long timeout,
+                           long uaddr2, long val3) {
+    /* t_exit_sent: this thread already told the manager it is gone (its
+     * channel is retired); glibc's thread-teardown futexes — e.g. the
+     * main thread parking forever inside pthread_exit — must block
+     * NATIVELY, which is exactly their purpose */
+    if (!g_ready || !uaddr || t_exit_sent)
+        return shim_raw_syscall6(SYS_futex, uaddr, op, val, timeout, uaddr2,
+                                 val3);
+    int cmd = (int)(op & FUTEX_CMD_MASK);
+    switch (cmd) {
+        case FUTEX_WAIT:
+        case FUTEX_WAIT_BITSET: {
+            if (__atomic_load_n((uint32_t *)uaddr, __ATOMIC_SEQ_CST) !=
+                (uint32_t)val)
+                return -EAGAIN;
+            int64_t tns = -1;
+            const struct timespec *ts = (const struct timespec *)timeout;
+            if (ts) {
+                tns = (int64_t)ts->tv_sec * 1000000000ll + ts->tv_nsec;
+                if (cmd == FUTEX_WAIT_BITSET) {
+                    /* BITSET waits take an absolute deadline (monotonic or
+                     * realtime — both are the one simulated clock) */
+                    tns -= (int64_t)sim_now_ns();
+                    if (tns < 0) tns = 0;
+                }
+            }
+            uint32_t bs =
+                cmd == FUTEX_WAIT_BITSET ? (uint32_t)val3 : 0xFFFFFFFFu;
+            int64_t args[6] = {uaddr, tns, (int64_t)bs, 0, 0, 0};
+            return shim_call(SHIM_OP_FUTEX_WAIT, args, NULL, 0, NULL, NULL,
+                             NULL);
+        }
+        case FUTEX_WAKE:
+        case FUTEX_WAKE_BITSET: {
+            uint32_t bs =
+                cmd == FUTEX_WAKE_BITSET ? (uint32_t)val3 : 0xFFFFFFFFu;
+            int64_t args[6] = {uaddr, val, (int64_t)bs, 0, 0, 0};
+            return shim_call(SHIM_OP_FUTEX_WAKE, args, NULL, 0, NULL, NULL,
+                             NULL);
+        }
+        case FUTEX_CMP_REQUEUE:
+            if (__atomic_load_n((uint32_t *)uaddr, __ATOMIC_SEQ_CST) !=
+                (uint32_t)val3)
+                return -EAGAIN;
+            /* fall through */
+        case FUTEX_REQUEUE: {
+            /* for requeue ops the timeout argument slot carries val2 =
+             * max threads to requeue.  Linux returns woken+requeued for
+             * CMP_REQUEUE but only woken for plain REQUEUE. */
+            int64_t args[6] = {uaddr, val, uaddr2, timeout, 0, 0};
+            int64_t reply[6];
+            int64_t woken = shim_call(SHIM_OP_FUTEX_REQUEUE, args, NULL, 0,
+                                      NULL, NULL, reply);
+            if (woken < 0) return woken;
+            return cmd == FUTEX_CMP_REQUEUE ? woken + reply[1] : woken;
+        }
+        case FUTEX_WAKE_OP: {
+            /* modify *uaddr2 locally (turn-taking = no concurrent
+             * mutators), wake uaddr, conditionally wake uaddr2 */
+            uint32_t enc = (uint32_t)val3;
+            int op_ = (enc >> 28) & 0xF;
+            int cmp_ = (enc >> 24) & 0xF;
+            /* 12-bit fields are sign-extended, as the kernel does
+             * (sign_extend32(..., 11)) */
+            int32_t oparg = (int32_t)((enc >> 12) & 0xFFF);
+            int32_t cmparg = (int32_t)(enc & 0xFFF);
+            oparg = (oparg << 20) >> 20;
+            cmparg = (cmparg << 20) >> 20;
+            if (op_ & 8) oparg = 1 << (oparg & 31); /* FUTEX_OP_ARG_SHIFT */
+            uint32_t *p2 = (uint32_t *)uaddr2;
+            if (!p2) return -EFAULT;
+            uint32_t old = *p2;
+            switch (op_ & 7) {
+                case 0: *p2 = (uint32_t)oparg; break;        /* SET */
+                case 1: *p2 = old + (uint32_t)oparg; break;  /* ADD */
+                case 2: *p2 = old | (uint32_t)oparg; break;  /* OR */
+                case 3: *p2 = old & ~(uint32_t)oparg; break; /* ANDN */
+                case 4: *p2 = old ^ (uint32_t)oparg; break;  /* XOR */
+            }
+            int64_t args[6] = {uaddr, val, 0xFFFFFFFFll, 0, 0, 0};
+            long woken =
+                shim_call(SHIM_OP_FUTEX_WAKE, args, NULL, 0, NULL, NULL, NULL);
+            int hit;
+            switch (cmp_) {
+                case 0: hit = old == (uint32_t)cmparg; break; /* EQ */
+                case 1: hit = old != (uint32_t)cmparg; break; /* NE */
+                case 2: hit = old < (uint32_t)cmparg; break;  /* LT */
+                case 3: hit = old <= (uint32_t)cmparg; break; /* LE */
+                case 4: hit = old > (uint32_t)cmparg; break;  /* GT */
+                case 5: hit = old >= (uint32_t)cmparg; break; /* GE */
+                default: hit = 0;
+            }
+            if (hit) {
+                int64_t args2[6] = {uaddr2, timeout, 0xFFFFFFFFll, 0, 0, 0};
+                long w2 = shim_call(SHIM_OP_FUTEX_WAKE, args2, NULL, 0, NULL,
+                                    NULL, NULL);
+                if (w2 > 0) woken += w2;
+            }
+            return woken;
+        }
+        default:
+            return shim_raw_syscall6(SYS_futex, uaddr, op, val, timeout,
+                                     uaddr2, val3);
+    }
+}
+
+/* Adapter: the public wrappers use libc conventions (-1 + errno); the
+ * trapped register must carry -errno. */
+#define WRAPRET(expr)                                                        \
+    do {                                                                     \
+        errno = 0;                                                           \
+        long wr_ = (long)(expr);                                             \
+        return wr_ < 0 && errno ? -(long)errno : wr_;                        \
+    } while (0)
+
+/* The syscall-user-dispatch backstop routes EVERY syscall issued outside
+ * the shim's text here.  Simulation-owned calls reuse the exact logic of
+ * the LD_PRELOAD wrappers above (which themselves fall back to raw kernel
+ * calls for fds the simulation does not own), so raw-syscall binaries —
+ * the reference's Go-runtime scenario (src/test/golang/,
+ * preload-libc/gen_syscall_wrappers_c.py) — see the same semantics
+ * libc-calling binaries see.  `*handled = 0` sends anything else to the
+ * kernel unchanged. */
+static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
+                              long a5, long a6, int *handled) {
+    *handled = 1;
+    switch (nr) {
+        /* ---- time / sleep / entropy (also the legacy-seccomp trap set;
+         * never re-executed natively: under a stale pre-exec filter the
+         * re-execution would re-trap) ---- */
+        case SYS_clock_gettime:
+            return vdso_repl_clock_gettime((clockid_t)a1,
+                                           (struct timespec *)a2);
+        case SYS_gettimeofday:
+            return vdso_repl_gettimeofday((struct timeval *)a1, (void *)a2);
+        case SYS_time:
+            return vdso_repl_time((time_t *)a1);
+        case SYS_nanosleep:
+        case SYS_clock_nanosleep: {
+            const struct timespec *req;
+            struct timespec *rem;
+            if (nr == SYS_nanosleep) {
+                req = (const struct timespec *)a1;
+                rem = (struct timespec *)a2;
+            } else {
+                req = (const struct timespec *)a3;
+                rem = (struct timespec *)a4;
+            }
+            if (!req) return -EFAULT;
+            int64_t ns = (int64_t)req->tv_sec * 1000000000ll + req->tv_nsec;
+            if (nr == SYS_clock_nanosleep && (a2 & 1 /* TIMER_ABSTIME */)) {
+                ns -= (int64_t)sim_now_ns();
+                if (ns < 0) ns = 0;
+            }
+            if (g_ready) {
+                int64_t args[6] = {ns, 0, 0, 0, 0, 0};
+                shim_call(SHIM_OP_NANOSLEEP, args, NULL, 0, NULL, NULL, NULL);
+            } /* else: dying process, nobody services the channel */
+            if (rem && nr == SYS_nanosleep) {
+                rem->tv_sec = 0;
+                rem->tv_nsec = 0;
+            }
+            return 0;
+        }
+        case SYS_getrandom: {
+            uint8_t *p = (uint8_t *)a1;
+            size_t left = (size_t)a2;
+            if (!p && left) return -EFAULT;
+            fill_entropy(p, left);
+            return (long)left;
+        }
+
+        /* ---- sockets ---- */
+        case SYS_socket:
+            WRAPRET(socket((int)a1, (int)a2, (int)a3));
+        case SYS_bind:
+            WRAPRET(bind((int)a1, (const struct sockaddr *)a2,
+                         (socklen_t)a3));
+        case SYS_connect:
+            WRAPRET(connect((int)a1, (const struct sockaddr *)a2,
+                            (socklen_t)a3));
+        case SYS_listen:
+            WRAPRET(listen((int)a1, (int)a2));
+        case SYS_accept:
+            WRAPRET(accept((int)a1, (struct sockaddr *)a2, (socklen_t *)a3));
+        case SYS_accept4:
+            WRAPRET(accept4((int)a1, (struct sockaddr *)a2, (socklen_t *)a3,
+                            (int)a4));
+        case SYS_sendto:
+            WRAPRET(sendto((int)a1, (const void *)a2, (size_t)a3, (int)a4,
+                           (const struct sockaddr *)a5, (socklen_t)a6));
+        case SYS_recvfrom:
+            WRAPRET(recvfrom((int)a1, (void *)a2, (size_t)a3, (int)a4,
+                             (struct sockaddr *)a5, (socklen_t *)a6));
+        case SYS_sendmsg:
+            WRAPRET(sendmsg((int)a1, (const struct msghdr *)a2, (int)a3));
+        case SYS_recvmsg:
+            WRAPRET(recvmsg((int)a1, (struct msghdr *)a2, (int)a3));
+        case SYS_shutdown:
+            WRAPRET(shutdown((int)a1, (int)a2));
+        case SYS_getsockname:
+            WRAPRET(getsockname((int)a1, (struct sockaddr *)a2,
+                                (socklen_t *)a3));
+        case SYS_getpeername:
+            WRAPRET(getpeername((int)a1, (struct sockaddr *)a2,
+                                (socklen_t *)a3));
+        case SYS_setsockopt:
+            WRAPRET(setsockopt((int)a1, (int)a2, (int)a3, (const void *)a4,
+                               (socklen_t)a5));
+        case SYS_getsockopt:
+            WRAPRET(getsockopt((int)a1, (int)a2, (int)a3, (void *)a4,
+                               (socklen_t *)a5));
+
+        /* ---- fd I/O that may hit simulated fds (the wrappers fall back
+         * to raw kernel calls — with the pipe/fifo sim-yield discipline —
+         * for real fds) ---- */
+        case SYS_read:
+            WRAPRET(read((int)a1, (void *)a2, (size_t)a3));
+        case SYS_write:
+            WRAPRET(write((int)a1, (const void *)a2, (size_t)a3));
+        case SYS_readv:
+            WRAPRET(readv((int)a1, (const struct iovec *)a2, (int)a3));
+        case SYS_writev:
+            WRAPRET(writev((int)a1, (const struct iovec *)a2, (int)a3));
+        case SYS_close:
+            WRAPRET(close((int)a1));
+        case SYS_dup:
+            WRAPRET(dup((int)a1));
+        case SYS_dup2:
+            WRAPRET(dup2((int)a1, (int)a2));
+        case SYS_dup3:
+            WRAPRET(dup3((int)a1, (int)a2, (int)a3));
+        case SYS_fcntl:
+            WRAPRET(fcntl((int)a1, (int)a2, a3));
+        case SYS_ioctl:
+            WRAPRET(ioctl((int)a1, (unsigned long)a2, a3));
+
+        /* ---- readiness ---- */
+        case SYS_poll:
+            WRAPRET(poll((struct pollfd *)a1, (nfds_t)a2, (int)a3));
+        case SYS_ppoll:
+            WRAPRET(ppoll((struct pollfd *)a1, (nfds_t)a2,
+                          (const struct timespec *)a3, NULL));
+        case SYS_select:
+            WRAPRET(select((int)a1, (fd_set *)a2, (fd_set *)a3, (fd_set *)a4,
+                           (struct timeval *)a5));
+        case SYS_pselect6: {
+            const struct timespec *ts = (const struct timespec *)a5;
+            struct timeval tv, *tvp = NULL;
+            if (ts) {
+                tv.tv_sec = ts->tv_sec;
+                tv.tv_usec = (ts->tv_nsec + 999) / 1000;
+                tvp = &tv;
+            }
+            WRAPRET(select((int)a1, (fd_set *)a2, (fd_set *)a3, (fd_set *)a4,
+                           tvp));
+        }
+        case SYS_epoll_ctl:
+            WRAPRET(epoll_ctl((int)a1, (int)a2, (int)a3,
+                              (struct epoll_event *)a4));
+        case SYS_epoll_wait:
+            WRAPRET(epoll_wait((int)a1, (struct epoll_event *)a2, (int)a3,
+                               (int)a4));
+        case SYS_epoll_pwait:
+            WRAPRET(epoll_pwait((int)a1, (struct epoll_event *)a2, (int)a3,
+                                (int)a4, NULL));
+
+        /* ---- virtual timerfd/eventfd ---- */
+        case SYS_timerfd_create:
+            WRAPRET(timerfd_create((int)a1, (int)a2));
+        case SYS_timerfd_settime:
+            WRAPRET(timerfd_settime((int)a1, (int)a2,
+                                    (const struct itimerspec *)a3,
+                                    (struct itimerspec *)a4));
+        case SYS_timerfd_gettime:
+            WRAPRET(timerfd_gettime((int)a1, (struct itimerspec *)a2));
+        case SYS_eventfd:
+            WRAPRET(eventfd((unsigned int)a1, 0));
+        case SYS_eventfd2:
+            WRAPRET(eventfd((unsigned int)a1, (int)a2));
+
+        /* ---- futex ---- */
+        case SYS_futex:
+            return shim_futex_emu(a1, a2, a3, a4, a5, a6);
+
+        /* ---- process lifecycle ---- */
+        case SYS_fork:
+        case SYS_vfork:
+            if (t_in_fork) {
+                long r = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
+                if (r == 0 && g_sud_on) sud_arm();
+                return r;
+            }
+            WRAPRET(fork());
+        case SYS_clone: {
+            unsigned long fl = (unsigned long)a1;
+            if (t_in_fork) {
+                /* glibc's fork internals, reached through our wrapper: run
+                 * the clone raw; on the child side dispatch was not
+                 * inherited — re-arm before returning into glibc */
+                long r = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
+                if (r == 0 && g_sud_on) sud_arm();
+                return r;
+            }
+            if (fl & CLONE_VM)
+                /* a raw thread would escape turn-taking entirely, and the
+                 * child of a re-executed CLONE_VM clone would resume on
+                 * the new stack inside our handler frame: refuse (use
+                 * pthreads or plain fork, both fully virtualized) */
+                return -ENOSYS;
+            WRAPRET(fork()); /* fork-like raw clone */
+        }
+        case SYS_clone3: {
+            /* struct clone_args: u64 flags first.  Fork-like clone3 routes
+             * through the fork wrapper; CLONE_VM is refused like SYS_clone
+             * (glibc falls back to clone/fork on ENOSYS) */
+            if (!a1 || (size_t)a2 < 8) return -EINVAL;
+            unsigned long fl3;
+            memcpy(&fl3, (void *)a1, 8);
+            if (t_in_fork) {
+                long r = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
+                if (r == 0 && g_sud_on) sud_arm();
+                return r;
+            }
+            if (fl3 & CLONE_VM) return -ENOSYS;
+            WRAPRET(fork());
+        }
+        case SYS_waitid: {
+            /* map onto the simulated wait path (a native waitid would
+             * block outside the turn and wedge the simulation) */
+            int idtype = (int)a1;
+            siginfo_t *infop = (siginfo_t *)a3;
+            int wopts = (int)a4;
+            if (idtype != P_ALL && idtype != P_PID)
+                return -EINVAL; /* P_PGID/P_PIDFD: not tracked */
+            pid_t wpid = idtype == P_ALL ? -1 : (pid_t)a2;
+            int status = 0;
+            errno = 0;
+            pid_t r = waitpid(wpid, &status,
+                              (wopts & WNOHANG) ? WNOHANG : 0);
+            if (r < 0) return errno ? -(long)errno : -EINVAL;
+            if (infop) {
+                memset(infop, 0, sizeof(*infop));
+                if (r > 0) {
+                    infop->si_signo = SIGCHLD;
+                    infop->si_pid = r;
+                    if (WIFEXITED(status)) {
+                        infop->si_code = CLD_EXITED;
+                        infop->si_status = WEXITSTATUS(status);
+                    } else {
+                        infop->si_code = CLD_KILLED;
+                        infop->si_status = WTERMSIG(status);
+                    }
+                }
+            }
+            return 0;
+        }
+        case SYS_execve:
+            WRAPRET(shim_execve((const char *)a1, (char *const *)a2,
+                                (char *const *)a3));
+        case SYS_wait4:
+            WRAPRET(wait4((pid_t)a1, (int *)a2, (int)a3,
+                          (struct rusage *)a4));
+        case SYS_exit_group:
+            g_exit_code = (int)a1;
+            send_farewell();
+            return shim_raw_syscall6(SYS_exit_group, a1, 0, 0, 0, 0, 0);
+        case SYS_uname:
+            WRAPRET(uname((struct utsname *)a1));
+
+        /* ---- signal-interface protection (kernel structs, not glibc's;
+         * the libc-level sigaction/signal wrappers cover PLT calls) ---- */
+        case SYS_rt_sigaction:
+            if ((int)a1 == SIGSYS && (g_sud_on || g_seccomp_on) && a2) {
+                if (a3) memset((void *)a3, 0, sizeof(struct shim_ksigaction));
+                return 0; /* accepted and ignored: the backstop stays */
+            }
+            *handled = 0;
+            return 0;
+        case SYS_rt_sigprocmask:
+            /* a blocked SIGSYS turns the next dispatch into a forced
+             * kill: strip it from any blocking set */
+            if (g_sud_on && a2 && (size_t)a4 >= 8 &&
+                ((int)a1 == SIG_BLOCK || (int)a1 == SIG_SETMASK)) {
+                uint64_t m;
+                memcpy(&m, (void *)a2, 8);
+                m &= ~(1ull << (SIGSYS - 1));
+                return shim_raw_syscall6(SYS_rt_sigprocmask, a1, (long)&m, a3,
+                                         8, 0, 0);
+            }
+            *handled = 0;
+            return 0;
+
+        default:
+            *handled = 0;
+            return 0;
+    }
 }

@@ -54,6 +54,9 @@ OP_TIMERFD_CREATE = 36
 OP_TIMERFD_SETTIME = 37
 OP_TIMERFD_GETTIME = 38
 OP_EVENTFD_CREATE = 39
+OP_FUTEX_WAIT = 40
+OP_FUTEX_WAKE = 41
+OP_FUTEX_REQUEUE = 42
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
@@ -65,7 +68,8 @@ OP_NAMES = {
     27: "mutex-lock", 28: "mutex-unlock", 29: "cond-wait", 30: "cond-wake",
     31: "sem-init", 32: "sem-wait", 33: "sem-post", 34: "sem-get",
     35: "dup", 36: "timerfd-create", 37: "timerfd-settime",
-    38: "timerfd-gettime", 39: "eventfd-create",
+    38: "timerfd-gettime", 39: "eventfd-create", 40: "futex-wait",
+    41: "futex-wake", 42: "futex-requeue",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)

@@ -138,7 +138,7 @@ class _Proc:
     __slots__ = ("chan", "os_pid", "popen", "parent", "blocked", "sockets",
                  "dead", "label", "saw_start", "cpu_lat", "kind", "vtid",
                  "os_proc", "detached", "main_exited", "mutexes", "conds",
-                 "sems", "thread_retvals")
+                 "sems", "thread_retvals", "futexes")
 
     def __init__(self, chan, os_pid=None, popen=None, parent=None, label="root",
                  kind="proc", vtid=0, os_proc=None):
@@ -166,6 +166,11 @@ class _Proc:
             self.conds: dict[int, list] = {}  # addr -> [(thread, mutex_addr)]
             self.sems: dict[int, list] = {}  # addr -> [value, waiters]
             self.thread_retvals: dict[int, int] = {}  # zombie vtid -> retval
+            # raw-futex wait queues: addr -> [(thread, bitset)], FIFO.
+            # Keyed per OS process: a futex address names memory in ONE
+            # address space (threads share it; fork children's copies are
+            # distinct futexes, as with real private futexes)
+            self.futexes: dict[int, list] = {}
 
     @property
     def pid(self) -> int:
@@ -454,6 +459,17 @@ class ManagedApp:
             proc.blocked = None
             self._reply(api, "sem-wait", -ETIMEDOUT)
             self._service(api, proc)
+        elif kind == "futex" and proc.blocked[2] == deadline:
+            addr = proc.blocked[1]
+            os_p = proc.os_proc
+            q = [e for e in os_p.futexes.get(addr, []) if e[0] is not proc]
+            if q:
+                os_p.futexes[addr] = q
+            else:
+                os_p.futexes.pop(addr, None)
+            proc.blocked = None
+            self._reply(api, "futex-wait", -ETIMEDOUT)
+            self._service(api, proc)
 
     def on_delivery(
         self, api: HostApi, t: int, src: int, seq: int, size: int, payload=None
@@ -655,6 +671,13 @@ class ManagedApp:
                 ev.e_sem = bool(req.args[2])
                 self.sockets[int(req.args[0])] = ev
                 self._reply(api, "eventfd-create", 0)
+            elif op == abi.OP_FUTEX_WAIT:
+                self._op_futex_wait(api, req)
+                return  # always parks (reply arrives at wake/timeout)
+            elif op == abi.OP_FUTEX_WAKE:
+                self._op_futex_wake(api, req)
+            elif op == abi.OP_FUTEX_REQUEUE:
+                self._op_futex_requeue(api, req)
             elif op == abi.OP_CLOSE:
                 self._op_close(api, req)
             else:
@@ -1126,6 +1149,81 @@ class ManagedApp:
     def _op_sem_get(self, api: HostApi, req) -> None:
         s = self._sem(self._cur.os_proc, int(req.args[0]))
         self._reply(api, "sem-get", 0, args=[0, s[0]])
+
+    # -- raw futex (the reference's futex table + FUTEX_* handler,
+    # host/futex_table.rs, handler/futex.rs).  The shim already verified
+    # *addr == expected under the turn-taking guarantee, so WAIT always
+    # parks here; wakes are FIFO for determinism. ------------------------
+
+    def _op_futex_wait(self, api: HostApi, req) -> None:
+        addr = int(req.args[0])
+        timeout = int(req.args[1])
+        bitset = int(req.args[2]) & 0xFFFFFFFF
+        cur = self._cur
+        deadline = None if timeout < 0 else api.now + timeout
+        cur.os_proc.futexes.setdefault(addr, []).append((cur, bitset))
+        self._park(api, ("futex", addr, deadline), deadline)
+
+    def _futex_take(self, os_p: "_Proc", addr: int, maxn: int,
+                    bitset: int) -> list:
+        """Dequeue up to maxn live waiters whose bitset intersects."""
+        q = os_p.futexes.get(addr, [])
+        taken, kept = [], []
+        for entry in q:
+            w, wbs = entry
+            stale = (w.dead or w.blocked is None or w.blocked[0] != "futex"
+                     or w.blocked[1] != addr)
+            if stale:
+                continue  # drop: timed out or died while queued
+            if len(taken) < maxn and (wbs & bitset):
+                taken.append(w)
+            else:
+                kept.append(entry)
+        if kept:
+            os_p.futexes[addr] = kept
+        else:
+            os_p.futexes.pop(addr, None)
+        return taken
+
+    def _op_futex_wake(self, api: HostApi, req) -> None:
+        addr = int(req.args[0])
+        maxn = max(0, int(req.args[1]))
+        bitset = int(req.args[2]) & 0xFFFFFFFF
+        os_p = self._cur.os_proc
+        taken = self._futex_take(os_p, addr, maxn, bitset)
+        self._reply(api, "futex-wake", len(taken))  # waker resumes first
+        for w in taken:
+            w.blocked = None
+            self._resume_granted(api, w, "futex-wait", 0)
+
+    def _op_futex_requeue(self, api: HostApi, req) -> None:
+        addr = int(req.args[0])
+        maxwake = max(0, int(req.args[1]))
+        addr2 = int(req.args[2])
+        maxreq = max(0, int(req.args[3]))
+        os_p = self._cur.os_proc
+        taken = self._futex_take(os_p, addr, maxwake, 0xFFFFFFFF)
+        moved = 0
+        if maxreq > 0:
+            q2 = os_p.futexes.setdefault(addr2, [])
+            for entry in list(os_p.futexes.get(addr, [])):
+                if moved >= maxreq:
+                    break
+                w, wbs = entry
+                os_p.futexes[addr].remove(entry)
+                # keep the original deadline: its fired closure follows the
+                # blocked tuple's addr, which now names the target queue
+                w.blocked = ("futex", addr2, w.blocked[2])
+                q2.append((w, wbs))
+                moved += 1
+            if not os_p.futexes.get(addr):
+                os_p.futexes.pop(addr, None)
+        # ret = woken; args[1] = requeued (the shim applies Linux's
+        # REQUEUE-vs-CMP_REQUEUE return-value difference)
+        self._reply(api, "futex-requeue", len(taken), args=[0, moved])
+        for w in taken:
+            w.blocked = None
+            self._resume_granted(api, w, "futex-wait", 0)
 
     # -- socket ops --------------------------------------------------------
 

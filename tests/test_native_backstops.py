@@ -91,6 +91,30 @@ def test_simulated_interface_identity(tmp_path):
     assert not result.process_errors
 
 
+def test_legacy_seccomp_fallback(tmp_path):
+    """SHADOW_TPU_SUD=0 forces the pre-5.11 fallback (narrow seccomp
+    filter over the time/sleep/entropy set): raw time syscalls still see
+    the simulation."""
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: 1s, seed: 5, data_directory: {tmp_path / 'data'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  solo:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'rawsys'}
+        args: [raw]
+        environment: {{SHADOW_TPU_SUD: "0"}}
+"""
+    )
+    result = Simulation(cfg).run()
+    out = (tmp_path / "data" / "hosts" / "solo" / "rawsys.stdout").read_text()
+    assert f"t0={EPOCH_2000_S}" in out
+    assert "slept_ms=50" in out
+    assert not result.process_errors
+
+
 def test_backstops_can_be_disabled(tmp_path):
     """experimental.use_seccomp/use_vdso_patching=false fall back to plain
     LD_PRELOAD: raw time reads then see the REAL clock (not year 2000),
