@@ -97,11 +97,28 @@ static int run_ifaddrs(void) {
     return 0;
 }
 
+static int run_tsc(void) {
+    /* direct rdtsc/rdtscp: only trap-and-emulate (shim_insn_emu.c analog)
+     * can make these read SIMULATED cycles */
+    unsigned lo, hi;
+    __asm__ volatile("rdtsc" : "=a"(lo), "=d"(hi));
+    unsigned long long t0 = ((unsigned long long)hi << 32) | lo;
+    struct timespec req = {0, 50 * 1000000L};
+    syscall(SYS_nanosleep, (long)&req, 0);
+    unsigned aux;
+    __asm__ volatile("rdtscp" : "=a"(lo), "=d"(hi), "=c"(aux));
+    unsigned long long t1 = ((unsigned long long)hi << 32) | lo;
+    printf("tsc: t0=%llu delta_ms=%llu mono=%d aux=%u\n", t0,
+           (t1 - t0) / 1000000ull, t1 > t0, aux);
+    return 0;
+}
+
 int main(int argc, char **argv) {
     setvbuf(stdout, NULL, _IOLBF, 0);
+    if (argc >= 2 && strcmp(argv[1], "tsc") == 0) return run_tsc();
     if (argc >= 2 && strcmp(argv[1], "raw") == 0) return run_raw();
     if (argc >= 2 && strcmp(argv[1], "vdso") == 0) return run_vdso();
     if (argc >= 2 && strcmp(argv[1], "ifaddrs") == 0) return run_ifaddrs();
-    fprintf(stderr, "usage: rawsys <raw|vdso|ifaddrs>\n");
+    fprintf(stderr, "usage: rawsys <raw|vdso|ifaddrs|tsc>\n");
     return 2;
 }

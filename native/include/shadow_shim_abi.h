@@ -119,6 +119,10 @@ enum {
     SHIM_OP_FUTEX_REQUEUE = 42, /* args[0]=addr args[1]=max-wake
                                    args[2]=dst addr args[3]=max-requeue;
                                    reply ret = woken, args[1] = requeued */
+    SHIM_OP_PREEMPT = 43, /* CPU-time itimer fired (busy loop without
+                             manager calls): args[0] = consumed quantum ns;
+                             the manager charges that much simulated time
+                             before replying (preempt.rs, host/cpu.rs) */
 };
 
 /* poll event bits (mirror Linux poll.h values) */

@@ -768,6 +768,10 @@ static void install_backstop(void) {
     install_seccomp();
 }
 
+static int tsc_chain_sigaction(const struct sigaction *act,
+                               struct sigaction *oldact);
+static int g_tsc_on; /* defined logically with the TSC emulation below */
+
 /* The app must not displace the SIGSYS backstop — but only when the
  * backstop is actually installed here; otherwise apps that sandbox
  * themselves (own seccomp + SIGSYS handler) must keep working. */
@@ -779,6 +783,8 @@ int sigaction(int signum, const struct sigaction *act,
         if (oldact) memset(oldact, 0, sizeof(*oldact));
         return 0; /* accepted and ignored: the backstop stays */
     }
+    if (signum == SIGSEGV && tsc_chain_sigaction(act, oldact))
+        return 0; /* absorbed: the TSC trap stays, app handler chained */
     return real_sa(signum, act, oldact);
 }
 
@@ -788,7 +794,144 @@ sighandler_t signal(int signum, sighandler_t handler) {
     static sighandler_t (*real_signal)(int, sighandler_t);
     if (!real_signal) *(void **)&real_signal = dlsym(RTLD_NEXT, "signal");
     if ((g_seccomp_on || g_sud_on) && signum == SIGSYS) return SIG_DFL;
+    if (signum == SIGSEGV && g_tsc_on) {
+        struct sigaction sa_c;
+        memset(&sa_c, 0, sizeof(sa_c));
+        sa_c.sa_handler = handler;
+        struct sigaction old;
+        tsc_chain_sigaction(&sa_c, &old);
+        return (old.sa_flags & SA_SIGINFO) ? SIG_DFL : old.sa_handler;
+    }
     return real_signal(signum, handler);
+}
+
+/* -- RDTSC/RDTSCP emulation (the reference's shim_insn_emu.c) ----------- */
+/* TSC-reading code (glibc internals, language runtimes, OpenSSL timing
+ * paths) would observe REAL time and silently break determinism.
+ * PR_SET_TSC(PR_TSC_SIGSEGV) makes every rdtsc/rdtscp fault; the handler
+ * decodes the instruction and serves monotone simulated cycles (a 1 GHz
+ * virtual TSC: one cycle per simulated nanosecond).  Faults that are not
+ * TSC reads restore the default disposition and re-execute, so real
+ * crashes still crash.  An app installing its own SIGSEGV handler is
+ * CHAINED: the shim keeps its handler (PR_SET_TSC is per-thread state,
+ * so dropping it on one thread would leave others faulting into the
+ * app's handler) and forwards non-TSC faults to the app's. */
+#ifndef PR_SET_TSC
+#define PR_SET_TSC 26
+#define PR_TSC_ENABLE 1
+#define PR_TSC_SIGSEGV 2
+#endif
+/* the app's own SIGSEGV disposition, chained behind the TSC trap */
+static struct sigaction g_app_segv;
+static int g_app_segv_set;
+
+static void tsc_segv_handler(int sig, siginfo_t *si, void *uctx) {
+    ucontext_t *uc = uctx;
+    greg_t *gr = uc->uc_mcontext.gregs;
+    const uint8_t *ip = (const uint8_t *)gr[REG_RIP];
+    if (g_shm && ip && ip[0] == 0x0F &&
+        (ip[1] == 0x31 || (ip[1] == 0x01 && ip[2] == 0xF9))) {
+        uint64_t cycles = sim_now_ns();
+        gr[REG_RAX] = (greg_t)(cycles & 0xFFFFFFFFull);
+        gr[REG_RDX] = (greg_t)(cycles >> 32);
+        if (ip[1] == 0x01) {
+            gr[REG_RCX] = 0; /* rdtscp: IA32_TSC_AUX = cpu 0 */
+            gr[REG_RIP] += 3;
+        } else {
+            gr[REG_RIP] += 2;
+        }
+        return;
+    }
+    /* a real fault: forward to the app's handler if it installed one */
+    if (g_app_segv_set) {
+        if (g_app_segv.sa_flags & SA_SIGINFO) {
+            if (g_app_segv.sa_sigaction != NULL) {
+                g_app_segv.sa_sigaction(sig, si, uctx);
+                return;
+            }
+        } else if (g_app_segv.sa_handler != SIG_DFL &&
+                   g_app_segv.sa_handler != SIG_IGN) {
+            g_app_segv.sa_handler(sig);
+            return;
+        } else if (g_app_segv.sa_handler == SIG_IGN) {
+            return;
+        }
+    }
+    /* no app handler: restore the default disposition and return — the
+     * faulting instruction re-executes and crashes properly */
+    struct shim_ksigaction dfl;
+    memset(&dfl, 0, sizeof(dfl));
+    shim_raw_syscall6(SYS_rt_sigaction, SIGSEGV, (long)&dfl, 0, 8, 0, 0);
+}
+
+static void tsc_arm(void) {
+    struct shim_ksigaction ksa;
+    memset(&ksa, 0, sizeof(ksa));
+    ksa.handler = (void *)tsc_segv_handler;
+    ksa.flags = SHIM_SA_SIGINFO | SHIM_SA_RESTORER;
+    ksa.restorer = shim_restore_rt;
+    if (shim_raw_syscall6(SYS_rt_sigaction, SIGSEGV, (long)&ksa, 0, 8, 0,
+                          0) != 0)
+        return;
+    if (shim_raw_syscall6(SYS_prctl, PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0,
+                          0) == 0)
+        g_tsc_on = 1;
+}
+
+/* App SIGSEGV registrations chain behind the trap instead of displacing
+ * it (PR_SET_TSC is per-thread: disabling it here would only cover the
+ * calling thread and leave other threads faulting into the app handler
+ * with no emulation).  Returns 1 when the registration was absorbed. */
+static int tsc_chain_sigaction(const struct sigaction *act,
+                               struct sigaction *oldact) {
+    if (!g_tsc_on) return 0;
+    if (oldact) {
+        if (g_app_segv_set) *oldact = g_app_segv;
+        else memset(oldact, 0, sizeof(*oldact));
+    }
+    if (act) {
+        g_app_segv = *act;
+        g_app_segv_set = 1;
+    }
+    return 1;
+}
+
+/* -- busy-loop preemption (the reference's preempt.rs) ------------------ */
+/* A plugin spinning on locally-serviced calls (clock_gettime reads the
+ * shmem clock — no manager hop) would never yield its turn and livelock
+ * the round.  When the CPU model is on, a CPU-time interval timer fires
+ * SIGVTALRM after each quantum of native CPU time and forces a yield that
+ * charges the quantum as simulated time.  shim_call masks SIGVTALRM
+ * during exchanges, so the forced yield only ever lands between calls —
+ * the same deferral discipline as app signal handlers.  Inherently
+ * wall-clock-dependent, so it is config-gated
+ * (general.model_unblocked_syscall_latency), exactly like the reference's
+ * feature. */
+static long g_preempt_ns;
+
+static void preempt_handler(int sig) {
+    (void)sig;
+    if (!g_ready || t_exit_sent) return;
+    int saved_errno = errno;
+    int64_t args[6] = {g_preempt_ns, 0, 0, 0, 0, 0};
+    shim_call(SHIM_OP_PREEMPT, args, NULL, 0, NULL, NULL, NULL);
+    errno = saved_errno;
+}
+
+static void preempt_arm(void) {
+    if (!g_preempt_ns) return;
+    struct shim_ksigaction ksa;
+    memset(&ksa, 0, sizeof(ksa));
+    ksa.handler = (void *)preempt_handler;
+    ksa.flags = SHIM_SA_RESTORER | SHIM_SA_RESTART;
+    ksa.restorer = shim_restore_rt;
+    shim_raw_syscall6(SYS_rt_sigaction, SIGVTALRM, (long)&ksa, 0, 8, 0, 0);
+    struct itimerval itv;
+    itv.it_interval.tv_sec = g_preempt_ns / 1000000000L;
+    itv.it_interval.tv_usec = (g_preempt_ns % 1000000000L) / 1000;
+    itv.it_value = itv.it_interval;
+    shim_raw_syscall6(SYS_setitimer, 1 /* ITIMER_VIRTUAL */, (long)&itv, 0,
+                      0, 0, 0);
 }
 
 __attribute__((constructor)) static void shim_init(void) {
@@ -797,6 +940,8 @@ __attribute__((constructor)) static void shim_init(void) {
     if (!path) return; /* not under the simulator: become a no-op */
     shim_attach(path);
     g_ready = 1;
+    const char *pq = getenv("SHADOW_TPU_PREEMPT_NS");
+    if (pq) g_preempt_ns = atol(pq);
     /* backstops before the first handshake (the reference's init order:
      * shmem -> seccomp -> vdso, shim.c:108-122); default on, disabled via
      * experimental.use_vdso_patching / use_seccomp */
@@ -804,6 +949,9 @@ __attribute__((constructor)) static void shim_init(void) {
     if (!vd || strcmp(vd, "0") != 0) patch_vdso();
     const char *sc = getenv("SHADOW_TPU_SECCOMP");
     if (!sc || strcmp(sc, "0") != 0) install_backstop();
+    const char *tsc = getenv("SHADOW_TPU_TSC");
+    if (!tsc || strcmp(tsc, "0") != 0) tsc_arm();
+    preempt_arm();
     /* report in and wait for the go signal: from here on the plugin only
      * runs while the manager has handed it the turn */
     shim_call(SHIM_OP_START, NULL, NULL, 0, NULL, NULL, NULL);
@@ -2167,6 +2315,7 @@ static void *shim_thread_tramp(void *p) {
     /* dispatch is per-thread: arm before anything else (we are in shim
      * text, so nothing here can escape beforehand) */
     if (g_sud_on) sud_arm();
+    if (g_tsc_on) tsc_arm();
     shim_thread_boot boot = *(shim_thread_boot *)p;
     free(p);
     t_shm = boot.shm;
@@ -2462,8 +2611,11 @@ pid_t fork(void) {
     if (pid == 0) {
         /* dispatch is per-thread state the child did not inherit; re-arm
          * before any app code runs (under legacy seccomp the filter IS
-         * inherited and nothing is needed) */
+         * inherited and nothing is needed).  The CPU-time itimer is also
+         * cleared by fork. */
         if (g_sud_on) sud_arm();
+        if (g_tsc_on) tsc_arm();
+        preempt_arm();
         setenv("SHADOW_TPU_SHM", path, 1);
         /* only the calling thread exists in the child (POSIX): it becomes
          * the main thread of a fresh single-threaded process */

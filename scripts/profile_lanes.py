@@ -99,7 +99,8 @@ def main():
 
 
 def bisect():
-    """Time one jitted round, one jitted iteration, and its halves."""
+    """Time one jitted round and one jitted fused iteration on the pair
+    representation (int32 key words; see lanes.py module docs)."""
     import shadow_tpu.backend.lanes as lanes
 
     cfg = flagship_mesh_config(N, sim_seconds=1, queue_capacity=C, pops_per_round=K)
@@ -111,28 +112,16 @@ def bisect():
     jax.block_until_ready(s1)
     timeit("one full round (jit)", lambda s: round_fn(s)[0], s1)
 
-    # one iteration's pieces on a live state
-    def pops(s):
-        we = jnp.min(s.q_time) + p.runahead
-        popped = {
-            "time": s.q_time[:, :K],
-            "aux": s.q_aux[:, :K],
-            "size": s.q_size[:, :K],
-        }
-        slots = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), popped)
+    iter_fn = lanes._build_iter(p, tb, pure_dataflow=True)
 
-        def scan_body(carry, slot_cols):
-            st, emit = lanes._process_slot(p, tb, carry, slot_cols, we)
-            return st, emit
+    def one_iter(s):
+        we_hi, we_lo = lanes.pair_min_lanes(s.q_thi[:, 0], s.q_tlo[:, 0])
+        we_hi, we_lo = lanes.pair_add32(we_hi, we_lo, p.runahead)
+        return iter_fn(s._replace(now_we_hi=we_hi, now_we_lo=we_lo))
 
-        s, emits = lax.scan(scan_body, s, slots)
-        return s, emits
-
-    scan_fn = jax.jit(lambda s: pops(s)[0])
-    timeit("scan K slots (jit)", scan_fn, s1)
-
-    merge_fn = jax.jit(lambda s: lanes._merge_append(p, *pops(s))[0])
-    timeit("scan + merge (jit)", merge_fn, s1)
+    fused = jax.jit(one_iter)
+    jax.block_until_ready(fused(s1))
+    timeit("one fused iteration (jit)", fused, s1)
 
 
 if __name__ == "__main__":

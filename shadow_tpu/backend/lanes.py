@@ -12,11 +12,11 @@ relay token buckets (relay/token_bucket.rs), and the per-host event queues
 
 - per-lane event queues: ``[N, C]`` arrays kept key-sorted by ``lax.sort``
   (the binary heap's batched equivalent).  The event key ``(time, kind,
-  src, seq)`` lives in the int64 state as ``time`` + a packed ``aux``
-  word, but the SORT pipeline runs on order-preserving **int32 splits**
-  of both (``_t_split``/``_aux_split``): TPU has no native int64, so
-  int32 operands halve the emulation overhead and memory traffic of the
-  merge — the hot path;
+  src, seq)`` is RESIDENT as four order-preserving int32 words
+  (``t_split``/``pack_aux_hi``): TPU has no native int64 — every i64 op
+  lowers to unfusable X64 custom calls — so the whole sort/merge/pop
+  pipeline stays on plain int32 lanes and only the slot arithmetic
+  touches int64, through one join at the pop boundary;
 - the latency/loss lookup as gathers into the dense ``[G, G]`` tables from
   ``net.graph``;
 - Bernoulli loss via the counter-based threefry streams of ``core.rng``
@@ -75,79 +75,194 @@ NEVER = stime.NEVER
 PASSIVE_MODELS = frozenset({M_NONE, M_TGEN_MESH, M_TGEN_CLIENT, M_TGEN_SERVER})
 STREAM_MODELS = frozenset({M_STREAM_CLIENT, M_STREAM_SERVER})
 
-# ---- packed aux word: kind(2b) | src(17b) | seq(44b), sign bit clear ------
-AUX_SEQ_BITS = 44
+# ---- event key representation ---------------------------------------------
+# TPU has no native int64 (every i64 op lowers to X64Split/Combine custom
+# calls that cannot fuse, fragmenting the while body into hundreds of tiny
+# kernels whose per-launch overhead dominates on the tunneled runtime), so
+# the RESIDENT event key is four int32 words whose lexicographic order is
+# the (time, kind, src, seq) total order:
+#
+#   (t_hi, t_lo)     = (time >> 31, time & 0x7FFFFFFF)  — absolute sim ns;
+#                      NEVER maps to (NEVER32, NEVER32)
+#   (aux_hi, aux_lo) = (kind << 29 | src << 12, seq)
+#
+# src < 2**17 lanes (engine-guarded); seq < 2**31 events per source (the
+# engine checks the final counters — 2e9 events per lane is unreachable).
+# This matches the round-1 int64 packing split at bit 32 with the 44-bit
+# seq's high bits always zero, so the event TOTAL ORDER is unchanged and
+# event logs stay bit-identical.
 AUX_SRC_BITS = 17
-AUX_SRC_SHIFT = AUX_SEQ_BITS
-AUX_KIND_SHIFT = AUX_SEQ_BITS + AUX_SRC_BITS
+AUX_SRC_SHIFT = 12
+AUX_KIND_SHIFT = AUX_SRC_SHIFT + AUX_SRC_BITS
 MAX_LANES = 1 << AUX_SRC_BITS
-_SEQ_MASK = (1 << AUX_SEQ_BITS) - 1
 _SRC_MASK = (1 << AUX_SRC_BITS) - 1
 
+NEVER32 = 0x7FFFFFFF  # plain int: no device array at import time
+MASK31 = 0x7FFFFFFF
 
-def pack_aux(kind, src, seq):
-    """(kind, src, seq) -> one int64 aux word preserving lexicographic
-    order.  src < 2**17 (131072 lanes), seq < 2**44 (~17.6e12 events per
-    source — unreachable in practice; TpuEngine guards the lane count)."""
-    i64 = jnp.int64
-    return (
-        (jnp.asarray(kind).astype(i64) << AUX_KIND_SHIFT)
-        | (jnp.asarray(src).astype(i64) << AUX_SRC_SHIFT)
-        | jnp.asarray(seq).astype(i64)
+
+def pack_aux_hi(kind, src):
+    """The (kind, src) high word of the packed key (seq rides aux_lo)."""
+    i32 = jnp.int32
+    return (jnp.asarray(kind).astype(i32) << AUX_KIND_SHIFT) | (
+        jnp.asarray(src).astype(i32) << AUX_SRC_SHIFT
     )
 
 
-def unpack_aux(aux):
-    kind = (aux >> AUX_KIND_SHIFT).astype(jnp.int32)
-    src = ((aux >> AUX_SRC_SHIFT) & _SRC_MASK).astype(jnp.int32)
-    seq = aux & _SEQ_MASK
-    return kind, src, seq
+def unpack_aux_hi(aux_hi):
+    kind = (aux_hi >> AUX_KIND_SHIFT).astype(jnp.int32)
+    src = ((aux_hi >> AUX_SRC_SHIFT) & _SRC_MASK).astype(jnp.int32)
+    return kind, src
+
+
+# int32 pair arithmetic: value = hi * 2**31 + lo with lo in [0, 2**31).
+# All ops fuse (plain int32 lanes), unlike emulated int64.
+
+
+def t_split(t):
+    """Absolute int64 ns -> (hi, lo) int32 pair; NEVER -> (NEVER32, NEVER32).
+    Exact for every 0 <= t < 2**62."""
+    never = t == NEVER
+    hi = jnp.where(never, NEVER32, t >> 31).astype(jnp.int32)
+    lo = jnp.where(never, NEVER32, t & MASK31).astype(jnp.int32)
+    return hi, lo
+
+
+def t_join(hi, lo):
+    """Inverse of t_split (hi == NEVER32 alone marks NEVER: a real event
+    cannot reach 2**62 ns)."""
+    t = (hi.astype(jnp.int64) << 31) | lo.astype(jnp.int64)
+    return jnp.where(hi == NEVER32, NEVER, t)
+
+
+def pair_lt(ahi, alo, bhi, blo):
+    return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+
+
+def pair_ge(ahi, alo, bhi, blo):
+    return ~pair_lt(ahi, alo, bhi, blo)
+
+
+def pair_min_lanes(hi, lo):
+    """Lexicographic min over all elements of an (hi, lo) pair array."""
+    mh = jnp.min(hi)
+    ml = jnp.min(jnp.where(hi == mh, lo, NEVER32))
+    return mh, ml
+
+
+def pair_add32(hi, lo, x):
+    """pair + x for 0 <= x < 2**31 (x int32 scalar or [N])."""
+    t = lo + x  # may wrap into the sign bit: that IS the carry
+    return hi + (t < 0).astype(jnp.int32), t & MASK31
+
+
+def pair_sub32(hi, lo, x):
+    """pair - x for 0 <= x < 2**31; caller guarantees pair >= x.
+    t < 0 means the true low word is t + 2**31, whose int32 bit pattern
+    is t & MASK31 (adding 2**31 just clears the sign bit mod 2**32)."""
+    t = lo - x
+    return hi - (t < 0).astype(jnp.int32), t & MASK31
+
+
+def pair_add_pair(ahi, alo, bhi, blo):
+    t = alo + blo
+    return ahi + bhi + (t < 0).astype(jnp.int32), t & MASK31
+
+
+def pair_max(ahi, alo, bhi, blo):
+    a_wins = pair_ge(ahi, alo, bhi, blo)
+    return jnp.where(a_wins, ahi, bhi), jnp.where(a_wins, alo, blo)
+
+
+def pair_sel(c, ahi, alo, bhi, blo):
+    return jnp.where(c, ahi, bhi), jnp.where(c, alo, blo)
+
+
+def pair_sub_clamp(ahi, alo, bhi, blo, lim):
+    """max(0, min(a - b, lim)) as int32 — exact whenever the true
+    difference lies in [0, lim] (lim < 2**31)."""
+    d = ahi - bhi
+    raw = alo - blo  # in (-2**31, 2**31)
+    ge = pair_ge(ahi, alo, bhi, blo)
+    # d == 1 with raw < 0: value = 2**31 + raw = (raw + 1) + MASK31,
+    # which cannot overflow because raw + 1 <= 0
+    return jnp.where(
+        ~ge,
+        0,
+        jnp.where(
+            d == 0,
+            jnp.minimum(raw, lim),
+            jnp.where(
+                (d == 1) & (raw < 0),
+                jnp.minimum((raw + 1) + MASK31, lim),
+                lim,
+            ),
+        ),
+    )
+
+
+def split64(v):
+    """Non-negative int64 -> (hi, lo) int32 pair (no NEVER handling)."""
+    return (v >> 31).astype(jnp.int32), (v & MASK31).astype(jnp.int32)
 
 
 class LaneState(NamedTuple):
     """The full device-resident simulation state (a pytree of arrays)."""
 
-    # event queues [N, C]
-    q_time: jnp.ndarray  # int64, NEVER = empty slot
-    q_aux: jnp.ndarray  # int64 packed (kind, src, seq)
+    # event queues [N, C]: int32 key words (see the representation note
+    # above); (NEVER32, NEVER32) time pair = empty slot
+    q_thi: jnp.ndarray  # int32 time hi
+    q_tlo: jnp.ndarray  # int32 time lo
+    q_auxh: jnp.ndarray  # int32 kind<<29 | src<<12
+    q_auxl: jnp.ndarray  # int32 seq
     q_size: jnp.ndarray  # int32
-    q_pay: jnp.ndarray  # int64 opaque payload (stream tier); 0 otherwise
-    # per-lane counters [N]
-    send_seq: jnp.ndarray  # int64
-    local_seq: jnp.ndarray  # int64
-    app_draws: jnp.ndarray  # int64
-    # token buckets [N]
-    up_tokens: jnp.ndarray  # int64
-    up_next_refill: jnp.ndarray  # int64
-    up_last_depart: jnp.ndarray  # int64
+    q_pay: jnp.ndarray  # int64 opaque payload (stream tier); () otherwise
+    # per-lane counters [N] — int32 throughout (the engine checks for
+    # wrap at readback: every counter is monotone, so a final negative
+    # value flags > 2**31 increments)
+    send_seq: jnp.ndarray  # int32
+    local_seq: jnp.ndarray  # int32
+    app_draws: jnp.ndarray  # int32
+    # token buckets [N]: token counts int32; time-ish state as int32 pairs
+    up_tokens: jnp.ndarray  # int32 bits
+    up_nr_hi: jnp.ndarray  # int32 pair: next_refill
+    up_nr_lo: jnp.ndarray
+    up_ld_hi: jnp.ndarray  # int32 pair: last_depart
+    up_ld_lo: jnp.ndarray
     dn_tokens: jnp.ndarray
-    dn_next_refill: jnp.ndarray
-    dn_last_depart: jnp.ndarray
-    # CoDel [N]
-    cd_first_above: jnp.ndarray  # int64
-    cd_drop_next: jnp.ndarray  # int64
+    dn_nr_hi: jnp.ndarray
+    dn_nr_lo: jnp.ndarray
+    dn_ld_hi: jnp.ndarray
+    dn_ld_lo: jnp.ndarray
+    # CoDel [N]: first_above/drop_next as int32 pairs (hi == CD_UNSET
+    # marks "not above" — the int64 law's time-0 sentinel)
+    cd_fat_hi: jnp.ndarray
+    cd_fat_lo: jnp.ndarray
+    cd_dnext_hi: jnp.ndarray
+    cd_dnext_lo: jnp.ndarray
     cd_drop_count: jnp.ndarray  # int32
     cd_dropping: jnp.ndarray  # bool
     # app state [N]
-    m_sent: jnp.ndarray  # int64 (ping/tgen-client messages sent)
-    m_peer_offset: jnp.ndarray  # int64 (tgen-mesh RR cursor)
-    # stats [N]
-    n_delivered: jnp.ndarray  # int64
+    m_sent: jnp.ndarray  # int32 (ping/tgen-client messages sent)
+    m_peer_offset: jnp.ndarray  # int32 (tgen-mesh RR cursor)
+    # stats [N] int32
+    n_delivered: jnp.ndarray
     n_loss: jnp.ndarray
     n_codel: jnp.ndarray
     n_queue: jnp.ndarray
     recv_bytes: jnp.ndarray
     n_sends: jnp.ndarray
-    n_hops: jnp.ndarray  # int64: app-processed deliveries (phold hop count)
+    n_hops: jnp.ndarray  # app-processed deliveries (phold hop count)
     # event log [L, 6] + count (L may be 0 = logging off)
     log: jnp.ndarray  # int64 (time, src, dst, seq, size, outcome)
-    log_count: jnp.ndarray  # int64 scalar
-    log_lost: jnp.ndarray  # int64 scalar: records dropped on log overflow
-    # stream tier (lanes_stream.StreamState columns; zeros when unused)
+    log_count: jnp.ndarray  # int32 scalar
+    log_lost: jnp.ndarray  # int32 scalar: records dropped on log overflow
+    # stream tier (lanes_stream.StreamState columns; () when unused)
     stream: Any
     # round bookkeeping (scalars)
-    rounds: jnp.ndarray  # int64
-    now_window_end: jnp.ndarray  # int64 (current round's end)
+    rounds: jnp.ndarray  # int32
+    now_we_hi: jnp.ndarray  # int32 pair: current round's window end
+    now_we_lo: jnp.ndarray
 
 
 @dataclasses.dataclass(frozen=True)
@@ -188,22 +303,29 @@ class LaneParams:
 
 
 class LaneTables(NamedTuple):
-    """Device-resident per-lane constants (not mutated by the sim)."""
+    """Device-resident per-lane constants (not mutated by the sim).
+    Everything on the hot path is int32 (the engine validates magnitudes
+    and raises LaneCompatError out of range — see TpuEngine)."""
 
     node_of: jnp.ndarray  # [N] int32: lane -> graph node index
-    lat: jnp.ndarray  # [G, G] int64 latency ns
+    lat: jnp.ndarray  # [G, G] int32 latency ns (< 2**31 enforced)
     thresh: jnp.ndarray  # [G, G] int64 loss thresholds (u64 domain)
-    up_rate: jnp.ndarray  # [N] int64 bits/interval
-    up_burst: jnp.ndarray  # [N] int64
+    up_rate: jnp.ndarray  # [N] int32 bits/interval
+    up_burst: jnp.ndarray  # [N] int32
+    up_kfull: jnp.ndarray  # [N] int32: intervals that certainly fill burst
+    up_kfi: jnp.ndarray  # [N] int32: up_kfull * interval ns
     dn_rate: jnp.ndarray
     dn_burst: jnp.ndarray
+    dn_kfull: jnp.ndarray
+    dn_kfi: jnp.ndarray
     model: jnp.ndarray  # [N] int32 model id
     p_size: jnp.ndarray  # [N] int32 datagram size
-    p_interval: jnp.ndarray  # [N] int64 timer interval
+    p_int_hi: jnp.ndarray  # [N] int32 pair: timer interval ns
+    p_int_lo: jnp.ndarray
     p_peer: jnp.ndarray  # [N] int32 fixed peer (client models)
-    p_count: jnp.ndarray  # [N] int64 message budget (ping client)
-    p_stride: jnp.ndarray  # [N] int64 (tgen-mesh)
-    codel_div: jnp.ndarray  # [1025] int64
+    p_count: jnp.ndarray  # [N] int32 message budget (ping client)
+    p_stride: jnp.ndarray  # [N] int32 (tgen-mesh)
+    codel_div: jnp.ndarray  # [1025] int32
     st_segs: jnp.ndarray  # [N] int64 stream-client data segments
     st_mss: jnp.ndarray  # [N] int64
     st_last: jnp.ndarray  # [N] int64 final-segment payload bytes
@@ -211,98 +333,160 @@ class LaneTables(NamedTuple):
 
 # --------------------------------------------------------------------------
 # vectorized component laws (identical arithmetic to net/token_bucket.py and
-# net/codel.py — see docs/SEMANTICS.md)
+# net/codel.py — see docs/SEMANTICS.md), on int32 pairs
 # --------------------------------------------------------------------------
 
 
 def bucket_charge_vec(
-    tokens, next_refill, last_depart, rate, burst, t, bits, active, interval
+    tokens, nr_hi, nr_lo, ld_hi, ld_lo, rate, burst, k_full, kfi,
+    t_hi, t_lo, bits, active, interval
 ):
-    """Masked vector form of TokenBucket.charge; returns (tokens',
-    next_refill', last_depart', depart).  FIFO law: the charge clock is
-    ``max(t, last_depart)`` so departures are monotone per lane."""
+    """Masked PAIR-arithmetic form of TokenBucket.charge; returns
+    (tokens', nr_hi', nr_lo', ld_hi', ld_lo', dep_hi, dep_lo).  Identical
+    update law to net/token_bucket.py, with the elapsed-interval count
+    computed exactly:
+
+    - within the k_full horizon (``kfi = k_full * interval`` ns, where
+      ``k_full`` intervals always refill to burst) the elapsed count comes
+      from an int32 clamped pair difference — exact because the clamp only
+      saturates beyond the horizon;
+    - beyond it the refill saturates at burst and next_refill realigns to
+      the first grid point past t (``next_refill ≡ 0 (mod interval)`` is
+      an invariant: the initial value is ``interval`` and every update
+      adds multiples of ``interval``), which needs one int64 mod — the
+      only int64 in the law besides the depart-wait product.
+
+    FIFO law: the charge clock is ``max(t, last_depart)`` so departures
+    are monotone per lane."""
+    i32 = jnp.int32
+    i64 = jnp.int64
     unlimited = rate == 0
     act = active & ~unlimited
-    t = jnp.maximum(t, last_depart)
+    t_hi, t_lo = pair_max(t_hi, t_lo, ld_hi, ld_lo)
 
-    do_refill = act & (t >= next_refill)
-    k = jnp.where(do_refill, (t - next_refill) // interval + 1, 0)
-    tokens = jnp.where(do_refill, jnp.minimum(burst, tokens + k * rate), tokens)
-    next_refill = next_refill + k * interval
+    do_refill = act & pair_ge(t_hi, t_lo, nr_hi, nr_lo)
+    diff = pair_sub_clamp(t_hi, t_lo, nr_hi, nr_lo, kfi)  # int32, exact < kfi
+    full = diff >= kfi
+    k = jnp.where(do_refill, jnp.minimum(diff // interval + 1, k_full), 0)
+    tokens = jnp.where(
+        do_refill, jnp.minimum(burst, tokens + k * rate), tokens
+    )
+    # next_refill': nr + k_true*interval == first grid point past t.
+    # Non-saturated: nr + k*interval (k == k_true).  Saturated: realign
+    # from t's grid phase directly.
+    part_hi, part_lo = pair_add32(nr_hi, nr_lo, k * interval)
+    t64 = t_join(t_hi, t_lo)
+    tmod = (t64 % interval).astype(i32)
+    g_hi, g_lo = pair_add32(*pair_sub32(t_hi, t_lo, tmod), interval)
+    nr_hi = jnp.where(do_refill, jnp.where(full, g_hi, part_hi), nr_hi)
+    nr_lo = jnp.where(do_refill, jnp.where(full, g_lo, part_lo), nr_lo)
 
     have = tokens >= bits
+    wait_lane = act & ~have
     need = jnp.maximum(bits - tokens, 1)
-    w = jnp.where(act & ~have, -(-need // jnp.maximum(rate, 1)), 0)
-    depart = jnp.where(
-        act & ~have, next_refill + (w - 1) * interval, t
-    )
+    w = jnp.where(wait_lane, -(-need // jnp.maximum(rate, 1)), 1)
+    # depart = next_refill' + (w-1)*interval.  The engine guarantees
+    # w*interval < 2**31 (minimum-rate guard: one max-size packet's wait
+    # never exceeds the int32 horizon), so the products stay int32 — an
+    # int64 product here made XLA:CPU's while-loop execution pathological
+    dep_hi, dep_lo = pair_add32(nr_hi, nr_lo, (w - 1) * interval)
+    dep_hi, dep_lo = pair_sel(wait_lane, dep_hi, dep_lo, t_hi, t_lo)
+    # token math caps w at the burst horizon (identical result: beyond it
+    # the refill saturates at burst before subtracting)
+    w_r = jnp.minimum(w, burst // jnp.maximum(rate, 1) + 1)
     new_tokens = jnp.where(
         have,
         tokens - bits,
-        jnp.maximum(0, jnp.minimum(burst, tokens + w * rate) - bits),
+        jnp.maximum(0, jnp.minimum(burst, tokens + w_r * rate) - bits),
     )
     tokens = jnp.where(act, new_tokens, tokens)
-    next_refill = jnp.where(act & ~have, next_refill + w * interval, next_refill)
-    last_depart = jnp.where(act, depart, last_depart)
-    return tokens, next_refill, last_depart, depart
+    nr2_hi, nr2_lo = pair_add32(nr_hi, nr_lo, w * interval)
+    nr_hi = jnp.where(wait_lane, nr2_hi, nr_hi)
+    nr_lo = jnp.where(wait_lane, nr2_lo, nr_lo)
+    ld_hi = jnp.where(act, dep_hi, ld_hi)
+    ld_lo = jnp.where(act, dep_lo, ld_lo)
+    return tokens, nr_hi, nr_lo, ld_hi, ld_lo, dep_hi, dep_lo
 
 
-def codel_offer_vec(state: LaneState, t_deliver, sojourn, active, codel_div):
-    """Masked vector form of CoDel.offer; returns (state', drop_mask)."""
-    fat, dnext, dcount, dropping = (
-        state.cd_first_above,
-        state.cd_drop_next,
-        state.cd_drop_count,
-        state.cd_dropping,
-    )
+# CoDel "first_above" unset sentinel: the int64 law used time 0; with pair
+# state the sentinel is a hi word no real time can reach
+CD_UNSET = -(1 << 31) + 1
+
+
+def codel_offer_vec(state, td_hi, td_lo, sojourn, active, codel_div):
+    """Masked PAIR form of CoDel.offer; returns (state', drop_mask).
+    ``sojourn`` is an int32 clamped difference — exact for every compare
+    in the law (values past the clamp are far above TARGET either way)."""
+    fat_hi, fat_lo = state.cd_fat_hi, state.cd_fat_lo
+    dn_hi, dn_lo = state.cd_dnext_hi, state.cd_dnext_lo
+    dcount, dropping = state.cd_drop_count, state.cd_dropping
+    unset = fat_hi == CD_UNSET
     below = sojourn < codel_mod.TARGET_NS
-    fat_new = jnp.where(
-        below,
-        0,
-        jnp.where(fat == 0, t_deliver + codel_mod.INTERVAL_NS, fat),
+    ent_hi, ent_lo = pair_add32(td_hi, td_lo, codel_mod.INTERVAL_NS)
+    fatn_hi = jnp.where(below, CD_UNSET, jnp.where(unset, ent_hi, fat_hi))
+    fatn_lo = jnp.where(below, 0, jnp.where(unset, ent_lo, fat_lo))
+    ok_to_drop = (
+        active & ~below & ~unset & pair_ge(td_hi, td_lo, fat_hi, fat_lo)
     )
-    ok_to_drop = active & ~below & (fat != 0) & (t_deliver >= fat)
 
     # dropping state machine
-    drop_in_dropping = active & dropping & ok_to_drop & (t_deliver >= dnext)
+    drop_in_dropping = (
+        active & dropping & ok_to_drop & pair_ge(td_hi, td_lo, dn_hi, dn_lo)
+    )
     dcount_d = dcount + drop_in_dropping.astype(dcount.dtype)
     div_idx_d = jnp.minimum(dcount_d, codel_mod.DIV_TABLE_SIZE - 1)
-    dnext_d = jnp.where(drop_in_dropping, dnext + codel_div[div_idx_d], dnext)
+    dnd_hi, dnd_lo = pair_add32(dn_hi, dn_lo, codel_div[div_idx_d])
+    dnd_hi = jnp.where(drop_in_dropping, dnd_hi, dn_hi)
+    dnd_lo = jnp.where(drop_in_dropping, dnd_lo, dn_lo)
 
+    # enter conditions: t_del - dnext < INTERVAL  |  t_del - fat_new >= INTERVAL
+    dni_hi, dni_lo = pair_add32(dn_hi, dn_lo, codel_mod.INTERVAL_NS)
+    fni_hi, fni_lo = pair_add32(fatn_hi, fatn_lo, codel_mod.INTERVAL_NS)
     enter = (
         active
         & ~dropping
         & ok_to_drop
         & (
-            (t_deliver - dnext < codel_mod.INTERVAL_NS)
-            | (t_deliver - fat_new >= codel_mod.INTERVAL_NS)
+            pair_lt(td_hi, td_lo, dni_hi, dni_lo)
+            | pair_ge(td_hi, td_lo, fni_hi, fni_lo)
         )
     )
-    dcount_e = jnp.where(
-        (dcount > 2) & (t_deliver - dnext < codel_mod.INTERVAL_NS), 2, 1
-    ).astype(dcount.dtype)
+    recent = pair_lt(td_hi, td_lo, dni_hi, dni_lo)
+    dcount_e = jnp.where((dcount > 2) & recent, 2, 1).astype(dcount.dtype)
     div_idx_e = jnp.minimum(dcount_e, codel_mod.DIV_TABLE_SIZE - 1)
-    dnext_e = t_deliver + codel_div[div_idx_e]
+    dne_hi, dne_lo = pair_add32(td_hi, td_lo, codel_div[div_idx_e])
 
     drop = drop_in_dropping | enter
-    fat_out = jnp.where(active, fat_new, fat)
-    dropping_out = jnp.where(
-        active, (dropping & ok_to_drop) | enter, dropping
+    fat_out_hi = jnp.where(active, fatn_hi, fat_hi)
+    fat_out_lo = jnp.where(active, fatn_lo, fat_lo)
+    dropping_out = jnp.where(active, (dropping & ok_to_drop) | enter, dropping)
+    dcount_out = jnp.where(
+        enter, dcount_e, jnp.where(drop_in_dropping, dcount_d, dcount)
     )
-    dcount_out = jnp.where(enter, dcount_e, jnp.where(drop_in_dropping, dcount_d, dcount))
-    dnext_out = jnp.where(enter, dnext_e, jnp.where(drop_in_dropping, dnext_d, dnext))
+    dn_out_hi = jnp.where(enter, dne_hi, dnd_hi)
+    dn_out_lo = jnp.where(enter, dne_lo, dnd_lo)
 
     state = state._replace(
-        cd_first_above=fat_out,
-        cd_drop_next=dnext_out,
+        cd_fat_hi=fat_out_hi,
+        cd_fat_lo=fat_out_lo,
+        cd_dnext_hi=dn_out_hi,
+        cd_dnext_lo=dn_out_lo,
         cd_drop_count=dcount_out,
         cd_dropping=dropping_out,
     )
     return state, drop
 
 
-def rand_u32_lane(seed: int, stream, counter):
-    return rng_mod.rand_u32(seed, stream, counter, xp=jnp)
+def rand_u32_lane(seed: int, stream, counter32):
+    """threefry draw with an int32 counter (c1 = 0): bit-identical to
+    core.rng.rand_u32 for counters < 2**32, with no int64 in the path."""
+    s_lo, s_hi = rng_mod._split_seed(seed)
+    u32 = jnp.uint32
+    k0 = u32(s_lo)
+    k1 = (jnp.asarray(stream, dtype=u32) ^ u32(s_hi)).astype(u32)
+    c0 = counter32.astype(u32)
+    c1 = jnp.zeros_like(c0)
+    return rng_mod.threefry2x32(k0, k1, c0, c1, jnp)[0]
 
 
 # --------------------------------------------------------------------------
@@ -311,52 +495,67 @@ def rand_u32_lane(seed: int, stream, counter):
 
 
 def _sort_queues(s: LaneState, with_pay: bool = False) -> LaneState:
-    """Key-sort every lane's queue by (time, aux) — the packed form of the
-    (time, kind, src, seq) total order; empty slots (NEVER) end at the back.
+    """Key-sort every lane's queue by the 4-word key — the split form of
+    the (time, kind, src, seq) total order; empty slots (NEVER pair) end at
+    the back.
 
     Establishes the sorted-row invariant on entry states
     (``TpuEngine.initial_state``) and restores it on iterations that pop
     events but skip the merge (see ``iter_body``).  ``with_pay`` carries the
     stream payload column through the permutation (static: stream tier)."""
     if with_pay:
-        t, aux, size, pay = lax.sort(
-            (s.q_time, s.q_aux, s.q_size, s.q_pay), dimension=1, num_keys=2
+        thi, tlo, ah, al, size, pay = lax.sort(
+            (s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size, s.q_pay),
+            dimension=1, num_keys=4,
         )
-        return s._replace(q_time=t, q_aux=aux, q_size=size, q_pay=pay)
-    t, aux, size = lax.sort(
-        (s.q_time, s.q_aux, s.q_size), dimension=1, num_keys=2
+        return s._replace(q_thi=thi, q_tlo=tlo, q_auxh=ah, q_auxl=al,
+                          q_size=size, q_pay=pay)
+    thi, tlo, ah, al, size = lax.sort(
+        (s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size),
+        dimension=1, num_keys=4,
     )
-    return s._replace(q_time=t, q_aux=aux, q_size=size)
+    return s._replace(q_thi=thi, q_tlo=tlo, q_auxh=ah, q_auxl=al,
+                      q_size=size)
 
 
 class _SlotEmit(NamedTuple):
-    """What one pop-slot step emits (all [N])."""
+    """What one pop-slot step emits (all [N]).  Every event key — time
+    included — is already (hi, lo) int32 words; the only int64 left is
+    the log-record channel (int64 log rows, built only when logging)."""
 
     # same-lane insert channel 1: DELIVERY self-insert (packet pops)
     ins_valid: jnp.ndarray  # bool
-    ins_time: jnp.ndarray  # int64
-    ins_aux: jnp.ndarray  # int64
+    ins_thi: jnp.ndarray  # int32 pair
+    ins_tlo: jnp.ndarray
+    ins_auxh: jnp.ndarray  # int32
+    ins_auxl: jnp.ndarray  # int32
     ins_size: jnp.ndarray  # int32
     ins_pay: jnp.ndarray  # int64
     # same-lane insert channel 2: timer re-arm / stream pump (LOCAL)
     arm_valid: jnp.ndarray
-    arm_time: jnp.ndarray
-    arm_aux: jnp.ndarray
+    arm_thi: jnp.ndarray
+    arm_tlo: jnp.ndarray
+    arm_auxh: jnp.ndarray
+    arm_auxl: jnp.ndarray
     arm_size: jnp.ndarray  # int32 (0 timer, -2 pump)
     arm_pay: jnp.ndarray  # int64 (stream flow id)
     # same-lane insert channel 3: stream RTO arm (LOCAL, size -3)
     arm2_valid: jnp.ndarray
-    arm2_time: jnp.ndarray
-    arm2_aux: jnp.ndarray
+    arm2_thi: jnp.ndarray
+    arm2_tlo: jnp.ndarray
+    arm2_auxh: jnp.ndarray
+    arm2_auxl: jnp.ndarray
     arm2_pay: jnp.ndarray
     # cross-lane channel: outbound packets
     out_valid: jnp.ndarray
     out_dst: jnp.ndarray  # int32
-    out_time: jnp.ndarray
-    out_aux: jnp.ndarray
+    out_thi: jnp.ndarray
+    out_tlo: jnp.ndarray
+    out_auxh: jnp.ndarray
+    out_auxl: jnp.ndarray
     out_size: jnp.ndarray
     out_pay: jnp.ndarray  # int64
-    # log record channel
+    # log record channel (int64; zeros when logging is off)
     rec_valid: jnp.ndarray
     rec_time: jnp.ndarray
     rec_src: jnp.ndarray
@@ -367,14 +566,16 @@ class _SlotEmit(NamedTuple):
 
 
 def _process_slot(
-    p: LaneParams, tb: LaneTables, s: LaneState, slot, window_end
+    p: LaneParams, tb: LaneTables, s: LaneState, slot, we_hi, we_lo
 ) -> tuple[LaneState, _SlotEmit]:
-    """Process one popped queue column (all lanes, masked by kind)."""
+    """Process one popped queue column (all lanes, masked by kind).
+    Every time is an (hi, lo) int32 pair; see the representation note at
+    module top."""
     n = p.n_lanes
     mp = set(p.models_present)
     lanes = jnp.arange(n, dtype=jnp.int32)
-    t = slot["time"]
-    kind, src, seq = unpack_aux(slot["aux"])
+    thi, tlo = slot["thi"], slot["tlo"]
+    kind, src, seq = slot["kind"], slot["src"], slot["seq"]  # int32
     size = slot["size"]
     pay = slot["pay"]
     active = slot["act"]
@@ -382,17 +583,29 @@ def _process_slot(
 
     i64 = jnp.int64
     i32 = jnp.int32
+    sp = p.stream_present
+    # the stream tier's scalar law runs on int64 times (sp-gated edge)
+    t64 = t_join(thi, tlo) if (sp or p.log_capacity) else None
 
     # ---- PACKET pops: down bucket + CoDel -> DELIVERY self-insert --------
     is_pkt = active & (kind == PACKET)
-    bits = (size.astype(i64) + FRAME_OVERHEAD_BYTES) * 8
-    dn_tokens, dn_next, dn_last, t_del = bucket_charge_vec(
-        s.dn_tokens, s.dn_next_refill, s.dn_last_depart, tb.dn_rate, tb.dn_burst,
-        t, bits, is_pkt, p.bucket_interval,
+    bits = (size + FRAME_OVERHEAD_BYTES) * 8  # int32: size <= 64 KiB
+    dn_tokens, dn_nr_hi, dn_nr_lo, dn_ld_hi, dn_ld_lo, td_hi, td_lo = (
+        bucket_charge_vec(
+            s.dn_tokens, s.dn_nr_hi, s.dn_nr_lo, s.dn_ld_hi, s.dn_ld_lo,
+            tb.dn_rate, tb.dn_burst, tb.dn_kfull, tb.dn_kfi,
+            thi, tlo, bits, is_pkt, p.bucket_interval,
+        )
     )
-    s = s._replace(dn_tokens=dn_tokens, dn_next_refill=dn_next, dn_last_depart=dn_last)
-    sojourn = t_del - t
-    s, codel_drop = codel_offer_vec(s, t_del, sojourn, is_pkt, tb.codel_div)
+    s = s._replace(
+        dn_tokens=dn_tokens, dn_nr_hi=dn_nr_hi, dn_nr_lo=dn_nr_lo,
+        dn_ld_hi=dn_ld_hi, dn_ld_lo=dn_ld_lo,
+    )
+    # sojourn only feeds compares against TARGET/INTERVAL: the clamp at
+    # NEVER32 is exact for every branch of the law
+    sojourn = pair_sub_clamp(td_hi, td_lo, thi, tlo, NEVER32)
+    s, codel_drop = codel_offer_vec(s, td_hi, td_lo, sojourn, is_pkt,
+                                    tb.codel_div)
     deliver = is_pkt & ~codel_drop
     s = s._replace(
         n_codel=s.n_codel + (is_pkt & codel_drop),
@@ -408,12 +621,13 @@ def _process_slot(
     inline_del = deliver & passive
     s = s._replace(
         recv_bytes=s.recv_bytes
-        + jnp.where(inline_del & (model != M_NONE), size.astype(i64), 0)
+        + jnp.where(inline_del & (model != M_NONE), size, 0)
     )
     all_passive = mp <= PASSIVE_MODELS
     ins_valid = false_n if all_passive else (deliver & ~passive)
-    ins_time = t_del
-    ins_aux = pack_aux(DELIVERY, src, seq)
+    ins_thi, ins_tlo = td_hi, td_lo
+    ins_auxh = pack_aux_hi(jnp.full(n, DELIVERY, dtype=i32), src)
+    ins_auxl = seq
     ins_size = size
     ins_pay = pay
 
@@ -455,7 +669,8 @@ def _process_slot(
     )
 
     # ---- stream tier (vectorized lane-TCP; static gate) ------------------
-    if p.stream_present:
+    if sp:
+        t = t64
         is_cl = model == M_STREAM_CLIENT
         is_sv = model == M_STREAM_SERVER
         st_any = is_cl | is_sv
@@ -465,7 +680,11 @@ def _process_slot(
         stim_open = is_start & is_cl
         stim_pump = is_loc & (size == lstr.SZ_PUMP) & st_any
         stim_rto = is_loc & (size == lstr.SZ_RTO) & st_any
-        stim_seg = is_del & st_any
+        # pay == 0 is a foreign (non-ltcp) datagram delivered to a stream
+        # lane in a mixed workload: every real segment carries flags != 0.
+        # The CPU oracle ignores those via its isinstance check
+        # (tcpflow.StreamServer.on_delivery) — mirror it exactly
+        stim_seg = is_del & st_any & (pay != 0)
         stream_stim = stim_open | stim_pump | stim_rto | stim_seg
         flow = jnp.where(
             is_sv,
@@ -530,7 +749,7 @@ def _process_slot(
 
     # tgen-mesh round-robin peer
     if M_TGEN_MESH in mp:
-        mesh_off = (s.m_peer_offset % max(n - 1, 1)).astype(i32)
+        mesh_off = s.m_peer_offset % max(n - 1, 1)
         mesh_dst = (lanes + 1 + mesh_off) % n
         s = s._replace(
             m_peer_offset=s.m_peer_offset + jnp.where(mesh_tick, tb.p_stride, 0)
@@ -549,7 +768,7 @@ def _process_slot(
         ),
     ).astype(i32)
     out_size = jnp.where(del_send_echo, size, tb.p_size).astype(i32)
-    if p.stream_present:
+    if sp:
         # server sends go to the flow's client lane; clients to p_peer
         dst = jnp.where(st_send, jnp.where(is_sv, flow, tb.p_peer), dst).astype(i32)
         out_size = jnp.where(st_send, sem.send_size, out_size).astype(i32)
@@ -566,31 +785,40 @@ def _process_slot(
     s = s._replace(send_seq=s.send_seq + do_send, n_sends=s.n_sends + do_send)
 
     # up bucket
-    out_bits = (out_size.astype(i64) + FRAME_OVERHEAD_BYTES) * 8
-    up_tokens, up_next, up_last, t_dep = bucket_charge_vec(
-        s.up_tokens, s.up_next_refill, s.up_last_depart, tb.up_rate, tb.up_burst,
-        t, out_bits, do_send, p.bucket_interval,
+    out_bits = (out_size + FRAME_OVERHEAD_BYTES) * 8
+    up_tokens, up_nr_hi, up_nr_lo, up_ld_hi, up_ld_lo, dep_hi, dep_lo = (
+        bucket_charge_vec(
+            s.up_tokens, s.up_nr_hi, s.up_nr_lo, s.up_ld_hi, s.up_ld_lo,
+            tb.up_rate, tb.up_burst, tb.up_kfull, tb.up_kfi,
+            thi, tlo, out_bits, do_send, p.bucket_interval,
+        )
     )
-    s = s._replace(up_tokens=up_tokens, up_next_refill=up_next, up_last_depart=up_last)
+    s = s._replace(
+        up_tokens=up_tokens, up_nr_hi=up_nr_hi, up_nr_lo=up_nr_lo,
+        up_ld_hi=up_ld_hi, up_ld_lo=up_ld_lo,
+    )
 
     # loss (bootstrap window is loss-free; loss-free graphs skip the draw)
     my_node = tb.node_of
     dst_node = tb.node_of[dst]
-    lat = tb.lat[my_node, dst_node]
+    lat = tb.lat[my_node, dst_node]  # int32
     if p.has_loss:
         u = rand_u32_lane(
             p.seed, (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
             snd_seq,
-        ).astype(jnp.uint64)
+        )
+        bs_hi, bs_lo = p.bootstrap_end >> 31, p.bootstrap_end & MASK31
+        past_bootstrap = pair_ge(thi, tlo, bs_hi, bs_lo)
         thresh = tb.thresh[my_node, dst_node]
-        lost = do_send & (t >= p.bootstrap_end) & (u.astype(i64) < thresh)
+        lost = do_send & past_bootstrap & (u.astype(jnp.uint64).astype(i64) < thresh)
         s = s._replace(n_loss=s.n_loss + lost)
     else:
         lost = false_n
 
-    arr = jnp.maximum(t_dep + lat, window_end)
+    arr_hi, arr_lo = pair_max(*pair_add32(dep_hi, dep_lo, lat), we_hi, we_lo)
     out_valid = do_send & ~lost
-    out_aux = pack_aux(jnp.full(n, PACKET, dtype=i32), lanes, snd_seq)
+    out_auxh = pack_aux_hi(jnp.full(n, PACKET, dtype=i32), lanes)
+    out_auxl = snd_seq
 
     # ---- local arm channels ---------------------------------------------
     has_timer = (
@@ -604,35 +832,47 @@ def _process_slot(
         | (is_timer & (model == M_TGEN_MESH) & (n == 1))
     )
     rearm = rearm_timer | st_pump
-    arm_time = jnp.where(st_pump, t, t + tb.p_interval)
+    ti_hi, ti_lo = pair_add_pair(thi, tlo, tb.p_int_hi, tb.p_int_lo)
+    arm_thi, arm_tlo = pair_sel(st_pump, thi, tlo, ti_hi, ti_lo)
     arm_size = jnp.where(st_pump, lstr.SZ_PUMP, 0).astype(i32)
     arm_pay = jnp.where(st_pump, flow.astype(i64), 0)
-    arm_aux = pack_aux(jnp.full(n, LOCAL, dtype=i32), lanes, s.local_seq)
+    loc_auxh = pack_aux_hi(jnp.full(n, LOCAL, dtype=i32), lanes)
+    arm_auxh = loc_auxh
+    arm_auxl = s.local_seq
     s = s._replace(local_seq=s.local_seq + rearm)
     # stream RTO arm consumes the NEXT local_seq (the CPU driver arms the
     # pump before the RTO inside one stimulus)
     arm2_valid = st_rto
-    arm2_time = sem.rto_time if sem is not None else jnp.zeros(n, dtype=i64)
-    arm2_aux = pack_aux(jnp.full(n, LOCAL, dtype=i32), lanes, s.local_seq)
-    arm2_pay = arm_pay
-    if p.stream_present:
+    if sp:
+        rto64 = sem.rto_time
+        arm2_thi, arm2_tlo = t_split(rto64)
         arm2_pay = jnp.where(st_rto, flow.astype(i64), 0)
         s = s._replace(local_seq=s.local_seq + arm2_valid)
+    else:
+        arm2_thi = jnp.zeros(n, dtype=i32)
+        arm2_tlo = jnp.zeros(n, dtype=i32)
+        arm2_pay = arm_pay
+    arm2_auxh = loc_auxh
+    arm2_auxl = s.local_seq
 
     # ---- log record (≤1 per slot: packet outcome, or send loss) ----------
     rec_valid = pk_rec_valid | lost
-    rec_time = jnp.where(pk_rec_valid, t_del, t)
-    rec_src = jnp.where(pk_rec_valid, src, lanes).astype(i64)
-    rec_dst = jnp.where(pk_rec_valid, lanes, dst).astype(i64)
-    rec_seq = jnp.where(pk_rec_valid, seq, snd_seq)
-    rec_size = jnp.where(pk_rec_valid, size, out_size).astype(i64)
-    rec_outcome = jnp.where(pk_rec_valid, pk_rec_outcome, DROP_LOSS).astype(i64)
+    if p.log_capacity:
+        rec_time = jnp.where(pk_rec_valid, t_join(td_hi, td_lo), t64)
+        rec_src = jnp.where(pk_rec_valid, src, lanes).astype(i64)
+        rec_dst = jnp.where(pk_rec_valid, lanes, dst).astype(i64)
+        rec_seq = jnp.where(pk_rec_valid, seq, snd_seq).astype(i64)
+        rec_size = jnp.where(pk_rec_valid, size, out_size).astype(i64)
+        rec_outcome = jnp.where(pk_rec_valid, pk_rec_outcome, DROP_LOSS).astype(i64)
+    else:
+        z64 = jnp.zeros(n, dtype=i64)
+        rec_time = rec_src = rec_dst = rec_seq = rec_size = rec_outcome = z64
 
     emit = _SlotEmit(
-        ins_valid, ins_time, ins_aux, ins_size, ins_pay,
-        rearm, arm_time, arm_aux, arm_size, arm_pay,
-        arm2_valid, arm2_time, arm2_aux, arm2_pay,
-        out_valid, dst, arr, out_aux, out_size, out_pay,
+        ins_valid, ins_thi, ins_tlo, ins_auxh, ins_auxl, ins_size, ins_pay,
+        rearm, arm_thi, arm_tlo, arm_auxh, arm_auxl, arm_size, arm_pay,
+        arm2_valid, arm2_thi, arm2_tlo, arm2_auxh, arm2_auxl, arm2_pay,
+        out_valid, dst, arr_hi, arr_lo, out_auxh, out_auxl, out_size, out_pay,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
     )
     return s, emit
@@ -680,49 +920,6 @@ def _window_gather(arrs, start, c):
     return out
 
 
-# int32 merge-path packing: TPU has no native int64 (every i64 op is an
-# emulated i32 pair with doubled memory traffic), so the sort/gather
-# pipeline runs on order-preserving int32 SPLITS of the window-relative
-# time and of the packed aux word.  State stays absolute int64, and the
-# split is exact for any event time (no horizon): the high word holds
-# rel >> 31, which only carries entropy for events more than ~2.1 s past
-# the window (long timers, RTO backoff, staggered starts).
-NEVER32 = 0x7FFFFFFF  # plain int: no device array at import time
-
-
-def _t_split(t, mbase):
-    """Absolute int64 ns -> (hi, lo) int32 words whose lexicographic order
-    equals the numeric order of ``t - mbase`` (which is >= 0 for every
-    real queued/emitted event).  NEVER maps to (NEVER32, NEVER32)."""
-    rel = t - mbase
-    never = t == NEVER
-    hi = jnp.where(never, NEVER32, rel >> 31).astype(jnp.int32)
-    lo = jnp.where(never, NEVER32, rel & 0x7FFFFFFF).astype(jnp.int32)
-    return hi, lo
-
-
-def _t_join(hi, lo, mbase):
-    """Inverse of _t_split.  A real event cannot reach hi == NEVER32 (that
-    would be ~2^62 ns past the window), so hi alone marks NEVER."""
-    rel = (hi.astype(jnp.int64) << 31) | lo.astype(jnp.int64)
-    return jnp.where(hi == NEVER32, NEVER, mbase + rel)
-
-
-def _aux_split(aux):
-    """One int64 aux (sign clear) -> two int32 words whose (hi, lo)
-    lexicographic order equals the int64 order.  The low half is biased
-    so its unsigned order survives the signed int32 comparison."""
-    hi = (aux >> 32).astype(jnp.int32)
-    lo = ((aux & 0xFFFFFFFF) - 0x80000000).astype(jnp.int32)
-    return hi, lo
-
-
-def _aux_join(hi, lo):
-    return (hi.astype(jnp.int64) << 32) | (
-        lo.astype(jnp.int64) + 0x80000000
-    )
-
-
 def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     """Append all generated events by **merge**, not scatter (TPU scatters
     serialize; sorts and gathers vectorize):
@@ -733,13 +930,13 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
        a segment gather (``searchsorted`` for each lane's slice bounds) into
        a lane-aligned ``[N, C]`` block — the batched equivalent of the
        reference's cross-host queue push (worker.rs:603-615);
-    3. one row-sort of ``[old C | self 2K | cross C]`` by (time, aux) keeps
-       the first C per lane — the queue's sorted invariant is maintained,
-       so the pop phase needs no sort at all.
+    3. one row-sort of ``[old C | self 2K | cross C]`` by the 4-word key
+       keeps the first C per lane — the queue's sorted invariant is
+       maintained, so the pop phase needs no sort at all.
 
-    The whole pipeline runs on int32 (rel time, split aux — see
-    ``_rel32``/``_aux_split``), converting back to the absolute int64
-    state at the end.
+    The whole pipeline runs on the resident int32 key words; the only
+    conversions left are the emit-time splits at entry (slot times are
+    int64 scalars-per-lane) and the log joins at exit (logging only).
 
     Events pushed past column C are capacity overflow: counted per lane
     (the engine raises in strict mode) and logged as DROP_QUEUE; the merge
@@ -749,39 +946,38 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     n, c = p.n_lanes, p.capacity
     i64 = jnp.int64
     sp = p.stream_present
-    # merge base: the current window's start (window_end is clamped to
-    # stop_time, so this can undershoot the true start — harmless, rel
-    # offsets just grow by the difference)
-    mbase = s.now_window_end - p.runahead
 
     # -- same-lane block [N, 2K] (3K with the stream RTO channel) ----------
     self_parts = [emits.ins_valid.T, emits.arm_valid.T]
-    time_parts = [emits.ins_time.T, emits.arm_time.T]
-    aux_parts = [emits.ins_aux.T, emits.arm_aux.T]
+    thi_parts = [emits.ins_thi.T, emits.arm_thi.T]
+    tlo_parts = [emits.ins_tlo.T, emits.arm_tlo.T]
+    auxh_parts = [emits.ins_auxh.T, emits.arm_auxh.T]
+    auxl_parts = [emits.ins_auxl.T, emits.arm_auxl.T]
     size_parts = [emits.ins_size.T, emits.arm_size.T]
     pay_parts = [emits.ins_pay.T, emits.arm_pay.T]
     if sp:
         self_parts.append(emits.arm2_valid.T)
-        time_parts.append(emits.arm2_time.T)
-        aux_parts.append(emits.arm2_aux.T)
+        thi_parts.append(emits.arm2_thi.T)
+        tlo_parts.append(emits.arm2_tlo.T)
+        auxh_parts.append(emits.arm2_auxh.T)
+        auxl_parts.append(emits.arm2_auxl.T)
         size_parts.append(jnp.full_like(emits.ins_size.T, lstr.SZ_RTO))
         pay_parts.append(emits.arm2_pay.T)
     self_valid = jnp.concatenate(self_parts, axis=1)
-    self_thi, self_tlo = _t_split(
-        jnp.where(self_valid, jnp.concatenate(time_parts, axis=1), NEVER),
-        mbase,
-    )
-    self_auxh, self_auxl = _aux_split(jnp.concatenate(aux_parts, axis=1))
+    self_thi = jnp.where(self_valid, jnp.concatenate(thi_parts, axis=1), NEVER32)
+    self_tlo = jnp.where(self_valid, jnp.concatenate(tlo_parts, axis=1), NEVER32)
+    self_auxh = jnp.concatenate(auxh_parts, axis=1)
+    self_auxl = jnp.concatenate(auxl_parts, axis=1)
     self_size = jnp.concatenate(size_parts, axis=1)
     self_pay = jnp.concatenate(pay_parts, axis=1)
 
     # -- cross-lane block [N, C] via sort-by-dst + segment gather ----------
     valid = emits.out_valid.reshape(-1)
     dst = jnp.where(valid, emits.out_dst.reshape(-1), jnp.int32(n))
-    out_thi, out_tlo = _t_split(emits.out_time.reshape(-1), mbase)
-    out_auxh, out_auxl = _aux_split(emits.out_aux.reshape(-1))
-    flat_ops = [dst, out_thi, out_tlo, out_auxh, out_auxl,
-                emits.out_size.reshape(-1)]
+    out_thi = emits.out_thi.reshape(-1)
+    out_tlo = emits.out_tlo.reshape(-1)
+    flat_ops = [dst, out_thi, out_tlo, emits.out_auxh.reshape(-1),
+                emits.out_auxl.reshape(-1), emits.out_size.reshape(-1)]
     if sp:
         flat_ops.append(emits.out_pay.reshape(-1))
     sorted_ops = lax.sort(tuple(flat_ops), dimension=0, num_keys=1)
@@ -806,15 +1002,14 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     cross_pay = jnp.where(in_seg, gathered[5], 0) if sp else None
     # receivers of more than C events in one iteration lose the tail
     # before the merge even sees it; count those drops too
-    lost_pre = jnp.maximum(cnt - c, 0).astype(i64)
+    lost_pre = jnp.maximum(cnt - c, 0)
 
     # -- merge [N, C + self + C], keep first C ----------------------------
-    q_thi, q_tlo = _t_split(s.q_time, mbase)
-    q_auxh, q_auxl = _aux_split(s.q_aux)
-    mthi = jnp.concatenate([q_thi, self_thi, cross_thi], axis=1)
-    mtlo = jnp.concatenate([q_tlo, self_tlo, cross_tlo], axis=1)
-    mh = jnp.concatenate([q_auxh, self_auxh, cross_auxh], axis=1)
-    ml = jnp.concatenate([q_auxl, self_auxl, cross_auxl], axis=1)
+    # queue state is ALREADY the int32 4-word key: no conversions at all
+    mthi = jnp.concatenate([s.q_thi, self_thi, cross_thi], axis=1)
+    mtlo = jnp.concatenate([s.q_tlo, self_tlo, cross_tlo], axis=1)
+    mh = jnp.concatenate([s.q_auxh, self_auxh, cross_auxh], axis=1)
+    ml = jnp.concatenate([s.q_auxl, self_auxl, cross_auxl], axis=1)
     ms = jnp.concatenate([s.q_size, self_size, cross_size], axis=1)
     if sp:
         mpay = jnp.concatenate([s.q_pay, self_pay, cross_pay], axis=1)
@@ -827,18 +1022,24 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
         )
     tail_mask = mthi[:, c:] != NEVER32
     s = s._replace(
-        q_time=_t_join(mthi[:, :c], mtlo[:, :c], mbase),
-        q_aux=_aux_join(mh[:, :c], ml[:, :c]),
+        q_thi=mthi[:, :c],
+        q_tlo=mtlo[:, :c],
+        q_auxh=mh[:, :c],
+        q_auxl=ml[:, :c],
         q_size=ms[:, :c],
-        n_queue=s.n_queue + tail_mask.sum(axis=1) + lost_pre,
+        n_queue=s.n_queue + tail_mask.sum(axis=1, dtype=jnp.int32)
+        + lost_pre,
     )
     if sp:
         s = s._replace(q_pay=mpay[:, :c])
 
     # overflow log records from the merge tail (pre-gather losses surface
-    # only in n_queue; both paths raise in strict mode)
-    t_tail = _t_join(mthi[:, c:], mtlo[:, c:], mbase)
-    _, o_src, o_seq = unpack_aux(_aux_join(mh[:, c:], ml[:, c:]))
+    # only in n_queue; both paths raise in strict mode).  Only materialized
+    # when logging is on: the int64 joins are edge work the bench never pays
+    if p.log_capacity == 0:
+        return s, None
+    t_tail = t_join(mthi[:, c:], mtlo[:, c:])
+    o_kind, o_src = unpack_aux_hi(mh[:, c:])
     rows = jnp.broadcast_to(
         jnp.arange(n, dtype=jnp.int64)[:, None], tail_mask.shape
     )
@@ -847,20 +1048,19 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
         "time": t_tail.reshape(-1),
         "src": o_src.reshape(-1).astype(i64),
         "dst": rows.reshape(-1),
-        "seq": o_seq.reshape(-1),
+        "seq": ml[:, c:].reshape(-1).astype(i64),
         "size": ms[:, c:].reshape(-1).astype(i64),
         "outcome": jnp.full(tail_mask.size, DROP_QUEUE, dtype=i64),
     }
     return s, over_rec
 
 
-def _append_log(p: LaneParams, s: LaneState, recs: dict) -> LaneState:
+def _append_log(p: LaneParams, s: LaneState, recs) -> LaneState:
     """Append valid records to the device event log (if enabled)."""
-    if p.log_capacity == 0:
+    if p.log_capacity == 0 or recs is None:
         return s
     valid = recs["valid"]
-    m = valid.shape[0]
-    offs = jnp.cumsum(valid.astype(jnp.int64)) - 1
+    offs = jnp.cumsum(valid.astype(jnp.int32)) - 1
     pos = s.log_count + offs
     ok = valid & (pos < p.log_capacity)
     idx = jnp.where(ok, pos, p.log_capacity)
@@ -876,8 +1076,8 @@ def _append_log(p: LaneParams, s: LaneState, recs: dict) -> LaneState:
         axis=1,
     )
     log = s.log.at[idx].set(row, mode="drop")
-    n_valid = valid.sum()
-    n_kept = ok.sum()
+    n_valid = valid.sum(dtype=jnp.int32)
+    n_kept = ok.sum(dtype=jnp.int32)
     return s._replace(
         log=log,
         log_count=s.log_count + n_valid,
@@ -887,7 +1087,7 @@ def _append_log(p: LaneParams, s: LaneState, recs: dict) -> LaneState:
 
 def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
     """Build the raw one-ITERATION advance (pop ≤K, process, merge) against
-    the window already in ``state.now_window_end``.  The step driver wraps
+    the window already in ``state.now_we_hi/lo``.  The step driver wraps
     it in a per-round while (window fixed across iterations); the fused
     full run folds the window advance into a single flat loop.
 
@@ -910,63 +1110,81 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
     passive_ids = sorted(PASSIVE_MODELS & mp_r)
 
     def iter_body(s: LaneState) -> LaneState:
-        # queue rows are kept sorted by (time, aux) — the pop is a slice
-        window_end = s.now_window_end
-        qt = s.q_time[:, :k]
-        kind_cols = (s.q_aux[:, :k] >> AUX_KIND_SHIFT).astype(jnp.int32)
-        same_t = qt == qt[:, :1]
+        # queue rows are kept sorted by the 4-word key — the pop is a slice
+        we_hi, we_lo = s.now_we_hi, s.now_we_lo
+        thi = s.q_thi[:, :k]
+        tlo = s.q_tlo[:, :k]
+        kind_cols = s.q_auxh[:, :k] >> AUX_KIND_SHIFT
+        same_t = (thi == thi[:, :1]) & (tlo == tlo[:, :1])
         pkt_prefix = jnp.cumprod(kind_cols == PACKET, axis=1).astype(bool)
         first_col = (jnp.arange(k) == 0)[None, :]
         passive_lane = jnp.zeros(p.n_lanes, dtype=bool)
         for _mid in passive_ids:
             passive_lane = passive_lane | (tb.model == _mid)
         allowed = passive_lane[:, None] | (same_t & (pkt_prefix | first_col))
+        act = allowed & pair_lt(thi, tlo, we_hi, we_lo)
+        kcol, srccol = unpack_aux_hi(s.q_auxh[:, :k])
         popped = {
-            "time": qt,
-            "aux": s.q_aux[:, :k],
+            "thi": thi,
+            "tlo": tlo,
+            "kind": kcol,
+            "src": srccol,
+            "seq": s.q_auxl[:, :k],
             "size": s.q_size[:, :k],
-            "pay": s.q_pay[:, :k],
-            "act": allowed & (qt < window_end),
+            # without the stream tier there is no payload column at all
+            # (dead carry costs per-iteration wall time); slots still see
+            # a zeros operand, which XLA folds
+            "pay": s.q_pay[:, :k] if p.stream_present
+            else jnp.zeros((p.n_lanes, k), dtype=jnp.int64),
+            "act": act,
         }
         consumed = popped["act"]
         s = s._replace(
-            q_time=s.q_time.at[:, :k].set(
-                jnp.where(consumed, NEVER, popped["time"])
-            )
+            q_thi=s.q_thi.at[:, :k].set(jnp.where(consumed, NEVER32, thi)),
+            q_tlo=s.q_tlo.at[:, :k].set(jnp.where(consumed, NEVER32, tlo)),
         )
 
         # the stream tier's slot body is large: inlining it per slot blows
-        # up XLA compile time, so slot-level conds stay when it's present
-        slot_dataflow = pure_dataflow and not p.stream_present
+        # up XLA:CPU compile time, so slot-level conds stay there.  On the
+        # accelerator the trade inverts hard — device control flow costs a
+        # host round-trip per decision (~100x slower iterations measured
+        # on the mixed mesh) while compile tolerates the inlined body
+        slot_dataflow = pure_dataflow and (
+            not p.stream_present or jax.default_backend() != "cpu"
+        )
 
         def scan_body(carry, slot_cols):
             st = carry
             if slot_dataflow:
                 # _process_slot is fully masked by `act`: unconditional
                 # masked work beats a control decision on the device
-                return _process_slot(p, tb, st, slot_cols, window_end)
+                return _process_slot(p, tb, st, slot_cols, we_hi, we_lo)
 
             def live(st_):
-                return _process_slot(p, tb, st_, slot_cols, window_end)
+                return _process_slot(p, tb, st_, slot_cols, we_hi, we_lo)
 
             def dead(st_):
                 nb = jnp.zeros(p.n_lanes, dtype=bool)
                 z64 = jnp.zeros(p.n_lanes, dtype=jnp.int64)
                 z32 = jnp.zeros(p.n_lanes, dtype=jnp.int32)
                 return st_, _SlotEmit(
-                    nb, z64, z64, z32, z64,
-                    nb, z64, z64, z32, z64,
-                    nb, z64, z64, z64,
-                    nb, z32, z64, z64, z32, z64,
+                    nb, z32, z32, z32, z32, z32, z64,
+                    nb, z32, z32, z32, z32, z32, z64,
+                    nb, z32, z32, z32, z32, z64,
+                    nb, z32, z32, z32, z32, z32, z32, z64,
                     nb, z64, z64, z64, z64, z64, z64,
                 )
 
             return lax.cond(jnp.any(slot_cols["act"]), live, dead, st)
 
         slots = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), popped)  # [K, N]
-        # full unroll: K is small and static; unrolling removes the scan
-        # loop's per-step kernel boundaries so XLA fuses across slots
-        s, emits = lax.scan(scan_body, s, slots, unroll=k)
+        # On TPU, full unroll removes the scan loop's per-step kernel
+        # boundaries so XLA fuses across slots.  On CPU the duplicated
+        # slot bodies multiply the HLO op count, and XLA:CPU pays a
+        # per-op thunk dispatch — K=8 unrolled made tiny parity runs
+        # hundreds of times slower than the rolled loop.
+        slot_unroll = k if jax.default_backend() != "cpu" else 1
+        s, emits = lax.scan(scan_body, s, slots, unroll=slot_unroll)
 
         if pure_dataflow:
             # always merge: a merge whose insert channels are all empty
@@ -1017,13 +1235,16 @@ def _build_round(p: LaneParams, tb: LaneTables):
     iter_body = _build_iter(p, tb)
 
     def round_fn(s: LaneState) -> tuple[LaneState, jnp.ndarray]:
-        start = jnp.min(s.q_time[:, 0])  # rows sorted: col 0 is the min
+        # rows sorted: col 0 is each lane's min; lexicographic pair min
+        start = t_join(*pair_min_lanes(s.q_thi[:, 0], s.q_tlo[:, 0]))
         done = start >= p.stop_time
         window_end = jnp.minimum(start + p.runahead, p.stop_time)
-        s = s._replace(now_window_end=window_end)
+        we_hi, we_lo = t_split(window_end)
+        s = s._replace(now_we_hi=we_hi, now_we_lo=we_lo)
 
         def cond(st: LaneState):
-            return jnp.min(st.q_time[:, 0]) < st.now_window_end
+            mh, ml = pair_min_lanes(st.q_thi[:, 0], st.q_tlo[:, 0])
+            return pair_lt(mh, ml, st.now_we_hi, st.now_we_lo)
 
         def body(st: LaneState):
             return iter_body(st)
@@ -1043,55 +1264,105 @@ def make_round_fn(p: LaneParams, tb: LaneTables):
     return jax.jit(_build_round(p, tb))
 
 
+# -- while-carry packing -----------------------------------------------------
+# The tunneled runtime pays a per-BUFFER cost on every while iteration
+# (measured: an identity-body loop over the ~32-leaf LaneState costs
+# ~0.65 ms/iter while small-tuple carries are microseconds), so the fused
+# run packs the carry into a handful of stacked arrays at the loop
+# boundary.  Slicing them apart inside the body fuses into the consumers;
+# restacking is one concatenate per group.
+
+_I32_N_FIELDS = (
+    "send_seq", "local_seq", "app_draws",
+    "up_tokens", "up_nr_hi", "up_nr_lo", "up_ld_hi", "up_ld_lo",
+    "dn_tokens", "dn_nr_hi", "dn_nr_lo", "dn_ld_hi", "dn_ld_lo",
+    "cd_fat_hi", "cd_fat_lo", "cd_dnext_hi", "cd_dnext_lo",
+    "cd_drop_count",
+    "m_sent", "m_peer_offset",
+    "n_delivered", "n_loss", "n_codel", "n_queue", "recv_bytes",
+    "n_sends", "n_hops",
+)
+_SCALAR_FIELDS = ("log_count", "log_lost", "rounds", "now_we_hi", "now_we_lo")
+
+
+def pack_state(s: LaneState):
+    q = jnp.stack([s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size])
+    c32 = jnp.stack(
+        [getattr(s, f) for f in _I32_N_FIELDS]
+        + [s.cd_dropping.astype(jnp.int32)]
+    )
+    sc = jnp.stack(
+        [jnp.asarray(getattr(s, f), dtype=jnp.int32) for f in _SCALAR_FIELDS]
+    )
+    return (q, c32, sc, s.log, s.q_pay, s.stream)
+
+
+def unpack_state(carry) -> LaneState:
+    q, c32, sc, log, q_pay, stream = carry
+    kw = {f: c32[i] for i, f in enumerate(_I32_N_FIELDS)}
+    kw.update({f: sc[i] for i, f in enumerate(_SCALAR_FIELDS)})
+    return LaneState(
+        q_thi=q[0], q_tlo=q[1], q_auxh=q[2], q_auxl=q[3], q_size=q[4],
+        q_pay=q_pay, stream=stream,
+        cd_dropping=c32[len(_I32_N_FIELDS)].astype(bool),
+        log=log, **kw,
+    )
+
+
 def _build_full_run(p: LaneParams, tb: LaneTables):
     """Raw (un-jitted) full-simulation run, entirely on-device.
 
     ONE flat ``lax.while_loop`` whose body both advances the window (only
     when the previous window is exhausted — the identical window sequence
     of the nested per-round form, so arrival bumps and event logs stay
-    bit-identical) and pops/processes/merges one iteration of events.
-    Collapsing the former rounds-while around an iterations-while matters
-    because each while iteration costs a host↔device round-trip on the
-    tunneled runtime (~350 µs): the common one-iteration window now pays
-    for one iteration, not three.  Shared by the single-device and sharded
-    drivers."""
+    bit-identical) and pops/processes/merges one iteration of events, over
+    the PACKED carry (see pack_state).  Shared by the single-device and
+    sharded drivers."""
     iter_fn = _build_iter(p, tb, pure_dataflow=True)
 
     # steps per while-loop trip (p.unroll, experimental.tpu_round_unroll):
-    # each loop iteration costs ~350 us of host round-trip on the tunneled
-    # runtime, so several window-advance+pop steps can run per trip.
-    # Steps past the end are harmless no-ops (the saturated window admits
-    # no pops), so no per-step guard is needed.
+    # several window-advance+pop steps can run per trip to amortize the
+    # per-iteration overhead.  Steps past the end are harmless no-ops (the
+    # saturated window admits no pops), so no per-step guard is needed.
     unroll = max(int(p.unroll), 1)
 
+    stop_hi, stop_lo = p.stop_time >> 31, p.stop_time & MASK31
+
     def full_run(s: LaneState) -> LaneState:
-        def cond(st: LaneState):
-            return jnp.min(st.q_time[:, 0]) < p.stop_time
+        def cond(carry):
+            q = carry[0]
+            mh, ml = pair_min_lanes(q[0, :, 0], q[1, :, 0])
+            return pair_lt(mh, ml, stop_hi, stop_lo)
 
         def step(st: LaneState):
-            min_next = jnp.min(st.q_time[:, 0])
-            live = min_next < p.stop_time
-            fresh = (min_next >= st.now_window_end) & live
-            window_end = jnp.where(
-                fresh,
-                # clamp before adding: min_next may be NEVER on a no-op
-                # trailing step, and NEVER + runahead would wrap
-                jnp.minimum(jnp.minimum(min_next, p.stop_time) + p.runahead,
-                            p.stop_time),
-                st.now_window_end,
+            mn_hi, mn_lo = pair_min_lanes(st.q_thi[:, 0], st.q_tlo[:, 0])
+            live = pair_lt(mn_hi, mn_lo, stop_hi, stop_lo)
+            fresh = pair_ge(mn_hi, mn_lo, st.now_we_hi, st.now_we_lo) & live
+            # clamp before adding runahead: min_next may be the NEVER pair
+            # on a no-op trailing step
+            c_hi, c_lo = pair_sel(
+                pair_lt(mn_hi, mn_lo, stop_hi, stop_lo),
+                mn_hi, mn_lo, stop_hi, stop_lo,
+            )
+            c_hi, c_lo = pair_add32(c_hi, c_lo, p.runahead)
+            c_hi, c_lo = pair_sel(
+                pair_lt(c_hi, c_lo, stop_hi, stop_lo),
+                c_hi, c_lo, stop_hi, stop_lo,
             )
             st = st._replace(
-                now_window_end=window_end,
+                now_we_hi=jnp.where(fresh, c_hi, st.now_we_hi),
+                now_we_lo=jnp.where(fresh, c_lo, st.now_we_lo),
                 rounds=st.rounds + fresh.astype(st.rounds.dtype),
             )
             return iter_fn(st)
 
-        def body(st: LaneState):
+        def body(carry):
+            st = unpack_state(carry)
             for _ in range(unroll):
                 st = step(st)
-            return st
+            return pack_state(st)
 
-        return lax.while_loop(cond, body, s)
+        return unpack_state(lax.while_loop(cond, body, pack_state(s)))
 
     return full_run
 

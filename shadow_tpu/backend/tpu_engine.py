@@ -182,21 +182,74 @@ class TpuEngine:
 
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)
         dn = np.array([bucket_params(int(b)) for b in bw_dn], dtype=np.int64)
+
+        # int32 magnitude guards: the lane kernel's pair arithmetic is
+        # exact only within these (generous) ranges — reject configs
+        # beyond them instead of silently diverging
+        interval = lanes.DEFAULT_INTERVAL_NS
+        i32max = (1 << 31) - 1
+
+        def _check(name, arr, limit):
+            mx = int(np.max(arr)) if np.size(arr) else 0
+            if mx > limit:
+                raise LaneCompatError(
+                    f"{name} {mx} exceeds the lane backend's int32 range "
+                    f"({limit}); use the cpu backend"
+                )
+
+        _check("link latency (ns)", np.asarray(lat), i32max)
+        _check("runahead (ns)", np.asarray([runahead]), i32max)
+        for side, b in (("up", up), ("dn", dn)):
+            # the refill computes tokens + k*rate <= 2*burst + rate before
+            # clamping to burst: THAT intermediate must fit int32
+            _check(f"{side} bucket refill ceiling (2*burst + rate)",
+                   2 * b[:, 1] + b[:, 0], i32max)
+        _check("datagram size", p_size, 1 << 20)
+        # one max-size packet's bucket wait must fit the int32 horizon:
+        # w = ceil(bits/rate) intervals, w*interval < 2**31
+        max_bits = (int(np.max(p_size, initial=0)) + 65536 + 38) * 8
+        for side, b in (("up", up), ("dn", dn)):
+            rates = b[:, 0][b[:, 0] > 0]
+            if rates.size:
+                w_max = -(-max_bits // int(rates.min()))
+                if w_max * interval > i32max:
+                    raise LaneCompatError(
+                        f"{side} bandwidth {int(rates.min())} bits/interval is "
+                        "too low for the lane backend's int32 wait horizon "
+                        "(one packet would wait > 2.1 s for tokens); use the "
+                        "cpu backend"
+                    )
+
+        def _kfull(b):
+            rate = np.maximum(b[:, 0], 1)
+            kf = b[:, 1] // rate + 1
+            kfi = kf * interval
+            _check("bucket full-refill horizon (ns)", kfi, i32max)
+            return kf.astype(np.int32), kfi.astype(np.int32)
+
+        up_kfull, up_kfi = _kfull(up)
+        dn_kfull, dn_kfi = _kfull(dn)
+        i32 = jnp.int32
         self.tables = lanes.LaneTables(
-            node_of=jnp.asarray(node_idx, dtype=jnp.int32),
-            lat=jnp.asarray(lat),
+            node_of=jnp.asarray(node_idx, dtype=i32),
+            lat=jnp.asarray(lat, dtype=i32),
             thresh=jnp.asarray(thresh),
-            up_rate=jnp.asarray(up[:, 0]),
-            up_burst=jnp.asarray(up[:, 1]),
-            dn_rate=jnp.asarray(dn[:, 0]),
-            dn_burst=jnp.asarray(dn[:, 1]),
+            up_rate=jnp.asarray(up[:, 0], dtype=i32),
+            up_burst=jnp.asarray(up[:, 1], dtype=i32),
+            up_kfull=jnp.asarray(up_kfull),
+            up_kfi=jnp.asarray(up_kfi),
+            dn_rate=jnp.asarray(dn[:, 0], dtype=i32),
+            dn_burst=jnp.asarray(dn[:, 1], dtype=i32),
+            dn_kfull=jnp.asarray(dn_kfull),
+            dn_kfi=jnp.asarray(dn_kfi),
             model=jnp.asarray(model),
             p_size=jnp.asarray(p_size),
-            p_interval=jnp.asarray(p_interval),
+            p_int_hi=jnp.asarray(p_interval >> 31, dtype=i32),
+            p_int_lo=jnp.asarray(p_interval & lanes.MASK31, dtype=i32),
             p_peer=jnp.asarray(p_peer),
-            p_count=jnp.asarray(p_count),
-            p_stride=jnp.asarray(p_stride),
-            codel_div=jnp.asarray(np.array(codel_mod.CODEL_DIV, dtype=np.int64)),
+            p_count=jnp.asarray(np.minimum(p_count, i32max), dtype=i32),
+            p_stride=jnp.asarray(p_stride, dtype=i32),
+            codel_div=jnp.asarray(np.array(codel_mod.CODEL_DIV, dtype=np.int32)),
             st_segs=jnp.asarray(st_segs),
             st_mss=jnp.asarray(st_mss),
             st_last=jnp.asarray(st_last),
@@ -216,69 +269,98 @@ class TpuEngine:
         p = self.params
         n, c = p.n_lanes, p.capacity
         q_time = np.full((n, c), NEVER, dtype=np.int64)
-        q_aux = np.zeros((n, c), dtype=np.int64)
+        q_auxh = np.zeros((n, c), dtype=np.int32)
+        q_auxl = np.zeros((n, c), dtype=np.int32)
         q_size = np.zeros((n, c), dtype=np.int32)
         fill = np.zeros(n, dtype=np.int64)
         for lane, t, kind, src, seq, size in self._init_events:
             i = fill[lane]
             q_time[lane, i] = t
-            q_aux[lane, i] = (
-                (kind << lanes.AUX_KIND_SHIFT) | (src << lanes.AUX_SRC_SHIFT) | seq
+            q_auxh[lane, i] = (kind << lanes.AUX_KIND_SHIFT) | (
+                src << lanes.AUX_SRC_SHIFT
             )
+            q_auxl[lane, i] = seq
             q_size[lane, i] = size
             fill[lane] += 1
-        # the round kernel keeps queue rows sorted by (time, aux) as an
-        # invariant; establish it here
-        order = np.lexsort((q_aux, q_time), axis=1)
+        # the round kernel keeps queue rows sorted by the 4-word key as an
+        # invariant; establish it here (aux_lo before aux_hi: np.lexsort
+        # takes the PRIMARY key last)
+        order = np.lexsort((q_auxl, q_auxh, q_time), axis=1)
         q_time = np.take_along_axis(q_time, order, axis=1)
-        q_aux = np.take_along_axis(q_aux, order, axis=1)
+        q_auxh = np.take_along_axis(q_auxh, order, axis=1)
+        q_auxl = np.take_along_axis(q_auxl, order, axis=1)
         q_size = np.take_along_axis(q_size, order, axis=1)
+        never = q_time == NEVER
+        q_thi = np.where(never, lanes.NEVER32, q_time >> 31).astype(np.int32)
+        q_tlo = np.where(never, lanes.NEVER32, q_time & lanes.MASK31).astype(
+            np.int32
+        )
 
         from . import lanes_stream as lstr
 
-        stream0 = lstr.init_stream_state(
-            n,
-            np.asarray(self.tables.st_segs),
-            np.asarray(self.tables.st_mss),
-            np.asarray(self.tables.st_last),
+        # no stream tier -> no stream columns AND no payload column: the
+        # while-loop carry pays a per-buffer cost every iteration on the
+        # tunneled runtime, so ~40 dead zero arrays are real wall time
+        stream0 = (
+            lstr.init_stream_state(
+                n,
+                np.asarray(self.tables.st_segs),
+                np.asarray(self.tables.st_mss),
+                np.asarray(self.tables.st_last),
+            )
+            if p.stream_present
+            else ()
         )
 
         up_burst = np.asarray(self.tables.up_burst)
         dn_burst = np.asarray(self.tables.dn_burst)
-        z64 = np.zeros(n, dtype=np.int64)
+        i32 = jnp.int32
+        z32 = np.zeros(n, dtype=np.int32)
+        # bucket state: next_refill starts one interval in (grid-aligned),
+        # last_depart at 0 — as pairs (hi, lo); CoDel first_above starts at
+        # the UNSET sentinel (the int64 law's time-0 marker)
         return lanes.LaneState(
-            q_time=jnp.asarray(q_time),
-            q_aux=jnp.asarray(q_aux),
+            q_thi=jnp.asarray(q_thi),
+            q_tlo=jnp.asarray(q_tlo),
+            q_auxh=jnp.asarray(q_auxh),
+            q_auxl=jnp.asarray(q_auxl),
             q_size=jnp.asarray(q_size),
-            q_pay=jnp.zeros((n, c), dtype=jnp.int64),
+            q_pay=jnp.zeros((n, c), dtype=jnp.int64) if p.stream_present else (),
             stream=stream0,
-            send_seq=jnp.asarray(z64),
-            local_seq=jnp.asarray(self._local_seq0),
-            app_draws=jnp.asarray(z64),
-            up_tokens=jnp.asarray(up_burst),
-            up_next_refill=jnp.full(n, self._interval, dtype=jnp.int64),
-            up_last_depart=jnp.asarray(z64),
-            dn_tokens=jnp.asarray(dn_burst),
-            dn_next_refill=jnp.full(n, self._interval, dtype=jnp.int64),
-            dn_last_depart=jnp.asarray(z64),
-            cd_first_above=jnp.asarray(z64),
-            cd_drop_next=jnp.asarray(z64),
-            cd_drop_count=jnp.zeros(n, dtype=jnp.int32),
+            send_seq=jnp.asarray(z32),
+            local_seq=jnp.asarray(self._local_seq0, dtype=i32),
+            app_draws=jnp.asarray(z32),
+            up_tokens=jnp.asarray(up_burst, dtype=i32),
+            up_nr_hi=jnp.asarray(z32),
+            up_nr_lo=jnp.full(n, self._interval, dtype=i32),
+            up_ld_hi=jnp.asarray(z32),
+            up_ld_lo=jnp.asarray(z32),
+            dn_tokens=jnp.asarray(dn_burst, dtype=i32),
+            dn_nr_hi=jnp.asarray(z32),
+            dn_nr_lo=jnp.full(n, self._interval, dtype=i32),
+            dn_ld_hi=jnp.asarray(z32),
+            dn_ld_lo=jnp.asarray(z32),
+            cd_fat_hi=jnp.full(n, lanes.CD_UNSET, dtype=i32),
+            cd_fat_lo=jnp.asarray(z32),
+            cd_dnext_hi=jnp.asarray(z32),
+            cd_dnext_lo=jnp.asarray(z32),
+            cd_drop_count=jnp.asarray(z32),
             cd_dropping=jnp.zeros(n, dtype=bool),
-            m_sent=jnp.asarray(z64),
-            m_peer_offset=jnp.asarray(z64),
-            n_delivered=jnp.asarray(z64),
-            n_loss=jnp.asarray(z64),
-            n_codel=jnp.asarray(z64),
-            n_queue=jnp.asarray(z64),
-            recv_bytes=jnp.asarray(z64),
-            n_sends=jnp.asarray(z64),
-            n_hops=jnp.asarray(z64),
+            m_sent=jnp.asarray(z32),
+            m_peer_offset=jnp.asarray(z32),
+            n_delivered=jnp.asarray(z32),
+            n_loss=jnp.asarray(z32),
+            n_codel=jnp.asarray(z32),
+            n_queue=jnp.asarray(z32),
+            recv_bytes=jnp.asarray(z32),
+            n_sends=jnp.asarray(z32),
+            n_hops=jnp.asarray(z32),
             log=jnp.zeros((max(self.params.log_capacity, 1), 6), dtype=jnp.int64),
-            log_count=jnp.int64(0),
-            log_lost=jnp.int64(0),
-            rounds=jnp.int64(0),
-            now_window_end=jnp.int64(0),
+            log_count=jnp.int32(0),
+            log_lost=jnp.int32(0),
+            rounds=jnp.int32(0),
+            now_we_hi=jnp.int32(0),
+            now_we_lo=jnp.int32(0),
         )
 
     # -- running -----------------------------------------------------------
@@ -313,7 +395,9 @@ class TpuEngine:
             while True:
                 if on_window is not None or self.perf_log is not None:
                     # queue rows are sorted: column 0 is each lane's min
-                    lane_next = np.asarray(state.q_time[:, 0])
+                    lane_next = np.asarray(
+                        lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
+                    )
                     start = int(lane_next.min())
                     we_pred = min(start + self.params.runahead, self.params.stop_time)
                     active = int((lane_next < we_pred).sum())
@@ -321,8 +405,14 @@ class TpuEngine:
                 if bool(done):
                     break
                 if on_window is not None or self.perf_log is not None:
-                    window_end = int(state.now_window_end)
-                    next_ev = int(np.asarray(state.q_time[:, 0]).min())
+                    window_end = int(
+                        (int(state.now_we_hi) << 31) | int(state.now_we_lo)
+                    )
+                    next_ev = int(
+                        np.asarray(
+                            lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
+                        ).min()
+                    )
                     if self.perf_log is not None:
                         self.perf_log.window_agg(
                             active, start, window_end,
@@ -334,6 +424,17 @@ class TpuEngine:
         return self.collect(state, wall)
 
     def collect(self, s: lanes.LaneState, wall: float) -> SimResult:
+        # int32 counter honesty: every per-lane counter is monotone, so a
+        # wrap past 2**31 shows as a negative value — raise instead of
+        # reporting garbage (2e9 events per lane is unreachable in any
+        # realistic run)
+        for fname in ("send_seq", "local_seq", "n_delivered", "n_sends",
+                      "recv_bytes", "m_peer_offset"):
+            if int(np.asarray(getattr(s, fname)).min(initial=0)) < 0:
+                raise RuntimeError(
+                    f"lane counter {fname} wrapped past 2**31; this run "
+                    "exceeds the lane backend's int32 counter range"
+                )
         n_queue_drops = int(np.asarray(s.n_queue).sum())
         if n_queue_drops and self.strict_capacity:
             raise RuntimeError(

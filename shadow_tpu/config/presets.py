@@ -15,10 +15,50 @@ def flagship_mesh_config(
     size: int = 1428,
     queue_capacity: int | None = None,
     pops_per_round: int | None = None,
+    stream_pairs: int = 0,
+    stream_bytes: int = 50_000_000,
+    backend: str = "tpu",
 ) -> ConfigOptions:
     """The tgen all-to-all mesh over a single switch (BASELINE config #4):
     every host sends a ``size``-byte datagram every ``interval`` to a
-    round-robin peer; lookahead window = link ``latency``."""
+    round-robin peer; lookahead window = link ``latency``.
+
+    ``stream_pairs`` > 0 makes it the MIXED TCP/UDP mesh of the north-star
+    config: that many stream-client -> stream-server lane-TCP flows
+    (handshake, NewReno, RTO — lanes_stream.py on device) run alongside
+    the UDP mesh, each streaming ``stream_bytes``; the mesh's round-robin
+    spray crosses the stream lanes, which must ignore it exactly like the
+    CPU oracle does."""
+    k = stream_pairs
+    if 2 * k >= n_hosts:
+        raise ValueError("stream_pairs must leave room for mesh hosts")
+    hosts = [
+        f"""
+  peer:
+    count: {n_hosts - 2 * k}
+    network_node_id: 0
+    processes:
+      - path: tgen-mesh
+        args: --interval {interval} --size {size}
+        start_time: 0 s
+"""
+    ]
+    for i in range(k):
+        hosts.append(
+            f"""
+  sc{i:05d}:
+    network_node_id: 0
+    processes:
+      - path: stream-client
+        args: --server ss{i:05d} --size {stream_bytes}
+        start_time: 0 s
+  ss{i:05d}:
+    network_node_id: 0
+    processes:
+      - path: stream-server
+        start_time: 0 s
+"""
+        )
     cfg = ConfigOptions.from_yaml(
         f"""
 general:
@@ -32,15 +72,9 @@ network:
         edge [ source 0  target 0  latency "{latency}" ]
       ]
 experimental:
-  network_backend: tpu
+  network_backend: {backend}
 hosts:
-  peer:
-    count: {n_hosts}
-    network_node_id: 0
-    processes:
-      - path: tgen-mesh
-        args: --interval {interval} --size {size}
-        start_time: 0 s
+{''.join(hosts)}
 """
     )
     if queue_capacity is not None:

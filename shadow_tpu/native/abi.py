@@ -57,6 +57,7 @@ OP_EVENTFD_CREATE = 39
 OP_FUTEX_WAIT = 40
 OP_FUTEX_WAKE = 41
 OP_FUTEX_REQUEUE = 42
+OP_PREEMPT = 43
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
@@ -69,7 +70,7 @@ OP_NAMES = {
     31: "sem-init", 32: "sem-wait", 33: "sem-post", 34: "sem-get",
     35: "dup", 36: "timerfd-create", 37: "timerfd-settime",
     38: "timerfd-gettime", 39: "eventfd-create", 40: "futex-wait",
-    41: "futex-wake", 42: "futex-requeue",
+    41: "futex-wake", 42: "futex-requeue", 43: "preempt",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)
@@ -208,6 +209,15 @@ class ShmChannel:
                 msg.turn = 0
                 return
             if not alive():
+                # re-check the channel before declaring death: the plugin
+                # may have PUBLISHED its farewell and exited between the
+                # turn check above and the liveness probe — taking the
+                # died path then would classify the exit differently than
+                # a run where the farewell won the race (a wall-clock
+                # dependence that broke run-twice determinism under load)
+                if msg.turn != 0:
+                    msg.turn = 0
+                    return
                 raise PluginDied("plugin exited without a farewell message")
             if time.monotonic() > deadline:
                 raise TimeoutError("plugin unresponsive (blocked outside the shim?)")

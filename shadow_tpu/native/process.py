@@ -52,6 +52,11 @@ EPHEMERAL_PORT_START = 49152
 # is serviced.  Deterministic: counts calls, not wall time.
 SYSCALL_LATENCY_NS = 1_000  # 1 us per serviced call
 MAX_UNAPPLIED_LATENCY_NS = 100_000  # forced yield every ~100 calls
+# busy-loop preemption quantum (the reference's preempt.rs): with the CPU
+# model on, the shim's CPU-time itimer forces a yield after this much
+# native CPU time and the manager charges it as simulated time — a plugin
+# spinning on locally-serviced clock reads can no longer livelock a round
+PREEMPT_QUANTUM_NS = 10_000_000  # 10 ms
 
 # errno values the manager hands back over the channel (Linux numbers via
 # the stdlib so the table can't drift)
@@ -393,6 +398,8 @@ class ManagedApp:
         # interposition backstops (default on; see ExperimentalOptions)
         if self._exp is not None and not self._exp.use_seccomp:
             env["SHADOW_TPU_SECCOMP"] = "0"
+        if self._cpu_model:
+            env["SHADOW_TPU_PREEMPT_NS"] = str(PREEMPT_QUANTUM_NS)
         if self._exp is not None and not self._exp.use_vdso_patching:
             env["SHADOW_TPU_VDSO"] = "0"
         self._stdout_file = open(host_dir / f"{stem}.stdout", "wb")
@@ -671,6 +678,13 @@ class ManagedApp:
                 ev.e_sem = bool(req.args[2])
                 self.sockets[int(req.args[0])] = ev
                 self._reply(api, "eventfd-create", 0)
+            elif op == abi.OP_PREEMPT:
+                # forced yield from the CPU-time itimer: charge the consumed
+                # quantum as simulated time, reply when it has passed
+                api.count("preempt_yields")
+                deadline = api.now + max(int(req.args[0]), 1)
+                self._park(api, ("sleep", deadline), deadline)
+                return
             elif op == abi.OP_FUTEX_WAIT:
                 self._op_futex_wait(api, req)
                 return  # always parks (reply arrives at wake/timeout)

@@ -68,7 +68,12 @@ INIT_CWND_FP = 10 * FP  # RFC 6928 initial window, segment units
 INIT_SSTHRESH_FP = 1 << 30
 MIN_SSTHRESH_FP = 2 * FP
 DUP_THRESH = 3
-RWND_SEGS = 256  # constant advertised receive window
+# Constant advertised receive window.  Sized so one full flight (plus
+# cross-traffic and timer arms) fits the lane backend's default bounded
+# queue capacity with headroom: every in-flight segment is a resident
+# event in the receiver's fixed-shape lane queue.  At the simulated
+# RTTs this is the per-flow throughput cap (24 * MSS / RTT).
+RWND_SEGS = 24
 MAX_CWND_FP = 2 * RWND_SEGS * FP  # growth past the window is pointless
 
 # -- RTO constants (RFC 6298, ns) ------------------------------------------

@@ -28,7 +28,7 @@ HOST_AXIS = "hosts"
 
 # LaneState fields that are not per-lane arrays and stay replicated
 _REPLICATED_FIELDS = frozenset(
-    ("log", "log_count", "log_lost", "rounds", "now_window_end")
+    ("log", "log_count", "log_lost", "rounds", "now_we_hi", "now_we_lo")
 )
 
 
@@ -58,7 +58,7 @@ def state_shardings(mesh: Mesh, axis: str = HOST_AXIS) -> lanes.LaneState:
 def shard_state(
     s: lanes.LaneState, mesh: Mesh, axis: str = HOST_AXIS
 ) -> lanes.LaneState:
-    n_lanes = s.q_time.shape[0]
+    n_lanes = s.q_thi.shape[0]
     if n_lanes % mesh.devices.size:
         raise ValueError(
             f"n_lanes={n_lanes} not divisible by mesh size {mesh.devices.size}"
