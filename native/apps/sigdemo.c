@@ -1,0 +1,65 @@
+/* sigdemo: simulated signal delivery between managed processes (the
+ * reference's handler/signal.rs surface).  The child arms a simulated
+ * alarm and a SIGTERM handler; the parent SIGTERMs it at a simulated
+ * instant via kill().  Every printed time derives from the simulated
+ * clock, so output is bit-identical run-to-run. */
+#define _GNU_SOURCE
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000L;
+}
+
+static long long t0;
+static volatile sig_atomic_t got_term;
+
+static void on_alrm(int sig) {
+    (void)sig;
+    printf("child: SIGALRM at +%lld ms\n", now_ms() - t0);
+}
+
+static void on_term(int sig) {
+    (void)sig;
+    got_term = 1;
+}
+
+int main(void) {
+    setvbuf(stdout, NULL, _IOLBF, 0);
+    t0 = now_ms();
+    pid_t pid = fork();
+    if (pid == 0) {
+        signal(SIGALRM, on_alrm);
+        signal(SIGTERM, on_term);
+        alarm(1); /* simulated: fires at +1000 ms of SIM time */
+        /* ONE long sleep: the manager must interrupt the parked call
+         * with EINTR when the handled signal lands (POSIX semantics) —
+         * polling in small slices would mask a broken EINTR path */
+        while (!got_term) {
+            struct timespec ts = {3600, 0};
+            if (nanosleep(&ts, NULL) == 0) break; /* slept 1h: broken */
+        }
+        printf("child: SIGTERM at +%lld ms, exiting 42\n", now_ms() - t0);
+        exit(42);
+    }
+    struct timespec ts = {2, 500 * 1000000L};
+    nanosleep(&ts, NULL); /* 2.5 simulated s */
+    if (kill(pid, SIGTERM) != 0) {
+        perror("kill");
+        return 1;
+    }
+    int st = 0;
+    waitpid(pid, &st, 0);
+    printf("parent: child exited=%d code=%d at +%lld ms\n", WIFEXITED(st),
+           WEXITSTATUS(st), now_ms() - t0);
+    /* signaling an unmanaged pid must be refused, not reach the real OS */
+    int r = kill(1, 0);
+    printf("parent: kill(pid 1) = %d\n", r);
+    return 0;
+}

@@ -123,6 +123,15 @@ enum {
                              manager calls): args[0] = consumed quantum ns;
                              the manager charges that much simulated time
                              before replying (preempt.rs, host/cpu.rs) */
+    /* simulated signal delivery (handler/signal.rs, shim/src/signals.rs):
+     * the manager owns inter-process signals so they land at simulated
+     * instants and only at turn boundaries */
+    SHIM_OP_KILL = 44,  /* args[0]=target os pid args[1]=signo; the manager
+                           delivers only to processes IT manages (-ESRCH
+                           otherwise — plugins cannot signal the real OS) */
+    SHIM_OP_ALARM = 45, /* args[0]=deadline ns rel (0 = cancel)
+                           args[1]=interval ns (setitimer re-arm);
+                           reply args[1]=previous remaining ns */
 };
 
 /* poll event bits (mirror Linux poll.h values) */
@@ -157,6 +166,9 @@ typedef struct {
     uint64_t rng_counter;      /* splitmix64 counter (shim-local draws) */
     uint64_t sock_sndbuf;      /* configured socket buffer sizes, so */
     uint64_t sock_rcvbuf;      /* getsockopt answers match the simulation */
+    uint64_t handled_signals;  /* bit (signo-1): the app installed a real
+                                  handler — the manager EINTRs parked calls
+                                  on delivery only when one is installed */
     shim_msg to_shadow;        /* plugin -> manager */
     shim_msg to_shim;          /* manager -> plugin */
 } shim_shmem;

@@ -324,11 +324,19 @@ static int64_t shim_call(uint32_t op, const int64_t args[6], const void *out,
      * issues its syscall from libc text, which the dispatch backstop
      * traps; the restore (with SIGSYS then blocked) would turn that trap
      * into a forced-SIGSYS kill. */
+    /* Block EVERYTHING except the fault set and SIGSYS during the
+     * exchange: an app handler running while this thread is parked would
+     * issue a REENTRANT shim_call and corrupt the strict alternation.
+     * Deferred handlers run at the mask restore below — and the manager
+     * completes a parked interruptible call with -EINTR when it delivers
+     * a handled signal, so handlers are never starved by a long park.
+     * SIGSYS stays open (dispatch infrastructure: a handler inheriting a
+     * blocked-SIGSYS context would be force-killed on its first
+     * interposed call); faults stay synchronous. */
     static const uint64_t sig_blk =
-        ~((1ull << (SIGTERM - 1)) | (1ull << (SIGINT - 1)) |
-          (1ull << (SIGQUIT - 1)) | (1ull << (SIGSEGV - 1)) |
-          (1ull << (SIGBUS - 1)) | (1ull << (SIGILL - 1)) |
-          (1ull << (SIGFPE - 1)) | (1ull << (SIGABRT - 1)));
+        ~((1ull << (SIGSEGV - 1)) | (1ull << (SIGBUS - 1)) |
+          (1ull << (SIGILL - 1)) | (1ull << (SIGFPE - 1)) |
+          (1ull << (SIGABRT - 1)) | (1ull << (SIGSYS - 1)));
     uint64_t sig_old = 0;
     shim_raw_syscall6(SYS_rt_sigprocmask, SIG_SETMASK, (long)&sig_blk,
                       (long)&sig_old, 8, 0, 0);
@@ -770,6 +778,7 @@ static void install_backstop(void) {
 
 static int tsc_chain_sigaction(const struct sigaction *act,
                                struct sigaction *oldact);
+static void tsc_disarm_for_exec(void);
 static int g_tsc_on; /* defined logically with the TSC emulation below */
 
 /* The app must not displace the SIGSYS backstop — but only when the
@@ -785,7 +794,17 @@ int sigaction(int signum, const struct sigaction *act,
     }
     if (signum == SIGSEGV && tsc_chain_sigaction(act, oldact))
         return 0; /* absorbed: the TSC trap stays, app handler chained */
-    return real_sa(signum, act, oldact);
+    int r = real_sa(signum, act, oldact);
+    if (r == 0 && act && g_shm && signum >= 1 && signum <= 64) {
+        uint64_t bit = 1ull << (signum - 1);
+        if (act->sa_handler != SIG_DFL && act->sa_handler != SIG_IGN)
+            __atomic_or_fetch(&g_shm->handled_signals, bit,
+                              __ATOMIC_RELAXED);
+        else
+            __atomic_and_fetch(&g_shm->handled_signals, ~bit,
+                               __ATOMIC_RELAXED);
+    }
+    return r;
 }
 
 /* glibc's signal() resolves through internal __sigaction, bypassing the
@@ -802,7 +821,17 @@ sighandler_t signal(int signum, sighandler_t handler) {
         tsc_chain_sigaction(&sa_c, &old);
         return (old.sa_flags & SA_SIGINFO) ? SIG_DFL : old.sa_handler;
     }
-    return real_signal(signum, handler);
+    sighandler_t r = real_signal(signum, handler);
+    if (r != SIG_ERR && g_shm && signum >= 1 && signum <= 64) {
+        uint64_t bit = 1ull << (signum - 1);
+        if (handler != SIG_DFL && handler != SIG_IGN)
+            __atomic_or_fetch(&g_shm->handled_signals, bit,
+                              __ATOMIC_RELAXED);
+        else
+            __atomic_and_fetch(&g_shm->handled_signals, ~bit,
+                               __ATOMIC_RELAXED);
+    }
+    return r;
 }
 
 /* -- RDTSC/RDTSCP emulation (the reference's shim_insn_emu.c) ----------- */
@@ -862,6 +891,11 @@ static void tsc_segv_handler(int sig, siginfo_t *si, void *uctx) {
     struct shim_ksigaction dfl;
     memset(&dfl, 0, sizeof(dfl));
     shim_raw_syscall6(SYS_rt_sigaction, SIGSEGV, (long)&dfl, 0, 8, 0, 0);
+}
+
+static void tsc_disarm_for_exec(void) {
+    if (!g_tsc_on) return;
+    shim_raw_syscall6(SYS_prctl, PR_SET_TSC, PR_TSC_ENABLE, 0, 0, 0, 0);
 }
 
 static void tsc_arm(void) {
@@ -1056,7 +1090,19 @@ int nanosleep(const struct timespec *req, struct timespec *rem) {
     }
     int64_t args[6] = {0};
     args[0] = (int64_t)req->tv_sec * 1000000000ll + req->tv_nsec;
-    shim_call(SHIM_OP_NANOSLEEP, args, NULL, 0, NULL, NULL, NULL);
+    int64_t reply[6];
+    int64_t ret =
+        shim_call(SHIM_OP_NANOSLEEP, args, NULL, 0, NULL, NULL, reply);
+    if (ret == -EINTR) {
+        /* a delivered signal interrupted the sleep; the manager reports
+         * the remaining SIMULATED time (POSIX rem semantics) */
+        if (rem) {
+            rem->tv_sec = reply[1] / 1000000000ll;
+            rem->tv_nsec = reply[1] % 1000000000ll;
+        }
+        errno = EINTR;
+        return -1;
+    }
     if (rem) rem->tv_sec = rem->tv_nsec = 0;
     return 0;
 }
@@ -2152,6 +2198,136 @@ struct hostent *gethostbyname(const char *name) {
     return &he;
 }
 
+/* Reverse lookup against the simulated hosts file — without it, glibc's
+ * gethostbyaddr fires real resolver UDP queries at /etc/resolv.conf's
+ * nameserver through the simulated network (CPython's http.server calls
+ * socket.getfqdn at startup, for example).  Unknown addresses fail fast
+ * and locally. */
+static int hosts_reverse(uint32_t ip, char *name_out, size_t cap) {
+    const char *path = getenv("SHADOW_TPU_HOSTS_FILE");
+    if (!path) return -1;
+    FILE *f = fopen(path, "re");
+    if (!f) return -1;
+    char line[512];
+    int found = -1;
+    while (fgets(line, sizeof(line), f)) {
+        char ipstr[64], host[256];
+        if (sscanf(line, "%63s %255s", ipstr, host) != 2) continue;
+        struct in_addr a;
+        if (inet_pton(AF_INET, ipstr, &a) == 1 && a.s_addr == ip) {
+            snprintf(name_out, cap, "%s", host);
+            found = 0;
+            break;
+        }
+    }
+    fclose(f);
+    return found;
+}
+
+struct hostent *gethostbyaddr(const void *addr, socklen_t len, int type) {
+    if (!real_socket) resolve_reals();
+    static struct hostent *(*real_gha)(const void *, socklen_t, int);
+    if (!real_gha) *(void **)&real_gha = dlsym(RTLD_NEXT, "gethostbyaddr");
+    if (!g_ready) return real_gha(addr, len, type);
+    static struct in_addr ra;
+    static char *ra_list[2];
+    static char rname[256];
+    static struct hostent rhe;
+    if (type != AF_INET || len < sizeof(struct in_addr) || !addr) {
+        h_errno = HOST_NOT_FOUND;
+        return NULL;
+    }
+    uint32_t ip = ((const struct in_addr *)addr)->s_addr;
+    if (ip == htonl(INADDR_LOOPBACK)) {
+        const char *hn = getenv("SHADOW_TPU_HOSTNAME");
+        snprintf(rname, sizeof(rname), "%s", hn ? hn : "localhost");
+    } else if (hosts_reverse(ip, rname, sizeof(rname)) != 0) {
+        h_errno = HOST_NOT_FOUND;
+        return NULL;
+    }
+    ra.s_addr = ip;
+    ra_list[0] = (char *)&ra;
+    ra_list[1] = NULL;
+    rhe.h_name = rname;
+    rhe.h_aliases = ra_list + 1; /* empty list */
+    rhe.h_addrtype = AF_INET;
+    rhe.h_length = sizeof(struct in_addr);
+    rhe.h_addr_list = ra_list;
+    return &rhe;
+}
+
+/* The reentrant variants (CPython's socketmodule resolves through these,
+ * not the classic entry points).  One helper fills the caller's buffer. */
+static int hostent_fill(struct hostent *ret, char *buf, size_t buflen,
+                        const char *name, uint32_t ip,
+                        struct hostent **result) {
+    size_t name_len = strlen(name) + 1;
+    size_t need = name_len + sizeof(struct in_addr) + 2 * sizeof(char *) + 16;
+    if (buflen < need) return ERANGE;
+    char *p = buf;
+    memcpy(p, name, name_len);
+    char *nm = p;
+    p += name_len;
+    p = (char *)(((uintptr_t)p + 7) & ~7ull); /* align */
+    struct in_addr *a = (struct in_addr *)p;
+    a->s_addr = ip;
+    p += sizeof(struct in_addr);
+    p = (char *)(((uintptr_t)p + 7) & ~7ull);
+    char **list = (char **)p;
+    list[0] = (char *)a;
+    list[1] = NULL;
+    ret->h_name = nm;
+    ret->h_aliases = list + 1;
+    ret->h_addrtype = AF_INET;
+    ret->h_length = sizeof(struct in_addr);
+    ret->h_addr_list = list;
+    *result = ret;
+    return 0;
+}
+
+int gethostbyaddr_r(const void *addr, socklen_t len, int type,
+                    struct hostent *ret, char *buf, size_t buflen,
+                    struct hostent **result, int *h_errnop) {
+    static int (*real_r)(const void *, socklen_t, int, struct hostent *,
+                         char *, size_t, struct hostent **, int *);
+    if (!real_r) *(void **)&real_r = dlsym(RTLD_NEXT, "gethostbyaddr_r");
+    if (!g_ready) return real_r(addr, len, type, ret, buf, buflen, result,
+                                h_errnop);
+    *result = NULL;
+    if (type != AF_INET || len < sizeof(struct in_addr) || !addr) {
+        if (h_errnop) *h_errnop = HOST_NOT_FOUND;
+        return ENOENT;
+    }
+    uint32_t ip = ((const struct in_addr *)addr)->s_addr;
+    char rname[256];
+    if (ip == htonl(INADDR_LOOPBACK)) {
+        const char *hn = getenv("SHADOW_TPU_HOSTNAME");
+        snprintf(rname, sizeof(rname), "%s", hn ? hn : "localhost");
+    } else if (hosts_reverse(ip, rname, sizeof(rname)) != 0) {
+        if (h_errnop) *h_errnop = HOST_NOT_FOUND;
+        return ENOENT;
+    }
+    return hostent_fill(ret, buf, buflen, rname, ip, result);
+}
+
+int gethostbyname_r(const char *name, struct hostent *ret, char *buf,
+                    size_t buflen, struct hostent **result, int *h_errnop) {
+    static int (*real_r)(const char *, struct hostent *, char *, size_t,
+                         struct hostent **, int *);
+    if (!real_r) *(void **)&real_r = dlsym(RTLD_NEXT, "gethostbyname_r");
+    if (!g_ready) return real_r(name, ret, buf, buflen, result, h_errnop);
+    *result = NULL;
+    uint32_t ip;
+    struct in_addr a;
+    if (inet_pton(AF_INET, name, &a) == 1) {
+        ip = a.s_addr;
+    } else if (hosts_lookup(name, &ip) != 0) {
+        if (h_errnop) *h_errnop = HOST_NOT_FOUND;
+        return ENOENT;
+    }
+    return hostent_fill(ret, buf, buflen, name, ip, result);
+}
+
 /* Interface enumeration: apps must see the SIMULATED interfaces (lo +
  * eth0 with the host's simulated IP), not the real machine's — the
  * reference answers these via its netlink socket emulation
@@ -2585,6 +2761,70 @@ void exit(int status) {
  * turn-taking the reference enforces per managed thread
  * (managed_thread.rs native_clone).  The child env points at its own
  * channel so an exec'd program's fresh shim re-registers on it. */
+/* -- simulated signals (handler/signal.rs, shim/src/signals.rs) --------- */
+/* kill between simulated processes routes through the manager: the signal
+ * lands at a simulated instant and only at a turn boundary (the target is
+ * parked or mid-exchange; shim_call masks deliverable signals during
+ * exchanges, so handlers run BETWEEN interposed calls).  The manager
+ * refuses pids it does not manage — a plugin cannot signal the real OS. */
+int kill(pid_t pid, int sig) {
+    static int (*real_kill)(pid_t, int);
+    if (!real_kill) *(void **)&real_kill = dlsym(RTLD_NEXT, "kill");
+    if (!g_ready) return real_kill(pid, sig);
+    if (pid == 0 || pid == -1) {
+        /* own process group / everyone: under the simulation that is this
+         * app's process tree — the manager fans the delivery out */
+        pid = 0;
+    } else if (pid < 0) {
+        pid = -pid; /* a specific group id == its leader's pid here */
+    }
+    int64_t args[6] = {pid, sig, 0, 0, 0, 0};
+    return (int)ret_errno(
+        shim_call(SHIM_OP_KILL, args, NULL, 0, NULL, NULL, NULL));
+}
+
+/* alarm/setitimer(ITIMER_REAL) tick the SIMULATED clock: the manager
+ * schedules the expiry and delivers SIGALRM at that simulated instant. */
+static int64_t alarm_set_ns(int64_t ns, int64_t interval_ns) {
+    int64_t args[6] = {ns, interval_ns, 0, 0, 0, 0};
+    int64_t reply[6];
+    int64_t ret =
+        shim_call(SHIM_OP_ALARM, args, NULL, 0, NULL, NULL, reply);
+    return ret < 0 ? 0 : reply[1];
+}
+
+unsigned int alarm(unsigned int seconds) {
+    static unsigned int (*real_alarm)(unsigned int);
+    if (!real_alarm) *(void **)&real_alarm = dlsym(RTLD_NEXT, "alarm");
+    if (!g_ready) return real_alarm(seconds);
+    int64_t old = alarm_set_ns((int64_t)seconds * 1000000000ll, 0);
+    return (unsigned int)((old + 999999999ll) / 1000000000ll);
+}
+
+int setitimer(__itimer_which_t which, const struct itimerval *new_value,
+              struct itimerval *old_value) {
+    static int (*real_seti)(__itimer_which_t, const struct itimerval *,
+                            struct itimerval *);
+    if (!real_seti) *(void **)&real_seti = dlsym(RTLD_NEXT, "setitimer");
+    if (!g_ready || which != ITIMER_REAL)
+        return real_seti(which, new_value, old_value);
+    if (!new_value) {
+        errno = EFAULT;
+        return -1;
+    }
+    int64_t ns = (int64_t)new_value->it_value.tv_sec * 1000000000ll +
+                 (int64_t)new_value->it_value.tv_usec * 1000ll;
+    int64_t ins = (int64_t)new_value->it_interval.tv_sec * 1000000000ll +
+                  (int64_t)new_value->it_interval.tv_usec * 1000ll;
+    int64_t old = alarm_set_ns(ns, ins);
+    if (old_value) {
+        memset(old_value, 0, sizeof(*old_value));
+        old_value->it_value.tv_sec = old / 1000000000ll;
+        old_value->it_value.tv_usec = (old % 1000000000ll) / 1000;
+    }
+    return 0;
+}
+
 /* Inside glibc's fork the raw clone comes from libc text and traps; the
  * dispatcher must re-execute it raw (re-arming dispatch on the child
  * side) instead of recursing into this wrapper.  Thread-local flag
@@ -2701,9 +2941,17 @@ static int raw_execve(const char *path, char *const argv[],
                       char *const envp[]) {
     /* raw: reachable from the dispatcher (a raw SYS_execve still gets its
      * environment rewritten), and SUD resets across exec so the new image
-     * starts clean */
-    return (int)raw_ret(shim_raw_syscall6(SYS_execve, (long)path, (long)argv,
-                                          (long)envp, 0, 0, 0));
+     * starts clean.  PR_SET_TSC however SURVIVES exec while the SIGSEGV
+     * handler does not — an early rdtsc in the new image's ld.so/libc
+     * startup would be fatal; disarm here, the fresh shim re-arms. */
+    tsc_disarm_for_exec();
+    long r = shim_raw_syscall6(SYS_execve, (long)path, (long)argv,
+                               (long)envp, 0, 0, 0);
+    /* only reached on failure: restore the trap so TSC reads stay
+     * simulated in the continuing image */
+    if (g_tsc_on)
+        shim_raw_syscall6(SYS_prctl, PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0, 0);
+    return (int)raw_ret(r);
 }
 
 static int shim_execve(const char *path, char *const argv[],
@@ -3365,6 +3613,13 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
             return shim_raw_syscall6(SYS_exit_group, a1, 0, 0, 0, 0, 0);
         case SYS_uname:
             WRAPRET(uname((struct utsname *)a1));
+        case SYS_kill:
+            WRAPRET(kill((pid_t)a1, (int)a2));
+        case SYS_alarm:
+            return (long)alarm((unsigned int)a1);
+        case SYS_setitimer:
+            WRAPRET(setitimer((int)a1, (const struct itimerval *)a2,
+                              (struct itimerval *)a3));
 
         /* ---- signal-interface protection (kernel structs, not glibc's;
          * the libc-level sigaction/signal wrappers cover PLT calls) ---- */

@@ -58,6 +58,8 @@ OP_FUTEX_WAIT = 40
 OP_FUTEX_WAKE = 41
 OP_FUTEX_REQUEUE = 42
 OP_PREEMPT = 43
+OP_KILL = 44
+OP_ALARM = 45
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
@@ -70,7 +72,7 @@ OP_NAMES = {
     31: "sem-init", 32: "sem-wait", 33: "sem-post", 34: "sem-get",
     35: "dup", 36: "timerfd-create", 37: "timerfd-settime",
     38: "timerfd-gettime", 39: "eventfd-create", 40: "futex-wait",
-    41: "futex-wake", 42: "futex-requeue", 43: "preempt",
+    41: "futex-wake", 42: "futex-requeue", 43: "preempt", 44: "kill", 45: "alarm",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)
@@ -102,6 +104,7 @@ class ShimShmem(ctypes.Structure):
         ("rng_counter", ctypes.c_uint64),
         ("sock_sndbuf", ctypes.c_uint64),
         ("sock_rcvbuf", ctypes.c_uint64),
+        ("handled_signals", ctypes.c_uint64),
         ("to_shadow", ShimMsg),
         ("to_shim", ShimMsg),
     ]

@@ -62,8 +62,9 @@ PREEMPT_QUANTUM_NS = 10_000_000  # 10 ms
 # the stdlib so the table can't drift)
 from errno import (  # noqa: E402
     EADDRINUSE, EAGAIN, EALREADY, EBADF, EBUSY, ECHILD, ECONNREFUSED,
-    ECONNRESET, EDEADLK, EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINVAL,
-    EISCONN, ENOSYS, ENOTCONN, ENOTSOCK, EPIPE, ESRCH, ETIMEDOUT,
+    ECONNRESET, EDEADLK, EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINTR,
+    EINVAL, EISCONN, ENOSYS, ENOTCONN, ENOTSOCK, EPERM, EPIPE, ESRCH,
+    ETIMEDOUT,
 )
 
 
@@ -143,7 +144,8 @@ class _Proc:
     __slots__ = ("chan", "os_pid", "popen", "parent", "blocked", "sockets",
                  "dead", "label", "saw_start", "cpu_lat", "kind", "vtid",
                  "os_proc", "detached", "main_exited", "mutexes", "conds",
-                 "sems", "thread_retvals", "futexes")
+                 "sems", "thread_retvals", "futexes",
+                 "_alarm_deadline", "_alarm_gen", "last_signal")
 
     def __init__(self, chan, os_pid=None, popen=None, parent=None, label="root",
                  kind="proc", vtid=0, os_proc=None):
@@ -171,6 +173,9 @@ class _Proc:
             self.conds: dict[int, list] = {}  # addr -> [(thread, mutex_addr)]
             self.sems: dict[int, list] = {}  # addr -> [value, waiters]
             self.thread_retvals: dict[int, int] = {}  # zombie vtid -> retval
+            self._alarm_deadline = None  # simulated alarm/itimer expiry
+            self._alarm_gen = 0
+            self.last_signal = 0  # last managed signal delivered (kill op)
             # raw-futex wait queues: addr -> [(thread, bitset)], FIFO.
             # Keyed per OS process: a futex address names memory in ONE
             # address space (threads share it; fork children's copies are
@@ -278,6 +283,23 @@ class ManagedApp:
             self.proc.send_signal(signum)
         except ProcessLookupError:
             pass
+        if self.root is not None:
+            self.root.last_signal = signum
+        # complete any parked interruptible call so the plugin leaves its
+        # exchange (signals are fully masked while parked): the pending
+        # signal is then observed — default action or handler — at the
+        # mask restore
+        prev = self._cur
+        for entity in self.procs:
+            if entity.dead or entity.blocked is None:
+                continue
+            b = entity.blocked
+            if b[0] in self._INTERRUPTIBLE:
+                entity.blocked = None
+                self._cur = entity
+                self._reply(api, "nanosleep" if b[0] == "sleep" else b[0],
+                            -EINTR)
+        self._cur = prev
         self.finished = True
         self._blocked = None
         forced = self._reap(grace_s=2)
@@ -678,6 +700,10 @@ class ManagedApp:
                 ev.e_sem = bool(req.args[2])
                 self.sockets[int(req.args[0])] = ev
                 self._reply(api, "eventfd-create", 0)
+            elif op == abi.OP_KILL:
+                self._op_kill(api, req)
+            elif op == abi.OP_ALARM:
+                self._op_alarm(api, req)
             elif op == abi.OP_PREEMPT:
                 # forced yield from the CPU-time itimer: charge the consumed
                 # quantum as simulated time, reply when it has passed
@@ -913,12 +939,15 @@ class ManagedApp:
         self._service(api, t)
 
     def _entity_died(self, api, proc: "_Proc") -> None:
-        """The OS process behind an entity died without a farewell."""
+        """The OS process behind an entity died without a farewell.  If the
+        simulation itself delivered a signal (kill op), report THAT as the
+        termination signal; SIGKILL otherwise."""
         os_p = proc.os_proc
+        sig = os_p.last_signal or 9
         if os_p.parent is None:
             self._finish(api, unexpected=True)
         else:
-            self._child_exit(api, os_p, 9, unexpected=True)  # SIGKILL
+            self._child_exit(api, os_p, sig, unexpected=True)
 
     def _thread_exit_msg(self, api: HostApi, proc: "_Proc", req) -> bool:
         """A THREAD_EXIT farewell arrived on ``proc``'s channel (no reply:
@@ -1163,6 +1192,135 @@ class ManagedApp:
     def _op_sem_get(self, api: HostApi, req) -> None:
         s = self._sem(self._cur.os_proc, int(req.args[0]))
         self._reply(api, "sem-get", 0, args=[0, s[0]])
+
+    # -- simulated signals (the reference's handler/signal.rs surface) ----
+
+    # parked kinds a delivered signal may interrupt with -EINTR (POSIX
+    # interruptible calls; sync primitives deliberately excluded —
+    # pthread_cond_wait and friends are not EINTR surfaces)
+    _INTERRUPTIBLE = ("sleep", "poll", "recvfrom", "recv", "accept",
+                      "connect", "waitpid", "futex")
+
+    def _op_kill(self, api: HostApi, req) -> None:
+        """kill() between simulated processes: the REAL signal is sent to
+        the target, whose exchange mask defers handlers to its next call
+        boundary — and if the target is parked in an interruptible call
+        AND has a handler installed (the shim-maintained handled_signals
+        bitmap), the parked call completes with -EINTR so the handler is
+        never starved by a long park.  Pid 0 fans out to the whole app
+        (its own process group); pids outside this app get -ESRCH: a
+        plugin can never signal the real OS through the simulation."""
+        target_pid = int(req.args[0])
+        sig = int(req.args[1])
+        if not (0 <= sig < 65):
+            self._reply(api, "kill", -EINVAL)
+            return
+        if sig in (_signal.SIGSTOP, _signal.SIGTSTP, _signal.SIGTTIN,
+                   _signal.SIGTTOU):
+            # a truly stopped plugin would never answer its channel and
+            # wedge the simulation: refuse (job control is not simulated)
+            self._reply(api, "kill", -EPERM)
+            return
+        if target_pid == 0:
+            targets = [pr for pr in self.procs
+                       if pr.kind == "proc" and not pr.dead]
+        else:
+            targets = [pr for pr in self.procs
+                       if pr.kind == "proc" and not pr.dead
+                       and pr.pid == target_pid]
+        if not targets:
+            self._reply(api, "kill", -ESRCH)
+            return
+        sender = self._cur
+        if sig:
+            for t in targets:
+                try:
+                    os.kill(t.pid, sig)
+                except ProcessLookupError:
+                    continue
+                t.last_signal = sig
+                api.count("managed_signals_sent")
+                self._interrupt_parked(api, t, sig)
+        self._cur = sender
+        self._reply(api, "kill", 0)
+
+    def _interrupt_parked(self, api, target: "_Proc", sig: int) -> None:
+        """Complete a parked interruptible call with -EINTR iff the target
+        installed a handler for ``sig`` (otherwise the default action
+        decides its fate and the park stays)."""
+        handled = int(target.chan.shm.handled_signals) if target.chan else 0
+        if not (handled >> (sig - 1)) & 1:
+            return
+        for entity in self.procs:
+            if entity.dead or entity.os_proc is not target.os_proc:
+                continue
+            b = entity.blocked
+            if b is None or b[0] not in self._INTERRUPTIBLE:
+                continue
+            entity.blocked = None
+            if b[0] == "sleep":
+                remaining = max(int(b[1]) - api.now, 0)
+                self._resume_granted(api, entity, "nanosleep", -EINTR,
+                                     args=[0, remaining])
+            elif b[0] == "futex":
+                addr = b[1]
+                os_p = entity.os_proc
+                q = [e for e in os_p.futexes.get(addr, [])
+                     if e[0] is not entity]
+                if q:
+                    os_p.futexes[addr] = q
+                else:
+                    os_p.futexes.pop(addr, None)
+                self._resume_granted(api, entity, "futex-wait", -EINTR)
+            else:
+                self._resume_granted(api, entity, b[0], -EINTR)
+
+    def _op_alarm(self, api: HostApi, req) -> None:
+        """alarm()/setitimer(ITIMER_REAL) on the SIMULATED clock: SIGALRM
+        is delivered at the simulated deadline (and re-armed for interval
+        timers)."""
+        ns = int(req.args[0])
+        interval = int(req.args[1])
+        proc = self._cur.os_proc
+        old = proc._alarm_deadline
+        remaining = max(old - api.now, 0) if old is not None else 0
+        proc._alarm_gen += 1
+        gen = proc._alarm_gen
+        if ns <= 0:
+            proc._alarm_deadline = None
+        else:
+            deadline = api.now + ns
+            proc._alarm_deadline = deadline
+            api.schedule_at(
+                deadline,
+                lambda h, p=proc, g=gen, iv=interval: self._alarm_fired(
+                    h, p, g, iv
+                ),
+            )
+        self._reply(api, "alarm", 0, args=[0, remaining])
+
+    def _alarm_fired(self, api, proc: "_Proc", gen: int, interval: int) -> None:
+        if proc.dead or self.finished or proc._alarm_gen != gen:
+            return  # re-armed or canceled since
+        proc._alarm_deadline = None
+        try:
+            os.kill(proc.pid, _signal.SIGALRM)
+        except ProcessLookupError:
+            return
+        proc.last_signal = int(_signal.SIGALRM)
+        api.count("managed_alarms_fired")
+        self._interrupt_parked(api, proc, int(_signal.SIGALRM))
+        if interval > 0:
+            proc._alarm_gen += 1
+            gen2 = proc._alarm_gen
+            deadline = api.now + interval
+            proc._alarm_deadline = deadline
+            api.schedule_at(
+                deadline,
+                lambda h, p=proc, g=gen2, iv=interval: self._alarm_fired(
+                    h, p, g, iv
+                ),
+            )
 
     # -- raw futex (the reference's futex table + FUTEX_* handler,
     # host/futex_table.rs, handler/futex.rs).  The shim already verified
@@ -1426,10 +1584,23 @@ class ManagedApp:
                 self._reply(api, "sendto", -EDESTADDRREQ)
                 return
             ip_be, port = sock.default_dst
-        dst = api.resolve(_be_to_ip(ip_be))
+        from ..net.dns import DnsError
+
+        try:
+            dst = api.resolve(_be_to_ip(ip_be))
+        except DnsError:
+            dst = None
         if sock.port is None:  # auto-bind an ephemeral source port
             sock.port = self._alloc_port(api)
             self._host_ports(api)[sock.port] = (self, sock)
+        if dst is None:
+            # a datagram to an address outside the simulated internet (a
+            # real resolver's nameserver, a hardcoded external IP...)
+            # vanishes, exactly like an unrouted packet on a real network;
+            # sendto itself succeeds
+            api.count("udp_external_drops")
+            self._reply(api, "sendto", len(data))
+            return
         api.send(dst, len(data) + UDP_HEADER_BYTES, payload=(sock.port, port, data))
         api.count("udp_tx_bytes", len(data))
         self._reply(api, "sendto", len(data))

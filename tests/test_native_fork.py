@@ -102,3 +102,33 @@ def test_uname_reports_simulated_hostname():
     res = shadow_exec(["/bin/bash", "-c", "uname -n; hostname"], stop_time="10s")
     assert res.ok
     assert res.stdout == "host0\nhost0\n"
+
+
+def test_simulated_signal_delivery():
+    """Emulated signals between managed processes (the reference's
+    handler/signal.rs): the child's alarm(1) fires SIGALRM at +1000
+    SIMULATED ms, the parent's kill(child, SIGTERM) lands at +2500 ms,
+    the handler runs at a deterministic sim instant, and signaling an
+    unmanaged pid is refused (-ESRCH) instead of reaching the real OS."""
+    res = shadow_exec([str(BUILD / "sigdemo")], stop_time="100s")
+    assert res.ok, res.stdout
+    assert "child: SIGALRM at +1000 ms" in res.stdout
+    assert "child: SIGTERM at +2500 ms, exiting 42" in res.stdout
+    assert "parent: child exited=1 code=42 at +2500 ms" in res.stdout
+    assert "parent: kill(pid 1) = -1" in res.stdout
+
+
+def test_simulated_signal_determinism():
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: 100s, seed: 3}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  solo:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'sigdemo'}
+"""
+    )
+    report = determinism_check(cfg)
+    assert report.identical, report.describe()
