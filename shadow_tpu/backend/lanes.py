@@ -263,6 +263,8 @@ class LaneState(NamedTuple):
     rounds: jnp.ndarray  # int32
     now_we_hi: jnp.ndarray  # int32 pair: current round's window end
     now_we_lo: jnp.ndarray
+    min_used_lat: jnp.ndarray  # int32 scalar: smallest latency sent over
+                               # so far (NEVER32 = none; dynamic runahead)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -285,6 +287,10 @@ class LaneParams:
     # static: any edge with packet_loss > 0?  loss-free graphs skip the
     # per-send threefry draw entirely
     has_loss: bool = True
+    # dynamic runahead (runahead.rs:44-118): the window may widen to the
+    # smallest latency actually used so far, never below the floor
+    dynamic_runahead: bool = False
+    runahead_floor: int = 1
     # window-advance+pop steps per fused while-loop trip (amortizes the
     # ~350 us per-iteration host round-trip of the tunneled runtime).
     # Multiplies XLA compile time with the body size — worth it for small
@@ -815,6 +821,14 @@ def _process_slot(
     else:
         lost = false_n
 
+    if p.dynamic_runahead:
+        # the smallest path latency of this slot's sends (the CPU law
+        # records EVERY send, before the loss draw — mirror exactly)
+        s = s._replace(
+            min_used_lat=jnp.minimum(
+                s.min_used_lat, jnp.min(jnp.where(do_send, lat, NEVER32))
+            )
+        )
     arr_hi, arr_lo = pair_max(*pair_add32(dep_hi, dep_lo, lat), we_hi, we_lo)
     out_valid = do_send & ~lost
     out_auxh = pack_aux_hi(jnp.full(n, PACKET, dtype=i32), lanes)
@@ -1227,6 +1241,19 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
     return iter_body
 
 
+def _effective_runahead(p: LaneParams, s: LaneState):
+    """Static: the precomputed min possible latency.  Dynamic: the min
+    latency of paths used so far, never below the floor (identical law to
+    CpuEngine.current_runahead / the reference's runahead.rs:44-57)."""
+    if not p.dynamic_runahead:
+        return p.runahead
+    return jnp.where(
+        s.min_used_lat == NEVER32,
+        jnp.int32(p.runahead),
+        jnp.maximum(s.min_used_lat, jnp.int32(max(p.runahead_floor, 1))),
+    )
+
+
 def _build_round(p: LaneParams, tb: LaneTables):
     """Build the raw (un-jitted) one-round advance: state -> (state, done)
     for the STEP driver.  Preserves the pre-round state when the
@@ -1238,7 +1265,9 @@ def _build_round(p: LaneParams, tb: LaneTables):
         # rows sorted: col 0 is each lane's min; lexicographic pair min
         start = t_join(*pair_min_lanes(s.q_thi[:, 0], s.q_tlo[:, 0]))
         done = start >= p.stop_time
-        window_end = jnp.minimum(start + p.runahead, p.stop_time)
+        window_end = jnp.minimum(
+            start + _effective_runahead(p, s), p.stop_time
+        )
         we_hi, we_lo = t_split(window_end)
         s = s._replace(now_we_hi=we_hi, now_we_lo=we_lo)
 
@@ -1282,7 +1311,8 @@ _I32_N_FIELDS = (
     "n_delivered", "n_loss", "n_codel", "n_queue", "recv_bytes",
     "n_sends", "n_hops",
 )
-_SCALAR_FIELDS = ("log_count", "log_lost", "rounds", "now_we_hi", "now_we_lo")
+_SCALAR_FIELDS = ("log_count", "log_lost", "rounds", "now_we_hi", "now_we_lo",
+                  "min_used_lat")
 
 
 def pack_state(s: LaneState):
@@ -1344,7 +1374,7 @@ def _build_full_run(p: LaneParams, tb: LaneTables):
                 pair_lt(mn_hi, mn_lo, stop_hi, stop_lo),
                 mn_hi, mn_lo, stop_hi, stop_lo,
             )
-            c_hi, c_lo = pair_add32(c_hi, c_lo, p.runahead)
+            c_hi, c_lo = pair_add32(c_hi, c_lo, _effective_runahead(p, st))
             c_hi, c_lo = pair_sel(
                 pair_lt(c_hi, c_lo, stop_hi, stop_lo),
                 c_hi, c_lo, stop_hi, stop_lo,

@@ -82,11 +82,6 @@ class TpuEngine:
         init_events: list[tuple[int, int, int, int, int, int]] = []  # lane,t,kind,src,seq,size
         local_seq0 = np.ones(n, dtype=np.int64)
 
-        if cfg.experimental.use_dynamic_runahead:
-            raise LaneCompatError(
-                "use_dynamic_runahead is cpu-backend only for now (the lane "
-                "round program uses a static window width)"
-            )
         for hid, hopt in enumerate(cfg.hosts):
             if len(hopt.processes) > 1:
                 raise LaneCompatError(
@@ -178,6 +173,8 @@ class TpuEngine:
             models_present=tuple(sorted(set(int(x) for x in model))),
             has_loss=bool(np.any(np.asarray(thresh) > 0)),
             unroll=cfg.experimental.tpu_round_unroll,
+            dynamic_runahead=bool(cfg.experimental.use_dynamic_runahead),
+            runahead_floor=max(cfg.experimental.runahead or 0, 1),
         )
 
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)
@@ -197,7 +194,9 @@ class TpuEngine:
                     f"({limit}); use the cpu backend"
                 )
 
-        _check("link latency (ns)", np.asarray(lat), i32max)
+        # strictly below NEVER32: a latency equal to the sentinel would
+        # read as "no sends yet" in the dynamic-runahead scalar
+        _check("link latency (ns)", np.asarray(lat), i32max - 1)
         _check("runahead (ns)", np.asarray([runahead]), i32max)
         for side, b in (("up", up), ("dn", dn)):
             # the refill computes tokens + k*rate <= 2*burst + rate before
@@ -262,6 +261,21 @@ class TpuEngine:
 
     def _resolve(self, hostname: str, n: int) -> int:
         return self.dns.resolve(hostname)
+
+    def current_runahead(self) -> int:
+        """Live window width (dynamic runahead reads the device scalar;
+        static mode is the precomputed minimum) — the step driver's
+        window predictor and run-control's host listing use this."""
+        p = self.params
+        if not p.dynamic_runahead:
+            return p.runahead
+        state = getattr(self, "_live_state", None)
+        if state is None:
+            return p.runahead
+        used = int(state.min_used_lat)
+        if used >= lanes.NEVER32:
+            return p.runahead
+        return max(used, max(p.runahead_floor, 1))
 
     # -- state construction ------------------------------------------------
 
@@ -361,6 +375,7 @@ class TpuEngine:
             rounds=jnp.int32(0),
             now_we_hi=jnp.int32(0),
             now_we_lo=jnp.int32(0),
+            min_used_lat=jnp.int32(lanes.NEVER32),
         )
 
     # -- running -----------------------------------------------------------
@@ -393,13 +408,16 @@ class TpuEngine:
             round_fn = lanes.make_round_fn(self.params, self.tables)
             t0 = wall_time.perf_counter()
             while True:
+                self._live_state = state
                 if on_window is not None or self.perf_log is not None:
                     # queue rows are sorted: column 0 is each lane's min
                     lane_next = np.asarray(
                         lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
                     )
                     start = int(lane_next.min())
-                    we_pred = min(start + self.params.runahead, self.params.stop_time)
+                    we_pred = min(
+                        start + self.current_runahead(), self.params.stop_time
+                    )
                     active = int((lane_next < we_pred).sum())
                 state, done = round_fn(state)
                 if bool(done):

@@ -178,7 +178,7 @@ class Simulation:
         if not needs_steps:
             return engine.run(mode="device")
         t0 = time.perf_counter()
-        on_window = self._make_on_window(None, engine.params.runahead, t0)
+        on_window = self._make_on_window(None, engine.current_runahead, t0)
         if self.cfg.experimental.perf_logging:
             engine.perf_log = PerfLog()
         return engine.run(mode="step", on_window=on_window)

@@ -402,3 +402,35 @@ def test_mixed_mesh_stream_parity():
     assert len(cpu.event_log) > 100
     # the stream tier really ran: segments crossed alongside the mesh
     assert tpu.counters.get("stream_rx_bytes", 0) > 0
+
+
+def test_dynamic_runahead_parity():
+    """use_dynamic_runahead on DEVICE (round-1 review item: it was
+    cpu-only): the window widens to the smallest latency actually used —
+    while only the slow path carries traffic the windows are wide, and
+    the first fast-path send narrows them.  Bit-identical logs against
+    the CPU oracle prove the identical law (runahead.rs:44-57)."""
+    yaml = """
+general: {stop_time: 2s, seed: 13}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "2 ms" ]
+        edge [ source 0 target 1 latency "40 ms" ]
+        edge [ source 1 target 1 latency "2 ms" ]
+      ]
+experimental: {use_dynamic_runahead: true}
+hosts:
+  a: {network_node_id: 0, processes: [{path: tgen-client, args: "--server b --interval 30ms --size 600"}]}
+  b: {network_node_id: 1, processes: [{path: tgen-server}]}
+  c: {network_node_id: 1, processes: [{path: ping, args: "--peer d --count 5 --interval 100ms"}]}
+  d: {network_node_id: 1, processes: [{path: ping}]}
+"""
+    cpu, tpu = both_logs(yaml, mode="device")
+    assert cpu.log_tuples() == tpu.log_tuples()
+    assert len(cpu.event_log) > 40
