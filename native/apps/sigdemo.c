@@ -48,6 +48,48 @@ int main(void) {
         printf("child: SIGTERM at +%lld ms, exiting 42\n", now_ms() - t0);
         exit(42);
     }
+    /* second child: NO handler — default action must kill it mid-park.
+     * POSIX terminates a sleeper on SIGTERM immediately; a manager that
+     * leaves the pending-and-masked signal waiting for the hour sleep to
+     * finish hangs this waitpid in simulated time. */
+    pid_t pid2 = fork();
+    if (pid2 == 0) {
+        struct timespec hour = {3600, 0};
+        nanosleep(&hour, NULL);
+        printf("child2: survived SIGTERM (broken)\n");
+        exit(7);
+    }
+    /* third child: SIG_IGN INHERITED across fork (POSIX) — the ignored
+     * signal must neither interrupt the sleep nor kill (finishes its 3 s
+     * nap and exits normally).  The disposition is installed in the
+     * parent pre-fork and never re-published by the child, so this also
+     * checks the manager seeds the child's channel with the parent's
+     * disposition bitmaps. */
+    signal(SIGTERM, SIG_IGN);
+    pid_t pid3 = fork();
+    if (pid3 == 0) {
+        struct timespec nap = {3, 0};
+        int rc = nanosleep(&nap, NULL);
+        printf("child3: nap rc=%d at +%lld ms\n", rc, now_ms() - t0);
+        exit(0);
+    }
+    signal(SIGTERM, SIG_DFL);
+    /* fourth child: sigprocmask-BLOCKED SIGTERM — POSIX keeps the signal
+     * pending without interrupting the sleep; the default action fires
+     * only at the unblock (+4 s), not at the kill (+2.5 s) */
+    pid_t pid4 = fork();
+    if (pid4 == 0) {
+        sigset_t blk;
+        sigemptyset(&blk);
+        sigaddset(&blk, SIGTERM);
+        sigprocmask(SIG_BLOCK, &blk, NULL);
+        struct timespec nap = {4, 0};
+        int rc = nanosleep(&nap, NULL);
+        printf("child4: nap rc=%d at +%lld ms\n", rc, now_ms() - t0);
+        sigprocmask(SIG_UNBLOCK, &blk, NULL); /* pending SIGTERM fires */
+        printf("child4: survived unblock (broken)\n");
+        exit(8);
+    }
     struct timespec ts = {2, 500 * 1000000L};
     nanosleep(&ts, NULL); /* 2.5 simulated s */
     if (kill(pid, SIGTERM) != 0) {
@@ -58,6 +100,25 @@ int main(void) {
     waitpid(pid, &st, 0);
     printf("parent: child exited=%d code=%d at +%lld ms\n", WIFEXITED(st),
            WEXITSTATUS(st), now_ms() - t0);
+    if (kill(pid2, SIGTERM) != 0 || kill(pid3, SIGTERM) != 0 ||
+        kill(pid4, SIGTERM) != 0) {
+        perror("kill2/3/4");
+        return 1;
+    }
+    int st2 = 0;
+    waitpid(pid2, &st2, 0);
+    printf("parent: child2 signaled=%d sig=%d at +%lld ms\n",
+           WIFSIGNALED(st2), WIFSIGNALED(st2) ? WTERMSIG(st2) : 0,
+           now_ms() - t0);
+    int st3 = 0;
+    waitpid(pid3, &st3, 0);
+    printf("parent: child3 exited=%d code=%d at +%lld ms\n", WIFEXITED(st3),
+           WEXITSTATUS(st3), now_ms() - t0);
+    int st4 = 0;
+    waitpid(pid4, &st4, 0);
+    printf("parent: child4 signaled=%d sig=%d at +%lld ms\n",
+           WIFSIGNALED(st4), WIFSIGNALED(st4) ? WTERMSIG(st4) : 0,
+           now_ms() - t0);
     /* signaling an unmanaged pid must be refused, not reach the real OS */
     int r = kill(1, 0);
     printf("parent: kill(pid 1) = %d\n", r);

@@ -169,6 +169,14 @@ typedef struct {
     uint64_t handled_signals;  /* bit (signo-1): the app installed a real
                                   handler — the manager EINTRs parked calls
                                   on delivery only when one is installed */
+    uint64_t ignored_signals;  /* bit (signo-1): the app set SIG_IGN — an
+                                  ignored signal neither interrupts a park
+                                  nor triggers the default-fatal release */
+    uint64_t blocked_signals;  /* bit (signo-1): the app's OWN sigprocmask
+                                  blocked set (not the shim's exchange
+                                  mask) — a blocked signal neither EINTRs
+                                  nor fatally releases a park; it stays
+                                  pending until the app unblocks it */
     shim_msg to_shadow;        /* plugin -> manager */
     shim_msg to_shim;          /* manager -> plugin */
 } shim_shmem;

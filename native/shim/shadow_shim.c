@@ -650,6 +650,38 @@ static void sigsys_handler(int sig, siginfo_t *si, void *uctx) {
     long a4 = gr[REG_R10], a5 = gr[REG_R8], a6 = gr[REG_R9];
     unsigned long insn_ip = (unsigned long)gr[REG_RIP] - 2; /* rip is past
                                                 the 2-byte syscall insn */
+    if (nr == SYS_rt_sigprocmask && (size_t)a4 == 8 &&
+        !(insn_ip >= g_text_lo && insn_ip < g_text_hi)) {
+        /* An app mask change must land in uc_sigmask — the kernel
+         * restores THAT at our sigreturn, so a mask set natively inside
+         * this handler would be silently undone.  Operate on the saved
+         * context directly (SIGSYS stripped: blocking it turns the next
+         * dispatch into a forced kill) and mirror the app's logical
+         * blocked set for the manager's park-release decisions. */
+        uint64_t *ucm = (uint64_t *)&uc->uc_sigmask;
+        uint64_t old = *ucm;
+        long r = 0;
+        if (a2) {
+            uint64_t m;
+            memcpy(&m, (void *)a2, 8);
+            uint64_t nw = old;
+            if ((int)a1 == SIG_BLOCK) nw = old | m;
+            else if ((int)a1 == SIG_UNBLOCK) nw = old & ~m;
+            else if ((int)a1 == SIG_SETMASK) nw = m;
+            else r = -EINVAL;
+            if (r == 0) {
+                nw &= ~(1ull << (SIGSYS - 1));
+                *ucm = nw;
+                if (g_shm)
+                    __atomic_store_n(&g_shm->blocked_signals, nw,
+                                     __ATOMIC_RELAXED);
+            }
+        }
+        if (r == 0 && a3) memcpy((void *)a3, &old, 8);
+        gr[REG_RAX] = r;
+        errno = saved_errno;
+        return;
+    }
     long ret;
     int handled = 0;
     /* Guard on g_shm, not g_ready: during the destructor (g_ready==0, shm
@@ -781,6 +813,24 @@ static int tsc_chain_sigaction(const struct sigaction *act,
 static void tsc_disarm_for_exec(void);
 static int g_tsc_on; /* defined logically with the TSC emulation below */
 
+/* Mirror an installed disposition into the manager-visible bitmaps: the
+ * handled bit gates EINTR completion of parked calls; the ignored bit
+ * keeps an explicit SIG_IGN from reading as SIG_DFL (whose default-fatal
+ * action releases parks).  Process-wide state lives on the MAIN channel
+ * regardless of the calling thread, matching POSIX disposition scope. */
+static void publish_disposition(int signum, sighandler_t handler) {
+    if (!g_shm || signum < 1 || signum > 64) return;
+    uint64_t bit = 1ull << (signum - 1);
+    if (handler != SIG_DFL && handler != SIG_IGN)
+        __atomic_or_fetch(&g_shm->handled_signals, bit, __ATOMIC_RELAXED);
+    else
+        __atomic_and_fetch(&g_shm->handled_signals, ~bit, __ATOMIC_RELAXED);
+    if (handler == SIG_IGN)
+        __atomic_or_fetch(&g_shm->ignored_signals, bit, __ATOMIC_RELAXED);
+    else
+        __atomic_and_fetch(&g_shm->ignored_signals, ~bit, __ATOMIC_RELAXED);
+}
+
 /* The app must not displace the SIGSYS backstop — but only when the
  * backstop is actually installed here; otherwise apps that sandbox
  * themselves (own seccomp + SIGSYS handler) must keep working. */
@@ -792,18 +842,14 @@ int sigaction(int signum, const struct sigaction *act,
         if (oldact) memset(oldact, 0, sizeof(*oldact));
         return 0; /* accepted and ignored: the backstop stays */
     }
-    if (signum == SIGSEGV && tsc_chain_sigaction(act, oldact))
-        return 0; /* absorbed: the TSC trap stays, app handler chained */
-    int r = real_sa(signum, act, oldact);
-    if (r == 0 && act && g_shm && signum >= 1 && signum <= 64) {
-        uint64_t bit = 1ull << (signum - 1);
-        if (act->sa_handler != SIG_DFL && act->sa_handler != SIG_IGN)
-            __atomic_or_fetch(&g_shm->handled_signals, bit,
-                              __ATOMIC_RELAXED);
-        else
-            __atomic_and_fetch(&g_shm->handled_signals, ~bit,
-                               __ATOMIC_RELAXED);
+    if (signum == SIGSEGV && tsc_chain_sigaction(act, oldact)) {
+        /* absorbed: the TSC trap stays, app handler chained — but the
+         * disposition is real and must reach the manager's bitmaps */
+        if (act) publish_disposition(signum, act->sa_handler);
+        return 0;
     }
+    int r = real_sa(signum, act, oldact);
+    if (r == 0 && act) publish_disposition(signum, act->sa_handler);
     return r;
 }
 
@@ -819,18 +865,11 @@ sighandler_t signal(int signum, sighandler_t handler) {
         sa_c.sa_handler = handler;
         struct sigaction old;
         tsc_chain_sigaction(&sa_c, &old);
+        publish_disposition(signum, handler);
         return (old.sa_flags & SA_SIGINFO) ? SIG_DFL : old.sa_handler;
     }
     sighandler_t r = real_signal(signum, handler);
-    if (r != SIG_ERR && g_shm && signum >= 1 && signum <= 64) {
-        uint64_t bit = 1ull << (signum - 1);
-        if (handler != SIG_DFL && handler != SIG_IGN)
-            __atomic_or_fetch(&g_shm->handled_signals, bit,
-                              __ATOMIC_RELAXED);
-        else
-            __atomic_and_fetch(&g_shm->handled_signals, ~bit,
-                               __ATOMIC_RELAXED);
-    }
+    if (r != SIG_ERR) publish_disposition(signum, handler);
     return r;
 }
 
@@ -3627,6 +3666,38 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
             if ((int)a1 == SIGSYS && (g_sud_on || g_seccomp_on) && a2) {
                 if (a3) memset((void *)a3, 0, sizeof(struct shim_ksigaction));
                 return 0; /* accepted and ignored: the backstop stays */
+            }
+            if (a2 && (int)a1 >= 1 && (int)a1 <= 64) {
+                const struct shim_ksigaction *ka =
+                    (const struct shim_ksigaction *)a2;
+                if ((int)a1 == SIGSEGV && g_tsc_on) {
+                    /* raw-installed SEGV handlers (Go runtime startup)
+                     * must chain behind the TSC trap, not displace it:
+                     * a displaced trap turns the next rdtsc into a
+                     * spurious SEGV in the app's handler */
+                    struct sigaction sa_c;
+                    memset(&sa_c, 0, sizeof(sa_c));
+                    sa_c.sa_handler = (sighandler_t)ka->handler;
+                    sa_c.sa_flags = (int)ka->flags &
+                                    ~(SHIM_SA_RESTORER);
+                    memcpy(&sa_c.sa_mask, &ka->mask, 8);
+                    struct sigaction old;
+                    tsc_chain_sigaction(&sa_c, &old);
+                    publish_disposition((int)a1,
+                                        (sighandler_t)ka->handler);
+                    if (a3) {
+                        struct shim_ksigaction kold;
+                        memset(&kold, 0, sizeof(kold));
+                        kold.handler = (void *)old.sa_handler;
+                        kold.flags = (unsigned long)old.sa_flags;
+                        memcpy(&kold.mask, &old.sa_mask, 8);
+                        memcpy((void *)a3, &kold, sizeof(kold));
+                    }
+                    return 0;
+                }
+                /* mirror the disposition the libc wrappers would have
+                 * published, then fall through to native execution */
+                publish_disposition((int)a1, (sighandler_t)ka->handler);
             }
             *handled = 0;
             return 0;

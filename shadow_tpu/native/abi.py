@@ -105,6 +105,8 @@ class ShimShmem(ctypes.Structure):
         ("sock_sndbuf", ctypes.c_uint64),
         ("sock_rcvbuf", ctypes.c_uint64),
         ("handled_signals", ctypes.c_uint64),
+        ("ignored_signals", ctypes.c_uint64),
+        ("blocked_signals", ctypes.c_uint64),
         ("to_shadow", ShimMsg),
         ("to_shim", ShimMsg),
     ]

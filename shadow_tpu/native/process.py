@@ -593,6 +593,10 @@ class ManagedApp:
                     for sock in list(proc.sockets.values()):
                         self._drop_socket_ref(api, sock)
                     proc.sockets.clear()
+                    # execve resets caught handlers to SIG_DFL while SIG_IGN
+                    # survives (POSIX); the shm file persists across exec,
+                    # so clear the handler bitmap here
+                    proc.chan.shm.handled_signals = 0
                 proc.saw_start = True
                 self._reply(api, "start", 0)
             elif op == abi.OP_EXIT:
@@ -756,6 +760,14 @@ class ManagedApp:
             rcvbuf=self._exp.socket_recv_buffer if self._exp else None,
         )
         chan.set_clock(stime.sim_to_emu(api.now))
+        # fork inherits signal dispositions (POSIX): seed the child's
+        # fresh channel with the parent's process-wide bitmaps, else a
+        # SIG_IGN/handler installed before fork would read as SIG_DFL and
+        # misfire the default-fatal park release
+        pshm = self._cur.os_proc.chan.shm
+        chan.shm.handled_signals = int(pshm.handled_signals)
+        chan.shm.ignored_signals = int(pshm.ignored_signals)
+        chan.shm.blocked_signals = int(pshm.blocked_signals)
         self._pending_chans.append(chan)
         self._reply(api, "prefork", 0, payload=str(path).encode())
 
@@ -1244,19 +1256,54 @@ class ManagedApp:
         self._cur = sender
         self._reply(api, "kill", 0)
 
+    # signals whose default action is NOT termination (stop signals are
+    # refused upstream; SIGCONT's default is continue): a no-handler
+    # delivery of one of these leaves the park alone
+    _DEFAULT_NONFATAL = frozenset(
+        {int(_signal.SIGCHLD), int(_signal.SIGURG), int(_signal.SIGWINCH),
+         int(_signal.SIGCONT)}
+    )
+
     def _interrupt_parked(self, api, target: "_Proc", sig: int) -> None:
-        """Complete a parked interruptible call with -EINTR iff the target
-        installed a handler for ``sig`` (otherwise the default action
-        decides its fate and the park stays)."""
-        handled = int(target.chan.shm.handled_signals) if target.chan else 0
-        if not (handled >> (sig - 1)) & 1:
+        """Complete a parked interruptible call with -EINTR when the target
+        installed a handler for ``sig`` — or release ANY park when ``sig``
+        has no handler and its default action is terminate: the exchange
+        mask blocks every maskable signal for the duration of a park, so a
+        pending default-fatal signal (SIGTERM/SIGALRM/... with no handler)
+        would otherwise never take effect until the park naturally
+        completed.  POSIX kills the sleeper now; releasing the park lets
+        the process leave its exchange and the pending signal's default
+        action fire at the mask restore (signal.rs default-action
+        dispositions; deliver_shutdown uses the same shape).  An explicitly
+        SIG_IGNed signal (the shim-maintained ignored_signals bitmap)
+        neither interrupts nor kills — the park stays."""
+        shm = target.chan.shm if target.chan else None
+        if shm is not None and (int(shm.blocked_signals) >> (sig - 1)) & 1:
+            # the app's own sigprocmask blocks it: POSIX keeps the signal
+            # pending without interrupting anything — it takes effect when
+            # the app unblocks (park releases would be spurious EINTRs)
             return
+        handled = int(shm.handled_signals) if shm is not None else 0
+        has_handler = (handled >> (sig - 1)) & 1
+        fatal = False
+        if not has_handler:
+            ignored = int(shm.ignored_signals) if shm is not None else 0
+            if (ignored >> (sig - 1)) & 1 or sig in self._DEFAULT_NONFATAL:
+                return
+            fatal = True
         for entity in self.procs:
             if entity.dead or entity.os_proc is not target.os_proc:
                 continue
             b = entity.blocked
-            if b is None or b[0] not in self._INTERRUPTIBLE:
+            if b is None:
                 continue
+            if b[0] not in self._INTERRUPTIBLE:
+                # handled signals EINTR only the POSIX-interruptible set;
+                # impending death releases every park except the imminent
+                # cpulat charge (a timed park with a near deadline whose
+                # pending request is serviced at expiry either way)
+                if not fatal or b[0] == "cpulat":
+                    continue
             entity.blocked = None
             if b[0] == "sleep":
                 remaining = max(int(b[1]) - api.now, 0)
@@ -1272,6 +1319,16 @@ class ManagedApp:
                 else:
                     os_p.futexes.pop(addr, None)
                 self._resume_granted(api, entity, "futex-wait", -EINTR)
+            elif b[0] == "mutex":
+                # wait queues skip entries whose `blocked` was cleared, so
+                # no explicit dequeue is needed (grant/wake loops check)
+                self._resume_granted(api, entity, b[4], -EINTR)
+            elif b[0] == "cond":
+                self._resume_granted(api, entity, "cond-wait", -EINTR)
+            elif b[0] == "sem":
+                self._resume_granted(api, entity, "sem-wait", -EINTR)
+            elif b[0] == "join":
+                self._resume_granted(api, entity, "thread-join", -EINTR)
             else:
                 self._resume_granted(api, entity, b[0], -EINTR)
 

@@ -312,12 +312,13 @@ hosts:
         eng.run()
         assert eng.current_runahead() >= 80_000_000
 
-    def test_lane_backend_rejects_dynamic(self):
-        import pytest
-
-        from shadow_tpu.backend.tpu_engine import LaneCompatError, TpuEngine
+    def test_lane_backend_accepts_dynamic(self):
+        # dynamic runahead runs ON DEVICE since round 2 (lanes.py
+        # _effective_runahead); bit-identical parity with the CPU law is
+        # covered by test_lane_parity.py::test_dynamic_runahead_parity
+        from shadow_tpu.backend.tpu_engine import TpuEngine
         from shadow_tpu.config.options import ConfigOptions
 
         cfg = ConfigOptions.from_yaml(self.YAML)
-        with pytest.raises(LaneCompatError, match="dynamic"):
-            TpuEngine(cfg)
+        eng = TpuEngine(cfg)
+        assert eng.params.dynamic_runahead is True

@@ -115,6 +115,22 @@ def test_simulated_signal_delivery():
     assert "child: SIGALRM at +1000 ms" in res.stdout
     assert "child: SIGTERM at +2500 ms, exiting 42" in res.stdout
     assert "parent: child exited=1 code=42 at +2500 ms" in res.stdout
+    # no-handler child: SIGTERM's DEFAULT action kills it mid-park at the
+    # simulated kill instant (the park is released so the pending signal
+    # fires at the exchange-mask restore — not after the hour sleep)
+    assert "parent: child2 signaled=1 sig=15 at +2500 ms" in res.stdout
+    assert "survived" not in res.stdout
+    # SIG_IGNed child: the ignored signal neither interrupts nor kills —
+    # it finishes its 3 s nap (rc=0) and exits normally.  The disposition
+    # was inherited across fork (installed pre-fork, never re-published)
+    assert "child3: nap rc=0 at +3000 ms" in res.stdout
+    assert "parent: child3 exited=1 code=0 at +3000 ms" in res.stdout
+    # sigprocmask-blocked child: the pending signal neither interrupts
+    # the nap (rc=0 at +4000) nor fires before the unblock, then the
+    # default action kills at the unblock instant
+    assert "child4: nap rc=0 at +4000 ms" in res.stdout
+    assert "child4: survived unblock" not in res.stdout
+    assert "parent: child4 signaled=1 sig=15 at +4000 ms" in res.stdout
     assert "parent: kill(pid 1) = -1" in res.stdout
 
 
