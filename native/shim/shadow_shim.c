@@ -672,8 +672,11 @@ static void sigsys_handler(int sig, siginfo_t *si, void *uctx) {
             if (r == 0) {
                 nw &= ~(1ull << (SIGSYS - 1));
                 *ucm = nw;
-                if (g_shm)
-                    __atomic_store_n(&g_shm->blocked_signals, nw,
+                /* per-THREAD mirror (cur_shm): sigmasks are thread state —
+                 * the manager checks the parked entity's own channel */
+                shim_shmem *mshm = cur_shm();
+                if (mshm)
+                    __atomic_store_n(&mshm->blocked_signals, nw,
                                      __ATOMIC_RELAXED);
             }
         }
@@ -3695,9 +3698,14 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
                     }
                     return 0;
                 }
-                /* mirror the disposition the libc wrappers would have
-                 * published, then fall through to native execution */
-                publish_disposition((int)a1, (sighandler_t)ka->handler);
+                /* execute natively NOW so the mirror only records
+                 * kernel-accepted dispositions (a rejected sigaction must
+                 * not flip the manager-visible bitmap) */
+                long r = shim_raw_syscall6(SYS_rt_sigaction, a1, a2, a3, a4,
+                                           a5, a6);
+                if (r == 0)
+                    publish_disposition((int)a1, (sighandler_t)ka->handler);
+                return r;
             }
             *handled = 0;
             return 0;

@@ -55,6 +55,7 @@ from ..core import rng as rng_mod
 from ..core import time as stime
 from ..net import codel as codel_mod
 from ..net.token_bucket import DEFAULT_INTERVAL_NS, FRAME_OVERHEAD_BYTES
+from . import lanes_pairs as _pairs
 from . import lanes_stream as lstr
 
 # event kinds (must match core.event.EventKind)
@@ -97,8 +98,25 @@ AUX_KIND_SHIFT = AUX_SRC_SHIFT + AUX_SRC_BITS
 MAX_LANES = 1 << AUX_SRC_BITS
 _SRC_MASK = (1 << AUX_SRC_BITS) - 1
 
-NEVER32 = 0x7FFFFFFF  # plain int: no device array at import time
-MASK31 = 0x7FFFFFFF
+NEVER32 = _pairs.NEVER32
+MASK31 = _pairs.MASK31
+MOD_SMALL_LIMIT = _pairs.MOD_SMALL_LIMIT
+
+# pair arithmetic helpers (shared with the stream tier — lanes_pairs.py)
+pair_lt = _pairs.pair_lt
+pair_ge = _pairs.pair_ge
+pair_min_lanes = _pairs.pair_min_lanes
+pair_add32 = _pairs.pair_add32
+pair_sub32 = _pairs.pair_sub32
+pair_add_pair = _pairs.pair_add_pair
+pair_max = _pairs.pair_max
+pair_sel = _pairs.pair_sel
+pair_sub_clamp = _pairs.pair_sub_clamp
+pair_sub_pair = _pairs.pair_sub_pair
+pair_abs_diff = _pairs.pair_abs_diff
+pair_div_pow2 = _pairs.pair_div_pow2
+pair_mul_small = _pairs.pair_mul_small
+pair_mod_small = _pairs.pair_mod_small
 
 
 def pack_aux_hi(kind, src):
@@ -135,72 +153,6 @@ def t_join(hi, lo):
     return jnp.where(hi == NEVER32, NEVER, t)
 
 
-def pair_lt(ahi, alo, bhi, blo):
-    return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
-
-
-def pair_ge(ahi, alo, bhi, blo):
-    return ~pair_lt(ahi, alo, bhi, blo)
-
-
-def pair_min_lanes(hi, lo):
-    """Lexicographic min over all elements of an (hi, lo) pair array."""
-    mh = jnp.min(hi)
-    ml = jnp.min(jnp.where(hi == mh, lo, NEVER32))
-    return mh, ml
-
-
-def pair_add32(hi, lo, x):
-    """pair + x for 0 <= x < 2**31 (x int32 scalar or [N])."""
-    t = lo + x  # may wrap into the sign bit: that IS the carry
-    return hi + (t < 0).astype(jnp.int32), t & MASK31
-
-
-def pair_sub32(hi, lo, x):
-    """pair - x for 0 <= x < 2**31; caller guarantees pair >= x.
-    t < 0 means the true low word is t + 2**31, whose int32 bit pattern
-    is t & MASK31 (adding 2**31 just clears the sign bit mod 2**32)."""
-    t = lo - x
-    return hi - (t < 0).astype(jnp.int32), t & MASK31
-
-
-def pair_add_pair(ahi, alo, bhi, blo):
-    t = alo + blo
-    return ahi + bhi + (t < 0).astype(jnp.int32), t & MASK31
-
-
-def pair_max(ahi, alo, bhi, blo):
-    a_wins = pair_ge(ahi, alo, bhi, blo)
-    return jnp.where(a_wins, ahi, bhi), jnp.where(a_wins, alo, blo)
-
-
-def pair_sel(c, ahi, alo, bhi, blo):
-    return jnp.where(c, ahi, bhi), jnp.where(c, alo, blo)
-
-
-def pair_sub_clamp(ahi, alo, bhi, blo, lim):
-    """max(0, min(a - b, lim)) as int32 — exact whenever the true
-    difference lies in [0, lim] (lim < 2**31)."""
-    d = ahi - bhi
-    raw = alo - blo  # in (-2**31, 2**31)
-    ge = pair_ge(ahi, alo, bhi, blo)
-    # d == 1 with raw < 0: value = 2**31 + raw = (raw + 1) + MASK31,
-    # which cannot overflow because raw + 1 <= 0
-    return jnp.where(
-        ~ge,
-        0,
-        jnp.where(
-            d == 0,
-            jnp.minimum(raw, lim),
-            jnp.where(
-                (d == 1) & (raw < 0),
-                jnp.minimum((raw + 1) + MASK31, lim),
-                lim,
-            ),
-        ),
-    )
-
-
 def split64(v):
     """Non-negative int64 -> (hi, lo) int32 pair (no NEVER handling)."""
     return (v >> 31).astype(jnp.int32), (v & MASK31).astype(jnp.int32)
@@ -216,7 +168,10 @@ class LaneState(NamedTuple):
     q_auxh: jnp.ndarray  # int32 kind<<29 | src<<12
     q_auxl: jnp.ndarray  # int32 seq
     q_size: jnp.ndarray  # int32
-    q_pay: jnp.ndarray  # int64 opaque payload (stream tier); () otherwise
+    # opaque payload words (stream tier: flags<<26|seq, ack — see
+    # lanes_stream.pack_pay); () when no stream models are present
+    q_phi: jnp.ndarray  # int32
+    q_plo: jnp.ndarray  # int32
     # per-lane counters [N] — int32 throughout (the engine checks for
     # wrap at readback: every counter is monotone, so a final negative
     # value flags > 2**31 increments)
@@ -291,6 +246,10 @@ class LaneParams:
     # smallest latency actually used so far, never below the floor
     dynamic_runahead: bool = False
     runahead_floor: int = 1
+    # every stream server serves exactly one client: server flow rows live
+    # at the server's own lane and the per-slot row gather/scatter
+    # disappears (TpuEngine detects this from the config)
+    stream_one_to_one: bool = False
     # window-advance+pop steps per fused while-loop trip (amortizes the
     # ~350 us per-iteration host round-trip of the tunneled runtime).
     # Multiplies XLA compile time with the body size — worth it for small
@@ -332,9 +291,11 @@ class LaneTables(NamedTuple):
     p_count: jnp.ndarray  # [N] int32 message budget (ping client)
     p_stride: jnp.ndarray  # [N] int32 (tgen-mesh)
     codel_div: jnp.ndarray  # [1025] int32
-    st_segs: jnp.ndarray  # [N] int64 stream-client data segments
-    st_mss: jnp.ndarray  # [N] int64
-    st_last: jnp.ndarray  # [N] int64 final-segment payload bytes
+    st_segs: jnp.ndarray  # [N] int32 stream-client data segments
+    st_mss: jnp.ndarray  # [N] int32
+    st_last: jnp.ndarray  # [N] int32 final-segment payload bytes
+    st_cl_of: jnp.ndarray  # [N] int32: server lane -> its client lane
+                           # (one-to-one mode; own lane elsewhere)
 
 
 # --------------------------------------------------------------------------
@@ -364,8 +325,6 @@ def bucket_charge_vec(
 
     FIFO law: the charge clock is ``max(t, last_depart)`` so departures
     are monotone per lane."""
-    i32 = jnp.int32
-    i64 = jnp.int64
     unlimited = rate == 0
     act = active & ~unlimited
     t_hi, t_lo = pair_max(t_hi, t_lo, ld_hi, ld_lo)
@@ -379,10 +338,10 @@ def bucket_charge_vec(
     )
     # next_refill': nr + k_true*interval == first grid point past t.
     # Non-saturated: nr + k*interval (k == k_true).  Saturated: realign
-    # from t's grid phase directly.
+    # from t's grid phase directly — chunked int32 mod (the int64 ``%``
+    # was the hot loop's last X64 custom call)
     part_hi, part_lo = pair_add32(nr_hi, nr_lo, k * interval)
-    t64 = t_join(t_hi, t_lo)
-    tmod = (t64 % interval).astype(i32)
+    tmod = pair_mod_small(t_hi, t_lo, interval)
     g_hi, g_lo = pair_add32(*pair_sub32(t_hi, t_lo, tmod), interval)
     nr_hi = jnp.where(do_refill, jnp.where(full, g_hi, part_hi), nr_hi)
     nr_lo = jnp.where(do_refill, jnp.where(full, g_lo, part_lo), nr_lo)
@@ -508,14 +467,15 @@ def _sort_queues(s: LaneState, with_pay: bool = False) -> LaneState:
     Establishes the sorted-row invariant on entry states
     (``TpuEngine.initial_state``) and restores it on iterations that pop
     events but skip the merge (see ``iter_body``).  ``with_pay`` carries the
-    stream payload column through the permutation (static: stream tier)."""
+    stream payload columns through the permutation (static: stream tier)."""
     if with_pay:
-        thi, tlo, ah, al, size, pay = lax.sort(
-            (s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size, s.q_pay),
+        thi, tlo, ah, al, size, phi, plo = lax.sort(
+            (s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size, s.q_phi,
+             s.q_plo),
             dimension=1, num_keys=4,
         )
         return s._replace(q_thi=thi, q_tlo=tlo, q_auxh=ah, q_auxl=al,
-                          q_size=size, q_pay=pay)
+                          q_size=size, q_phi=phi, q_plo=plo)
     thi, tlo, ah, al, size = lax.sort(
         (s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size),
         dimension=1, num_keys=4,
@@ -536,7 +496,8 @@ class _SlotEmit(NamedTuple):
     ins_auxh: jnp.ndarray  # int32
     ins_auxl: jnp.ndarray  # int32
     ins_size: jnp.ndarray  # int32
-    ins_pay: jnp.ndarray  # int64
+    ins_phi: jnp.ndarray  # int32 payload words
+    ins_plo: jnp.ndarray
     # same-lane insert channel 2: timer re-arm / stream pump (LOCAL)
     arm_valid: jnp.ndarray
     arm_thi: jnp.ndarray
@@ -544,14 +505,14 @@ class _SlotEmit(NamedTuple):
     arm_auxh: jnp.ndarray
     arm_auxl: jnp.ndarray
     arm_size: jnp.ndarray  # int32 (0 timer, -2 pump)
-    arm_pay: jnp.ndarray  # int64 (stream flow id)
+    arm_plo: jnp.ndarray  # int32 (stream flow id; phi is always 0)
     # same-lane insert channel 3: stream RTO arm (LOCAL, size -3)
     arm2_valid: jnp.ndarray
     arm2_thi: jnp.ndarray
     arm2_tlo: jnp.ndarray
     arm2_auxh: jnp.ndarray
     arm2_auxl: jnp.ndarray
-    arm2_pay: jnp.ndarray
+    arm2_plo: jnp.ndarray
     # cross-lane channel: outbound packets
     out_valid: jnp.ndarray
     out_dst: jnp.ndarray  # int32
@@ -560,7 +521,8 @@ class _SlotEmit(NamedTuple):
     out_auxh: jnp.ndarray
     out_auxl: jnp.ndarray
     out_size: jnp.ndarray
-    out_pay: jnp.ndarray  # int64
+    out_phi: jnp.ndarray  # int32 payload words
+    out_plo: jnp.ndarray
     # log record channel (int64; zeros when logging is off)
     rec_valid: jnp.ndarray
     rec_time: jnp.ndarray
@@ -583,15 +545,15 @@ def _process_slot(
     thi, tlo = slot["thi"], slot["tlo"]
     kind, src, seq = slot["kind"], slot["src"], slot["seq"]  # int32
     size = slot["size"]
-    pay = slot["pay"]
+    phi, plo = slot["phi"], slot["plo"]
     active = slot["act"]
     false_n = jnp.zeros(n, dtype=bool)
 
     i64 = jnp.int64
     i32 = jnp.int32
     sp = p.stream_present
-    # the stream tier's scalar law runs on int64 times (sp-gated edge)
-    t64 = t_join(thi, tlo) if (sp or p.log_capacity) else None
+    # the only int64 left is the log-record channel (edge work)
+    t64 = t_join(thi, tlo) if p.log_capacity else None
 
     # ---- PACKET pops: down bucket + CoDel -> DELIVERY self-insert --------
     is_pkt = active & (kind == PACKET)
@@ -635,7 +597,7 @@ def _process_slot(
     ins_auxh = pack_aux_hi(jnp.full(n, DELIVERY, dtype=i32), src)
     ins_auxl = seq
     ins_size = size
-    ins_pay = pay
+    ins_phi, ins_plo = phi, plo
 
     # packet outcome log record
     pk_rec_valid = is_pkt
@@ -676,39 +638,41 @@ def _process_slot(
 
     # ---- stream tier (vectorized lane-TCP; static gate) ------------------
     if sp:
-        t = t64
         is_cl = model == M_STREAM_CLIENT
         is_sv = model == M_STREAM_SERVER
         st_any = is_cl | is_sv
-        flags_in, sseq_in, sack_in = lstr.unpack_pay(pay)
-        # flow id: the client lane (delivery src at the server, payload
-        # word on server locals, own lane otherwise)
+        flags_in, sseq_in, sack_in = lstr.unpack_pay(phi, plo)
         stim_open = is_start & is_cl
         stim_pump = is_loc & (size == lstr.SZ_PUMP) & st_any
         stim_rto = is_loc & (size == lstr.SZ_RTO) & st_any
-        # pay == 0 is a foreign (non-ltcp) datagram delivered to a stream
-        # lane in a mixed workload: every real segment carries flags != 0.
-        # The CPU oracle ignores those via its isinstance check
-        # (tcpflow.StreamServer.on_delivery) — mirror it exactly
-        stim_seg = is_del & st_any & (pay != 0)
+        # zero payload words mark a foreign (non-ltcp) datagram delivered
+        # to a stream lane in a mixed workload: every real segment carries
+        # flags != 0.  The CPU oracle ignores those via its isinstance
+        # check (tcpflow.StreamServer.on_delivery) — mirror it exactly
+        stim_seg = is_del & st_any & ((phi | plo) != 0)
         stream_stim = stim_open | stim_pump | stim_rto | stim_seg
-        flow = jnp.where(
-            is_sv,
-            jnp.where(stim_seg, src, (pay & 0xFFFFFFFF).astype(jnp.int32)),
-            lanes,
-        )
+        # flow id: the client lane (delivery src at the server, payload
+        # word on server locals, own lane otherwise).  In one-to-one mode
+        # the server's flow is a static table lookup — no payload read
+        if p.stream_one_to_one:
+            flow = jnp.where(is_sv, tb.st_cl_of, lanes)
+        else:
+            flow = jnp.where(
+                is_sv, jnp.where(stim_seg, src, plo), lanes
+            )
         server_mask = stream_stim & is_sv
         f = lstr.gather_cols(
-            s.stream, flow, server_mask, tb.st_segs, tb.st_mss, tb.st_last
+            s.stream, flow, server_mask, tb.st_segs, tb.st_mss, tb.st_last,
+            p.stream_one_to_one,
         )
-        f1, em1 = lstr.open_flow_vec(f, t, stim_open)
+        f1, em1 = lstr.open_flow_vec(f, thi, tlo, stim_open)
         f = lstr._merge_cols(f, f1, stim_open)
-        f2, em2 = lstr.on_pump_vec(f, t, stim_pump)
+        f2, em2 = lstr.on_pump_vec(f, thi, tlo, stim_pump)
         f = lstr._merge_cols(f, f2, stim_pump)
-        f3, em3 = lstr.on_rto_vec(f, t, stim_rto)
+        f3, em3 = lstr.on_rto_vec(f, thi, tlo, stim_rto)
         f = lstr._merge_cols(f, f3, stim_rto)
         f4, em4 = lstr.on_segment_vec(
-            f, t, stim_seg, flags_in, sseq_in, sack_in, size.astype(jnp.int64)
+            f, thi, tlo, stim_seg, flags_in, sseq_in, sack_in, size
         )
         f = lstr._merge_cols(f, f4, stim_seg)
         sem = lstr._merge_emit(
@@ -723,7 +687,8 @@ def _process_slot(
             completed=f.completed | (sem.completed_now & stream_stim)
         )
         stream_state = lstr.scatter_cols(
-            s.stream, f, flow, stream_stim & ~server_mask, server_mask
+            s.stream, f, flow, stream_stim & ~server_mask, server_mask,
+            p.stream_one_to_one,
         )
         s = s._replace(stream=stream_state)
         st_send = sem.send_valid & stream_stim
@@ -778,13 +743,14 @@ def _process_slot(
         # server sends go to the flow's client lane; clients to p_peer
         dst = jnp.where(st_send, jnp.where(is_sv, flow, tb.p_peer), dst).astype(i32)
         out_size = jnp.where(st_send, sem.send_size, out_size).astype(i32)
-        out_pay = jnp.where(
-            st_send,
-            lstr.pack_pay(sem.send_flags, sem.send_seq, sem.send_ack),
-            jnp.zeros(n, dtype=i64),
+        pk_phi, pk_plo = lstr.pack_pay(
+            sem.send_flags, sem.send_seq, sem.send_ack
         )
+        z32n = jnp.zeros(n, dtype=i32)
+        out_phi = jnp.where(st_send, pk_phi, z32n)
+        out_plo = jnp.where(st_send, pk_plo, z32n)
     else:
-        out_pay = jnp.zeros(n, dtype=i64)
+        out_phi = out_plo = jnp.zeros(n, dtype=i32)
 
     # per-send sequence numbers
     snd_seq = s.send_seq
@@ -849,7 +815,7 @@ def _process_slot(
     ti_hi, ti_lo = pair_add_pair(thi, tlo, tb.p_int_hi, tb.p_int_lo)
     arm_thi, arm_tlo = pair_sel(st_pump, thi, tlo, ti_hi, ti_lo)
     arm_size = jnp.where(st_pump, lstr.SZ_PUMP, 0).astype(i32)
-    arm_pay = jnp.where(st_pump, flow.astype(i64), 0)
+    arm_plo = jnp.where(st_pump, flow, 0)
     loc_auxh = pack_aux_hi(jnp.full(n, LOCAL, dtype=i32), lanes)
     arm_auxh = loc_auxh
     arm_auxl = s.local_seq
@@ -858,14 +824,13 @@ def _process_slot(
     # pump before the RTO inside one stimulus)
     arm2_valid = st_rto
     if sp:
-        rto64 = sem.rto_time
-        arm2_thi, arm2_tlo = t_split(rto64)
-        arm2_pay = jnp.where(st_rto, flow.astype(i64), 0)
+        arm2_thi, arm2_tlo = sem.rto_thi, sem.rto_tlo
+        arm2_plo = jnp.where(st_rto, flow, 0)
         s = s._replace(local_seq=s.local_seq + arm2_valid)
     else:
         arm2_thi = jnp.zeros(n, dtype=i32)
         arm2_tlo = jnp.zeros(n, dtype=i32)
-        arm2_pay = arm_pay
+        arm2_plo = arm_plo
     arm2_auxh = loc_auxh
     arm2_auxl = s.local_seq
 
@@ -883,10 +848,12 @@ def _process_slot(
         rec_time = rec_src = rec_dst = rec_seq = rec_size = rec_outcome = z64
 
     emit = _SlotEmit(
-        ins_valid, ins_thi, ins_tlo, ins_auxh, ins_auxl, ins_size, ins_pay,
-        rearm, arm_thi, arm_tlo, arm_auxh, arm_auxl, arm_size, arm_pay,
-        arm2_valid, arm2_thi, arm2_tlo, arm2_auxh, arm2_auxl, arm2_pay,
-        out_valid, dst, arr_hi, arr_lo, out_auxh, out_auxl, out_size, out_pay,
+        ins_valid, ins_thi, ins_tlo, ins_auxh, ins_auxl, ins_size, ins_phi,
+        ins_plo,
+        rearm, arm_thi, arm_tlo, arm_auxh, arm_auxl, arm_size, arm_plo,
+        arm2_valid, arm2_thi, arm2_tlo, arm2_auxh, arm2_auxl, arm2_plo,
+        out_valid, dst, arr_hi, arr_lo, out_auxh, out_auxl, out_size,
+        out_phi, out_plo,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
     )
     return s, emit
@@ -968,7 +935,8 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     auxh_parts = [emits.ins_auxh.T, emits.arm_auxh.T]
     auxl_parts = [emits.ins_auxl.T, emits.arm_auxl.T]
     size_parts = [emits.ins_size.T, emits.arm_size.T]
-    pay_parts = [emits.ins_pay.T, emits.arm_pay.T]
+    phi_parts = [emits.ins_phi.T, jnp.zeros_like(emits.arm_plo.T)]
+    plo_parts = [emits.ins_plo.T, emits.arm_plo.T]
     if sp:
         self_parts.append(emits.arm2_valid.T)
         thi_parts.append(emits.arm2_thi.T)
@@ -976,14 +944,16 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
         auxh_parts.append(emits.arm2_auxh.T)
         auxl_parts.append(emits.arm2_auxl.T)
         size_parts.append(jnp.full_like(emits.ins_size.T, lstr.SZ_RTO))
-        pay_parts.append(emits.arm2_pay.T)
+        phi_parts.append(jnp.zeros_like(emits.arm2_plo.T))
+        plo_parts.append(emits.arm2_plo.T)
     self_valid = jnp.concatenate(self_parts, axis=1)
     self_thi = jnp.where(self_valid, jnp.concatenate(thi_parts, axis=1), NEVER32)
     self_tlo = jnp.where(self_valid, jnp.concatenate(tlo_parts, axis=1), NEVER32)
     self_auxh = jnp.concatenate(auxh_parts, axis=1)
     self_auxl = jnp.concatenate(auxl_parts, axis=1)
     self_size = jnp.concatenate(size_parts, axis=1)
-    self_pay = jnp.concatenate(pay_parts, axis=1)
+    self_phi = jnp.concatenate(phi_parts, axis=1)
+    self_plo = jnp.concatenate(plo_parts, axis=1)
 
     # -- cross-lane block [N, C] via sort-by-dst + segment gather ----------
     valid = emits.out_valid.reshape(-1)
@@ -993,10 +963,11 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     flat_ops = [dst, out_thi, out_tlo, emits.out_auxh.reshape(-1),
                 emits.out_auxl.reshape(-1), emits.out_size.reshape(-1)]
     if sp:
-        flat_ops.append(emits.out_pay.reshape(-1))
+        flat_ops.append(emits.out_phi.reshape(-1))
+        flat_ops.append(emits.out_plo.reshape(-1))
     sorted_ops = lax.sort(tuple(flat_ops), dimension=0, num_keys=1)
     dst_s, thi_s, tlo_s, auxh_s, auxl_s, size_s = sorted_ops[:6]
-    pay_s = sorted_ops[6] if sp else None
+    pay_s = sorted_ops[6:8] if sp else None
     # one search over [0..N]: start of lane n+1 is the end of lane n
     bounds = jnp.searchsorted(
         dst_s, jnp.arange(n + 1, dtype=dst_s.dtype), side="left"
@@ -1005,7 +976,9 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     cnt = bounds[1:] - start
     r = jnp.arange(c, dtype=jnp.int32)[None, :]  # [1, C]
     in_seg = r < cnt[:, None]
-    gather_ops = [thi_s, tlo_s, auxh_s, auxl_s, size_s] + ([pay_s] if sp else [])
+    gather_ops = [thi_s, tlo_s, auxh_s, auxl_s, size_s] + (
+        list(pay_s) if sp else []
+    )
     gathered = _window_gather(gather_ops, start, c)
     g_thi, g_tlo, g_auxh, g_auxl, g_size = gathered[:5]
     cross_thi = jnp.where(in_seg, g_thi, NEVER32).astype(jnp.int32)
@@ -1013,7 +986,9 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     cross_auxh = jnp.where(in_seg, g_auxh, 0).astype(jnp.int32)
     cross_auxl = jnp.where(in_seg, g_auxl, 0).astype(jnp.int32)
     cross_size = jnp.where(in_seg, g_size, 0).astype(jnp.int32)
-    cross_pay = jnp.where(in_seg, gathered[5], 0) if sp else None
+    if sp:
+        cross_phi = jnp.where(in_seg, gathered[5], 0)
+        cross_plo = jnp.where(in_seg, gathered[6], 0)
     # receivers of more than C events in one iteration lose the tail
     # before the merge even sees it; count those drops too
     lost_pre = jnp.maximum(cnt - c, 0)
@@ -1026,9 +1001,10 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     ml = jnp.concatenate([s.q_auxl, self_auxl, cross_auxl], axis=1)
     ms = jnp.concatenate([s.q_size, self_size, cross_size], axis=1)
     if sp:
-        mpay = jnp.concatenate([s.q_pay, self_pay, cross_pay], axis=1)
-        mthi, mtlo, mh, ml, ms, mpay = lax.sort(
-            (mthi, mtlo, mh, ml, ms, mpay), dimension=1, num_keys=4
+        mphi = jnp.concatenate([s.q_phi, self_phi, cross_phi], axis=1)
+        mplo = jnp.concatenate([s.q_plo, self_plo, cross_plo], axis=1)
+        mthi, mtlo, mh, ml, ms, mphi, mplo = lax.sort(
+            (mthi, mtlo, mh, ml, ms, mphi, mplo), dimension=1, num_keys=4
         )
     else:
         mthi, mtlo, mh, ml, ms = lax.sort(
@@ -1045,7 +1021,7 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
         + lost_pre,
     )
     if sp:
-        s = s._replace(q_pay=mpay[:, :c])
+        s = s._replace(q_phi=mphi[:, :c], q_plo=mplo[:, :c])
 
     # overflow log records from the merge tail (pre-gather losses surface
     # only in n_queue; both paths raise in strict mode).  Only materialized
@@ -1147,9 +1123,11 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
             "size": s.q_size[:, :k],
             # without the stream tier there is no payload column at all
             # (dead carry costs per-iteration wall time); slots still see
-            # a zeros operand, which XLA folds
-            "pay": s.q_pay[:, :k] if p.stream_present
-            else jnp.zeros((p.n_lanes, k), dtype=jnp.int64),
+            # zeros operands, which XLA folds
+            "phi": s.q_phi[:, :k] if p.stream_present
+            else jnp.zeros((p.n_lanes, k), dtype=jnp.int32),
+            "plo": s.q_plo[:, :k] if p.stream_present
+            else jnp.zeros((p.n_lanes, k), dtype=jnp.int32),
             "act": act,
         }
         consumed = popped["act"]
@@ -1182,10 +1160,10 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 z64 = jnp.zeros(p.n_lanes, dtype=jnp.int64)
                 z32 = jnp.zeros(p.n_lanes, dtype=jnp.int32)
                 return st_, _SlotEmit(
-                    nb, z32, z32, z32, z32, z32, z64,
-                    nb, z32, z32, z32, z32, z32, z64,
-                    nb, z32, z32, z32, z32, z64,
-                    nb, z32, z32, z32, z32, z32, z32, z64,
+                    nb, z32, z32, z32, z32, z32, z32, z32,
+                    nb, z32, z32, z32, z32, z32, z32,
+                    nb, z32, z32, z32, z32, z32,
+                    nb, z32, z32, z32, z32, z32, z32, z32, z32,
                     nb, z64, z64, z64, z64, z64, z64,
                 )
 
@@ -1316,7 +1294,11 @@ _SCALAR_FIELDS = ("log_count", "log_lost", "rounds", "now_we_hi", "now_we_lo",
 
 
 def pack_state(s: LaneState):
-    q = jnp.stack([s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size])
+    q_cols = [s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size]
+    has_pay = not isinstance(s.q_phi, tuple)
+    if has_pay:
+        q_cols += [s.q_phi, s.q_plo]
+    q = jnp.stack(q_cols)
     c32 = jnp.stack(
         [getattr(s, f) for f in _I32_N_FIELDS]
         + [s.cd_dropping.astype(jnp.int32)]
@@ -1324,16 +1306,18 @@ def pack_state(s: LaneState):
     sc = jnp.stack(
         [jnp.asarray(getattr(s, f), dtype=jnp.int32) for f in _SCALAR_FIELDS]
     )
-    return (q, c32, sc, s.log, s.q_pay, s.stream)
+    return (q, c32, sc, s.log, s.stream)
 
 
 def unpack_state(carry) -> LaneState:
-    q, c32, sc, log, q_pay, stream = carry
+    q, c32, sc, log, stream = carry
+    has_pay = q.shape[0] == 7
     kw = {f: c32[i] for i, f in enumerate(_I32_N_FIELDS)}
     kw.update({f: sc[i] for i, f in enumerate(_SCALAR_FIELDS)})
     return LaneState(
         q_thi=q[0], q_tlo=q[1], q_auxh=q[2], q_auxl=q[3], q_size=q[4],
-        q_pay=q_pay, stream=stream,
+        q_phi=q[5] if has_pay else (), q_plo=q[6] if has_pay else (),
+        stream=stream,
         cd_dropping=c32[len(_I32_N_FIELDS)].astype(bool),
         log=log, **kw,
     )

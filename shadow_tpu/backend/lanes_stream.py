@@ -1,22 +1,34 @@
-"""Vectorized lane-TCP: the stream tier on device.
+"""Vectorized lane-TCP: the stream tier on device, in pure int32 lanes.
 
 The masked-vector twin of the scalar law in :mod:`shadow_tpu.net.ltcp`
 (SURVEY §7 hard part (e): "TCP state machine vectorization").  One flow per
-stream-client lane; all flow state lives in ``[N]`` integer arrays indexed
-by the CLIENT lane (the flow's identity on both ends, mirroring the CPU
-models' ``(client, conn)`` key with conn=0):
+stream-client lane; flow state lives in two ``[N, F]`` int32 matrices —
+``cl`` (client endpoints, indexed by client lane) and ``sv`` (server
+endpoints, indexed by the client lane in the general case, by the SERVER
+lane when the config pairs every server with exactly one client).
 
-- client-role columns (``cl_*``) are the client's FlowState, updated in
-  place on the client lane;
-- server-role columns (``sv_*``) are the server's FlowState for flow c,
-  gathered/scattered at index c — unique per slot because each lane pops
-  at most one event and every flow has exactly one client lane.
+**Representation.** TPU has no native int64 (every i64 op lowers to
+unfusable X64 custom calls whose per-launch overhead dominated the mixed
+bench), so every column is int32: sequence state, congestion control, and
+counters are plain int32 (engine-guarded magnitudes), and the six
+time-valued fields (srtt, rttvar, rto, rtt_ts, rto_deadline, rto_evt) are
+(hi, lo) int32 pairs in the same split encoding as the event keys
+(``lanes.t_split``).  ``now`` enters as a pair; no int64 exists anywhere in
+the law.  The arithmetic is exactly the scalar law's — pair add/sub/mul-by-
+small-constant/div-by-power-of-two reproduce the integer results bit for
+bit (the CPU oracle these lanes are diffed against).
 
-Wire payloads pack ``flags(4) | seq(28) | ack(28)`` into one int64 queue
-word; pump/RTO local events are marked by size -2/-3 and carry the flow id
-in the payload word.  Every stimulus handler below is a line-for-line
-masked translation of ltcp.py's scalar functions — the CPU oracle these
-lanes are diffed against bit-for-bit.
+**Wire payloads** pack ``flags(4) | seq(26)`` into one int32 queue word and
+``ack`` into a second (engine guard: seq units < 2**26); pump/RTO local
+events are marked by size -2/-3 and carry the flow id in the low payload
+word.
+
+**Indexing.**  The general (star) case gathers/scatters server rows at the
+flow index — one row-gather + one row-scatter per endpoint matrix per slot
+(rows vectorize where per-element access serializes).  When every stream
+server serves exactly ONE client (``one_to_one``), server rows live at the
+server's own lane and the gather/scatter disappear entirely: slot access
+is a masked elementwise select.
 """
 
 from __future__ import annotations
@@ -25,141 +37,82 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from ..core.time import NEVER
 from ..net import ltcp
+from . import lanes_pairs as lp
 
 # size-field markers for stream LOCAL events
 SZ_PUMP = -2
 SZ_RTO = -3
 
-# payload packing: flags(4) | seq(28) | ack(28)
-_P_SEQ_BITS = 28
-_P_MASK = (1 << _P_SEQ_BITS) - 1
+# payload packing: word0 = flags(4) << 26 | seq(26); word1 = ack
+PAY_SEQ_BITS = 26
+PAY_SEQ_MASK = (1 << PAY_SEQ_BITS) - 1
+
+NEVER32 = lp.NEVER32
+
+# RTO constants as static pair splits (python ints at trace time)
+_RTO_INIT_P = (ltcp.RTO_INIT >> 31, ltcp.RTO_INIT & lp.MASK31)
+_RTO_MIN_P = (ltcp.RTO_MIN >> 31, ltcp.RTO_MIN & lp.MASK31)
+_RTO_MAX_P = (ltcp.RTO_MAX >> 31, ltcp.RTO_MAX & lp.MASK31)
+_GRAN_P = (0, 1_000_000)  # RFC 6298 1 ms granularity floor
 
 
 def pack_pay(flags, seq, ack):
-    i64 = jnp.int64
-    return (
-        (jnp.asarray(flags).astype(i64) << (2 * _P_SEQ_BITS))
-        | (jnp.asarray(seq).astype(i64) << _P_SEQ_BITS)
-        | jnp.asarray(ack).astype(i64)
-    )
+    """(flags, seq, ack) -> (word0, word1) int32 pair."""
+    i32 = jnp.int32
+    w0 = (jnp.asarray(flags).astype(i32) << PAY_SEQ_BITS) | jnp.asarray(
+        seq
+    ).astype(i32)
+    return w0, jnp.asarray(ack).astype(i32)
 
 
-def unpack_pay(pay):
-    flags = (pay >> (2 * _P_SEQ_BITS)).astype(jnp.int32)
-    seq = (pay >> _P_SEQ_BITS) & _P_MASK
-    ack = pay & _P_MASK
-    return flags, seq, ack
+def unpack_pay(w0, w1):
+    flags = w0 >> PAY_SEQ_BITS
+    seq = w0 & PAY_SEQ_MASK
+    return flags, seq, w1
+
+
+# -- column layout of the per-endpoint [N, F] int32 matrix -------------------
+(C_STATE, C_SND_UNA, C_SND_NXT, C_RCV_NXT, C_CWND, C_SSTHRESH, C_DUP_ACKS,
+ C_IN_REC, C_RECOVER, C_MAX_SENT, C_RTT_SEQ,
+ C_SRTT_HI, C_SRTT_LO, C_RTTVAR_HI, C_RTTVAR_LO, C_RTO_HI, C_RTO_LO,
+ C_RTT_TS_HI, C_RTT_TS_LO, C_RTODL_HI, C_RTODL_LO, C_RTOEV_HI, C_RTOEV_LO,
+ C_TX_SEGS, C_RETRANS, C_COMPLETED, C_RX_SEGS, C_RX_BYTES) = range(28)
+N_COLS = 28
 
 
 class StreamState(NamedTuple):
-    """Per-flow columns, all [N] indexed by client lane.  ``cl_*`` is the
-    client endpoint, ``sv_*`` the server endpoint of the same flow."""
+    """Two [N, F] int32 matrices: client endpoints (indexed by client lane)
+    and server endpoints (indexed by client lane, or by server lane in
+    one-to-one mode)."""
 
-    # client endpoint (ltcp.FlowState fields)
-    cl_state: jnp.ndarray  # int32
-    cl_snd_una: jnp.ndarray  # int64
-    cl_snd_nxt: jnp.ndarray
-    cl_rcv_nxt: jnp.ndarray
-    cl_cwnd_fp: jnp.ndarray
-    cl_ssthresh_fp: jnp.ndarray
-    cl_dup_acks: jnp.ndarray  # int32
-    cl_in_rec: jnp.ndarray  # bool
-    cl_recover: jnp.ndarray
-    cl_max_sent: jnp.ndarray
-    cl_srtt: jnp.ndarray
-    cl_rttvar: jnp.ndarray
-    cl_rto: jnp.ndarray
-    cl_rtt_seq: jnp.ndarray
-    cl_rtt_ts: jnp.ndarray
-    cl_rto_deadline: jnp.ndarray
-    cl_rto_evt: jnp.ndarray
-    cl_tx_segs: jnp.ndarray
-    cl_retransmits: jnp.ndarray
-    cl_completed: jnp.ndarray  # bool
-    # server endpoint (full FlowState mirror)
-    sv_state: jnp.ndarray
-    sv_snd_una: jnp.ndarray
-    sv_snd_nxt: jnp.ndarray
-    sv_rcv_nxt: jnp.ndarray
-    sv_cwnd_fp: jnp.ndarray
-    sv_ssthresh_fp: jnp.ndarray
-    sv_dup_acks: jnp.ndarray
-    sv_in_rec: jnp.ndarray
-    sv_recover: jnp.ndarray
-    sv_max_sent: jnp.ndarray
-    sv_srtt: jnp.ndarray
-    sv_rttvar: jnp.ndarray
-    sv_rto: jnp.ndarray
-    sv_rtt_seq: jnp.ndarray
-    sv_rtt_ts: jnp.ndarray
-    sv_rto_deadline: jnp.ndarray
-    sv_rto_evt: jnp.ndarray
-    sv_rx_segs: jnp.ndarray
-    sv_rx_bytes: jnp.ndarray
-    sv_retransmits: jnp.ndarray
-    sv_tx_segs: jnp.ndarray
-    sv_completed: jnp.ndarray  # bool
+    cl: jnp.ndarray
+    sv: jnp.ndarray
 
 
-def init_stream_state(n: int, segs, mss, last_bytes) -> StreamState:
-    """Fresh columns; ``segs``/``mss``/``last_bytes`` are static [N] tables
-    (0 on non-client lanes)."""
-    i64 = jnp.int64
-    i32 = jnp.int32
-    z64 = jnp.zeros(n, dtype=i64)
-    z32 = jnp.zeros(n, dtype=i32)
-    zb = jnp.zeros(n, dtype=bool)
-    never = jnp.full(n, NEVER, dtype=i64)
-    return StreamState(
-        cl_state=z32,
-        cl_snd_una=z64,
-        cl_snd_nxt=z64,
-        cl_rcv_nxt=z64,
-        cl_cwnd_fp=jnp.full(n, ltcp.INIT_CWND_FP, dtype=i64),
-        cl_ssthresh_fp=jnp.full(n, ltcp.INIT_SSTHRESH_FP, dtype=i64),
-        cl_dup_acks=z32,
-        cl_in_rec=zb,
-        cl_recover=z64,
-        cl_max_sent=z64,
-        cl_srtt=jnp.full(n, -1, dtype=i64),
-        cl_rttvar=z64,
-        cl_rto=jnp.full(n, ltcp.RTO_INIT, dtype=i64),
-        cl_rtt_seq=jnp.full(n, -1, dtype=i64),
-        cl_rtt_ts=z64,
-        cl_rto_deadline=never,
-        cl_rto_evt=never,
-        cl_tx_segs=z64,
-        cl_retransmits=z64,
-        cl_completed=zb,
-        sv_state=z32,
-        sv_snd_una=z64,
-        sv_snd_nxt=z64,
-        sv_rcv_nxt=z64,
-        sv_cwnd_fp=jnp.full(n, ltcp.INIT_CWND_FP, dtype=i64),
-        sv_ssthresh_fp=jnp.full(n, ltcp.INIT_SSTHRESH_FP, dtype=i64),
-        sv_dup_acks=z32,
-        sv_in_rec=zb,
-        sv_recover=z64,
-        sv_max_sent=z64,
-        sv_srtt=jnp.full(n, -1, dtype=i64),
-        sv_rttvar=z64,
-        sv_rto=jnp.full(n, ltcp.RTO_INIT, dtype=i64),
-        sv_rtt_seq=jnp.full(n, -1, dtype=i64),
-        sv_rtt_ts=z64,
-        sv_rto_deadline=never,
-        sv_rto_evt=never,
-        sv_rx_segs=z64,
-        sv_rx_bytes=z64,
-        sv_retransmits=z64,
-        sv_tx_segs=z64,
-        sv_completed=zb,
-    )
+def _fresh_matrix(n: int) -> jnp.ndarray:
+    m = jnp.zeros((n, N_COLS), dtype=jnp.int32)
+    m = m.at[:, C_CWND].set(ltcp.INIT_CWND_FP)
+    m = m.at[:, C_SSTHRESH].set(ltcp.INIT_SSTHRESH_FP)
+    m = m.at[:, C_SRTT_HI].set(-1)
+    m = m.at[:, C_RTO_HI].set(_RTO_INIT_P[0])
+    m = m.at[:, C_RTO_LO].set(_RTO_INIT_P[1])
+    m = m.at[:, C_RTT_SEQ].set(-1)
+    m = m.at[:, C_RTODL_HI].set(NEVER32)
+    m = m.at[:, C_RTODL_LO].set(NEVER32)
+    m = m.at[:, C_RTOEV_HI].set(NEVER32)
+    m = m.at[:, C_RTOEV_LO].set(NEVER32)
+    return m
+
+
+def init_stream_state(n: int) -> StreamState:
+    """Fresh endpoint matrices (transfer-shape tables are static and live
+    in LaneTables, not here)."""
+    return StreamState(cl=_fresh_matrix(n), sv=_fresh_matrix(n))
 
 
 class FlowCols(NamedTuple):
-    """One endpoint's FlowState as gathered [N] columns + static shape."""
+    """One endpoint's FlowState as [N] int32 columns (+ static shape)."""
 
     state: jnp.ndarray
     snd_una: jnp.ndarray
@@ -168,25 +121,48 @@ class FlowCols(NamedTuple):
     cwnd_fp: jnp.ndarray
     ssthresh_fp: jnp.ndarray
     dup_acks: jnp.ndarray
-    in_rec: jnp.ndarray
+    in_rec: jnp.ndarray  # bool
     recover: jnp.ndarray
     max_sent: jnp.ndarray
-    srtt: jnp.ndarray
-    rttvar: jnp.ndarray
-    rto: jnp.ndarray
     rtt_seq: jnp.ndarray
-    rtt_ts: jnp.ndarray
-    rto_deadline: jnp.ndarray
-    rto_evt: jnp.ndarray
+    srtt_hi: jnp.ndarray  # pair (hi < 0 = no sample yet)
+    srtt_lo: jnp.ndarray
+    rttvar_hi: jnp.ndarray
+    rttvar_lo: jnp.ndarray
+    rto_hi: jnp.ndarray
+    rto_lo: jnp.ndarray
+    rtt_ts_hi: jnp.ndarray
+    rtt_ts_lo: jnp.ndarray
+    rtodl_hi: jnp.ndarray  # NEVER32 = unarmed
+    rtodl_lo: jnp.ndarray
+    rtoev_hi: jnp.ndarray
+    rtoev_lo: jnp.ndarray
     tx_segs: jnp.ndarray
     retransmits: jnp.ndarray
+    completed: jnp.ndarray  # bool
+    rx_segs: jnp.ndarray
+    rx_bytes: jnp.ndarray
     role: jnp.ndarray  # SENDER / RECEIVER
     segs: jnp.ndarray  # transfer shape (client flows; 0 for server role)
     mss: jnp.ndarray
     last_bytes: jnp.ndarray
-    rx_segs: jnp.ndarray
-    rx_bytes: jnp.ndarray
-    completed: jnp.ndarray  # bool: reached DONE before this stimulus
+
+
+_MATRIX_FIELDS = (
+    ("state", C_STATE), ("snd_una", C_SND_UNA), ("snd_nxt", C_SND_NXT),
+    ("rcv_nxt", C_RCV_NXT), ("cwnd_fp", C_CWND), ("ssthresh_fp", C_SSTHRESH),
+    ("dup_acks", C_DUP_ACKS), ("recover", C_RECOVER),
+    ("max_sent", C_MAX_SENT), ("rtt_seq", C_RTT_SEQ),
+    ("srtt_hi", C_SRTT_HI), ("srtt_lo", C_SRTT_LO),
+    ("rttvar_hi", C_RTTVAR_HI), ("rttvar_lo", C_RTTVAR_LO),
+    ("rto_hi", C_RTO_HI), ("rto_lo", C_RTO_LO),
+    ("rtt_ts_hi", C_RTT_TS_HI), ("rtt_ts_lo", C_RTT_TS_LO),
+    ("rtodl_hi", C_RTODL_HI), ("rtodl_lo", C_RTODL_LO),
+    ("rtoev_hi", C_RTOEV_HI), ("rtoev_lo", C_RTOEV_LO),
+    ("tx_segs", C_TX_SEGS), ("retransmits", C_RETRANS),
+    ("rx_segs", C_RX_SEGS), ("rx_bytes", C_RX_BYTES),
+)
+_BOOL_FIELDS = (("in_rec", C_IN_REC), ("completed", C_COMPLETED))
 
 
 class StreamEmit(NamedTuple):
@@ -199,12 +175,13 @@ class StreamEmit(NamedTuple):
     send_size: jnp.ndarray  # wire size
     pump_valid: jnp.ndarray  # arm a pump LOCAL at the current time
     rto_valid: jnp.ndarray  # arm an RTO LOCAL
-    rto_time: jnp.ndarray
+    rto_thi: jnp.ndarray  # pair: RTO event time
+    rto_tlo: jnp.ndarray
     completed_now: jnp.ndarray  # flow reached DONE on this stimulus
 
 
 # --------------------------------------------------------------------------
-# law helpers (vector twins of ltcp.py's helpers)
+# law helpers (pair twins of ltcp.py's helpers)
 # --------------------------------------------------------------------------
 
 
@@ -242,36 +219,68 @@ def _can_send_new(f: FlowCols):
     )
 
 
-def _rtt_sample(f: FlowCols, now, m) -> FlowCols:
-    """RFC 6298 update where mask ``m``."""
-    r = jnp.maximum(now - f.rtt_ts, 0)
-    first = f.srtt < 0
-    srtt1 = jnp.where(first, r, (7 * f.srtt + r) // 8)
-    delta = jnp.abs(f.srtt - r)
-    rttvar1 = jnp.where(first, r // 2, (3 * f.rttvar + delta) // 4)
-    rto1 = jnp.clip(
-        srtt1 + jnp.maximum(4 * rttvar1, 1_000_000), ltcp.RTO_MIN, ltcp.RTO_MAX
-    )
+def _rtt_sample(f: FlowCols, nh, nl, m) -> FlowCols:
+    """RFC 6298 update where mask ``m`` — identical integer results to the
+    scalar law, on pairs."""
+    # r = max(now - rtt_ts, 0)
+    nonneg = lp.pair_ge(nh, nl, f.rtt_ts_hi, f.rtt_ts_lo)
+    rh, rl = lp.pair_sub_pair(nh, nl, f.rtt_ts_hi, f.rtt_ts_lo)
+    rh = jnp.where(nonneg, rh, 0)
+    rl = jnp.where(nonneg, rl, 0)
+    first = f.srtt_hi < 0
+    # srtt' = first ? r : (7*srtt + r) // 8
+    s7h, s7l = lp.pair_mul_small(f.srtt_hi, f.srtt_lo, 7)
+    sh, sl = lp.pair_div_pow2(*lp.pair_add_pair(s7h, s7l, rh, rl), 3)
+    srtt1h = jnp.where(first, rh, sh)
+    srtt1l = jnp.where(first, rl, sl)
+    # delta = |srtt - r| (PRE-update srtt, as in the scalar law)
+    dh, dl = lp.pair_abs_diff(f.srtt_hi, f.srtt_lo, rh, rl)
+    # rttvar' = first ? r // 2 : (3*rttvar + delta) // 4
+    v3h, v3l = lp.pair_mul_small(f.rttvar_hi, f.rttvar_lo, 3)
+    vh, vl = lp.pair_div_pow2(*lp.pair_add_pair(v3h, v3l, dh, dl), 2)
+    r2h, r2l = lp.pair_div_pow2(rh, rl, 1)
+    var1h = jnp.where(first, r2h, vh)
+    var1l = jnp.where(first, r2l, vl)
+    # rto' = clip(srtt' + max(4*rttvar', 1 ms), RTO_MIN, RTO_MAX)
+    v4h, v4l = lp.pair_mul_small(var1h, var1l, 4)
+    v4h, v4l = lp.pair_max(v4h, v4l, _GRAN_P[0], _GRAN_P[1])
+    toh, tol = lp.pair_add_pair(srtt1h, srtt1l, v4h, v4l)
+    below = lp.pair_lt(toh, tol, _RTO_MIN_P[0], _RTO_MIN_P[1])
+    toh = jnp.where(below, _RTO_MIN_P[0], toh)
+    tol = jnp.where(below, _RTO_MIN_P[1], tol)
+    above = lp.pair_lt(_RTO_MAX_P[0], _RTO_MAX_P[1], toh, tol)
+    toh = jnp.where(above, _RTO_MAX_P[0], toh)
+    tol = jnp.where(above, _RTO_MAX_P[1], tol)
     return f._replace(
-        srtt=jnp.where(m, srtt1, f.srtt),
-        rttvar=jnp.where(m, rttvar1, f.rttvar),
-        rto=jnp.where(m, rto1, f.rto),
+        srtt_hi=jnp.where(m, srtt1h, f.srtt_hi),
+        srtt_lo=jnp.where(m, srtt1l, f.srtt_lo),
+        rttvar_hi=jnp.where(m, var1h, f.rttvar_hi),
+        rttvar_lo=jnp.where(m, var1l, f.rttvar_lo),
+        rto_hi=jnp.where(m, toh, f.rto_hi),
+        rto_lo=jnp.where(m, tol, f.rto_lo),
     )
 
 
-def _restart_rto(f: FlowCols, now, m, em_rto_valid, em_rto_time):
+def _restart_rto(f: FlowCols, nh, nl, m, em_rto_valid, em_rto_thi,
+                 em_rto_tlo):
     """(Re)start the retransmission timer where ``m``; returns (f, valid,
-    time) with the dedup law of ltcp._restart_rto."""
-    deadline = now + f.rto
-    arm = m & ((f.rto_evt == NEVER) | (deadline < f.rto_evt))
+    thi, tlo) with the dedup law of ltcp._restart_rto."""
+    dlh, dll = lp.pair_add_pair(nh, nl, f.rto_hi, f.rto_lo)
+    arm = m & (
+        (f.rtoev_hi == NEVER32)
+        | lp.pair_lt(dlh, dll, f.rtoev_hi, f.rtoev_lo)
+    )
     f = f._replace(
-        rto_deadline=jnp.where(m, deadline, f.rto_deadline),
-        rto_evt=jnp.where(arm, deadline, f.rto_evt),
+        rtodl_hi=jnp.where(m, dlh, f.rtodl_hi),
+        rtodl_lo=jnp.where(m, dll, f.rtodl_lo),
+        rtoev_hi=jnp.where(arm, dlh, f.rtoev_hi),
+        rtoev_lo=jnp.where(arm, dll, f.rtoev_lo),
     )
     return (
         f,
         em_rto_valid | arm,
-        jnp.where(arm, deadline, em_rto_time),
+        jnp.where(arm, dlh, em_rto_thi),
+        jnp.where(arm, dll, em_rto_tlo),
     )
 
 
@@ -301,23 +310,24 @@ def _emit_unit(f: FlowCols, unit, m, retransmit, em):
 
 
 def _empty_emit(n: int) -> StreamEmit:
-    i64 = jnp.int64
     i32 = jnp.int32
     zb = jnp.zeros(n, dtype=bool)
+    z32 = jnp.zeros(n, dtype=i32)
     return StreamEmit(
         send_valid=zb,
-        send_flags=jnp.zeros(n, dtype=i32),
-        send_seq=jnp.zeros(n, dtype=i64),
-        send_ack=jnp.zeros(n, dtype=i64),
-        send_size=jnp.zeros(n, dtype=i32),
+        send_flags=z32,
+        send_seq=z32,
+        send_ack=z32,
+        send_size=z32,
         pump_valid=zb,
         rto_valid=zb,
-        rto_time=jnp.zeros(n, dtype=i64),
+        rto_thi=z32,
+        rto_tlo=z32,
         completed_now=zb,
     )
 
 
-def _pull_back(f: FlowCols, now, m, em):
+def _pull_back(f: FlowCols, nh, nl, m, em):
     """Go-back-N loss response where ``m``."""
     f = f._replace(
         snd_nxt=jnp.where(m, f.snd_una + 1, f.snd_nxt),
@@ -328,65 +338,86 @@ def _pull_back(f: FlowCols, now, m, em):
         ),
     )
     f, em = _emit_unit(f, f.snd_una, m, jnp.asarray(True), em)
-    f, rv, rt = _restart_rto(f, now, m, em.rto_valid, em.rto_time)
-    em = em._replace(rto_valid=rv, rto_time=rt)
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, m, em.rto_valid, em.rto_thi,
+                                   em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
     em = em._replace(pump_valid=em.pump_valid | (m & _can_send_new(f)))
     return f, em
 
 
 # --------------------------------------------------------------------------
-# stimulus handlers (vector twins of ltcp.open_flow / on_pump / on_rto_event
+# stimulus handlers (pair twins of ltcp.open_flow / on_pump / on_rto_event
 # / on_segment); each applies under an activity mask ``m``
 # --------------------------------------------------------------------------
 
 
-def open_flow_vec(f: FlowCols, now, m) -> tuple[FlowCols, StreamEmit]:
+def open_flow_vec(f: FlowCols, nh, nl, m) -> tuple[FlowCols, StreamEmit]:
     em = _empty_emit(f.state.shape[0])
     f = f._replace(
         state=jnp.where(m, ltcp.SYN_SENT, f.state),
         snd_nxt=jnp.where(m, 1, f.snd_nxt),
     )
     f, em = _emit_unit(f, jnp.zeros_like(f.snd_nxt), m, jnp.asarray(False), em)
-    f = f._replace(rtt_ts=jnp.where(m, now, f.rtt_ts))
-    f, rv, rt = _restart_rto(f, now, m, em.rto_valid, em.rto_time)
-    em = em._replace(rto_valid=rv, rto_time=rt)
+    f = f._replace(
+        rtt_ts_hi=jnp.where(m, nh, f.rtt_ts_hi),
+        rtt_ts_lo=jnp.where(m, nl, f.rtt_ts_lo),
+    )
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, m, em.rto_valid, em.rto_thi,
+                                   em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
     return f, em
 
 
-def on_pump_vec(f: FlowCols, now, m) -> tuple[FlowCols, StreamEmit]:
+def on_pump_vec(f: FlowCols, nh, nl, m) -> tuple[FlowCols, StreamEmit]:
     em = _empty_emit(f.state.shape[0])
     m = m & _can_send_new(f)
     unit = f.snd_nxt
     f = f._replace(snd_nxt=jnp.where(m, f.snd_nxt + 1, f.snd_nxt))
     retransmit = unit < f.max_sent
+    fresh_ts = m & ~retransmit & (f.rtt_seq < 0)
     f = f._replace(
-        rtt_ts=jnp.where(m & ~retransmit & (f.rtt_seq < 0), now, f.rtt_ts)
+        rtt_ts_hi=jnp.where(fresh_ts, nh, f.rtt_ts_hi),
+        rtt_ts_lo=jnp.where(fresh_ts, nl, f.rtt_ts_lo),
     )
     f, em = _emit_unit(f, unit, m, retransmit, em)
     f = f._replace(
         state=jnp.where(m & (unit == f.segs + 1), ltcp.FIN_WAIT, f.state)
     )
-    f, rv, rt = _restart_rto(f, now, m, em.rto_valid, em.rto_time)
-    em = em._replace(rto_valid=rv, rto_time=rt)
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, m, em.rto_valid, em.rto_thi,
+                                   em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
     em = em._replace(pump_valid=em.pump_valid | (m & _can_send_new(f)))
     return f, em
 
 
-def on_rto_vec(f: FlowCols, now, m) -> tuple[FlowCols, StreamEmit]:
+def on_rto_vec(f: FlowCols, nh, nl, m) -> tuple[FlowCols, StreamEmit]:
     em = _empty_emit(f.state.shape[0])
-    m = m & (now == f.rto_evt)  # ownership law
-    f = f._replace(rto_evt=jnp.where(m, NEVER, f.rto_evt))
-    lapse = (f.rto_deadline == NEVER) | (_flight(f) <= 0)
+    # ownership law: only the event at time rto_evt speaks for the timer
+    m = m & (nh == f.rtoev_hi) & (nl == f.rtoev_lo)
+    f = f._replace(
+        rtoev_hi=jnp.where(m, NEVER32, f.rtoev_hi),
+        rtoev_lo=jnp.where(m, NEVER32, f.rtoev_lo),
+    )
+    lapse = (f.rtodl_hi == NEVER32) | (_flight(f) <= 0)
     m = m & ~lapse
     # deadline moved later: re-arm there
-    rearm = m & (now < f.rto_deadline)
-    f = f._replace(rto_evt=jnp.where(rearm, f.rto_deadline, f.rto_evt))
+    rearm = m & lp.pair_lt(nh, nl, f.rtodl_hi, f.rtodl_lo)
+    f = f._replace(
+        rtoev_hi=jnp.where(rearm, f.rtodl_hi, f.rtoev_hi),
+        rtoev_lo=jnp.where(rearm, f.rtodl_lo, f.rtoev_lo),
+    )
     em = em._replace(
         rto_valid=em.rto_valid | rearm,
-        rto_time=jnp.where(rearm, f.rto_deadline, em.rto_time),
+        rto_thi=jnp.where(rearm, f.rtodl_hi, em.rto_thi),
+        rto_tlo=jnp.where(rearm, f.rtodl_lo, em.rto_tlo),
     )
     fire = m & ~rearm
-    fl_fp = _flight(f) * ltcp.FP
+    # flight <= MAX window segs (law invariant): the product fits int32
+    fl_fp = jnp.minimum(_flight(f), 1 << 15) * ltcp.FP
+    r2h, r2l = lp.pair_mul_small(f.rto_hi, f.rto_lo, 2)
+    over = lp.pair_lt(_RTO_MAX_P[0], _RTO_MAX_P[1], r2h, r2l)
+    r2h = jnp.where(over, _RTO_MAX_P[0], r2h)
+    r2l = jnp.where(over, _RTO_MAX_P[1], r2l)
     f = f._replace(
         ssthresh_fp=jnp.where(
             fire, jnp.maximum(fl_fp // 2, ltcp.MIN_SSTHRESH_FP), f.ssthresh_fp
@@ -394,21 +425,22 @@ def on_rto_vec(f: FlowCols, now, m) -> tuple[FlowCols, StreamEmit]:
         cwnd_fp=jnp.where(fire, ltcp.FP, f.cwnd_fp),
         dup_acks=jnp.where(fire, 0, f.dup_acks),
         in_rec=jnp.where(fire, False, f.in_rec),
-        rto=jnp.where(fire, jnp.minimum(f.rto * 2, ltcp.RTO_MAX), f.rto),
+        rto_hi=jnp.where(fire, r2h, f.rto_hi),
+        rto_lo=jnp.where(fire, r2l, f.rto_lo),
     )
-    f, em = _pull_back(f, now, fire, em)
+    f, em = _pull_back(f, nh, nl, fire, em)
     return f, em
 
 
 def on_segment_vec(
-    f: FlowCols, now, m, flags, seq, ack, size
+    f: FlowCols, nh, nl, m, flags, seq, ack, size
 ) -> tuple[FlowCols, StreamEmit]:
     """Vector twin of ltcp.on_segment.  The scalar function is a sequence
     of early returns; here each return path is a disjoint mask and state
     updates compose under them in the same order."""
     n = f.state.shape[0]
     em = _empty_emit(n)
-    i64 = jnp.int64
+    i32 = jnp.int32
 
     is_syn = (flags & ltcp.F_SYN) != 0
     is_ack = (flags & ltcp.F_ACK) != 0
@@ -423,7 +455,7 @@ def on_segment_vec(
         send_flags=jnp.where(reack, ltcp.F_ACK, em.send_flags),
         send_seq=jnp.where(reack, f.snd_nxt, em.send_seq),
         send_ack=jnp.where(reack, f.rcv_nxt, em.send_ack),
-        send_size=jnp.where(reack, ltcp.HDR_BYTES, em.send_size).astype(jnp.int32),
+        send_size=jnp.where(reack, ltcp.HDR_BYTES, em.send_size).astype(i32),
     )
     m = m & ~done0
 
@@ -435,22 +467,34 @@ def on_segment_vec(
         rcv_nxt=jnp.where(po_ok, 1, f.rcv_nxt),
         snd_nxt=jnp.where(po_ok, 1, f.snd_nxt),
     )
-    f, em = _emit_unit(f, jnp.zeros(n, dtype=i64), po_ok, jnp.asarray(False), em)
-    f = f._replace(rtt_ts=jnp.where(po_ok, now, f.rtt_ts))
-    f, rv, rt = _restart_rto(f, now, po_ok, em.rto_valid, em.rto_time)
-    em = em._replace(rto_valid=rv, rto_time=rt)
+    f, em = _emit_unit(f, jnp.zeros(n, dtype=i32), po_ok, jnp.asarray(False),
+                       em)
+    f = f._replace(
+        rtt_ts_hi=jnp.where(po_ok, nh, f.rtt_ts_hi),
+        rtt_ts_lo=jnp.where(po_ok, nl, f.rtt_ts_lo),
+    )
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, po_ok, em.rto_valid, em.rto_thi,
+                                   em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
     m = m & ~po  # both the handled SYN and the ignored non-SYN return
 
     # retransmitted SYN into SYN_RCVD: resend the SYN-ACK
-    rsyn = m & (f.role == ltcp.RECEIVER) & (f.state == ltcp.SYN_RCVD) & is_syn & ~is_ack
-    f, em = _emit_unit(f, jnp.zeros(n, dtype=i64), rsyn, jnp.asarray(True), em)
-    f, rv, rt = _restart_rto(f, now, rsyn, em.rto_valid, em.rto_time)
-    em = em._replace(rto_valid=rv, rto_time=rt)
+    rsyn = (
+        m & (f.role == ltcp.RECEIVER) & (f.state == ltcp.SYN_RCVD)
+        & is_syn & ~is_ack
+    )
+    f, em = _emit_unit(f, jnp.zeros(n, dtype=i32), rsyn, jnp.asarray(True), em)
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, rsyn, em.rto_valid, em.rto_thi,
+                                   em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
     m = m & ~rsyn
 
     # ---- ACK processing ---------------------------------------------------
     new_ack = m & is_ack & (ack > f.snd_una)
-    acked = ack - f.snd_una
+    # acked <= the max historical flight (law invariant ~ RWND); the clamp
+    # keeps acked*FP inside int32 with identical results (cwnd saturates
+    # at MAX_CWND_FP far below the clamp)
+    acked = jnp.minimum(ack - f.snd_una, 1 << 15)
     pre_snd_una = f.snd_una  # the dup test is an elif on the PRE-ack value
     pre_in_rec = f.in_rec  # branch on the PRE-ack recovery flag
     was_syn_sent = new_ack & (f.state == ltcp.SYN_SENT)
@@ -482,7 +526,10 @@ def on_segment_vec(
                 f.cwnd_fp + acked * ltcp.FP,
                 jnp.where(
                     ca,
-                    f.cwnd_fp + jnp.maximum(1, (ltcp.FP * ltcp.FP) // jnp.maximum(f.cwnd_fp, 1)),
+                    f.cwnd_fp
+                    + jnp.maximum(
+                        1, (ltcp.FP * ltcp.FP) // jnp.maximum(f.cwnd_fp, 1)
+                    ),
                     f.cwnd_fp,
                 ),
             ),
@@ -490,13 +537,16 @@ def on_segment_vec(
         ),
     )
     rtt_m = new_ack & (f.rtt_seq >= 0) & (ack > f.rtt_seq)
-    f = _rtt_sample(f, now, rtt_m)
+    f = _rtt_sample(f, nh, nl, rtt_m)
     f = f._replace(rtt_seq=jnp.where(rtt_m, -1, f.rtt_seq))
     has_flight = _flight(f) > 0
-    f, rv, rt = _restart_rto(f, now, new_ack & has_flight, em.rto_valid, em.rto_time)
-    em = em._replace(rto_valid=rv, rto_time=rt)
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, new_ack & has_flight,
+                                   em.rto_valid, em.rto_thi, em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
+    no_flight = new_ack & ~has_flight
     f = f._replace(
-        rto_deadline=jnp.where(new_ack & ~has_flight, NEVER, f.rto_deadline)
+        rtodl_hi=jnp.where(no_flight, NEVER32, f.rtodl_hi),
+        rtodl_lo=jnp.where(no_flight, NEVER32, f.rtodl_lo),
     )
 
     # pure duplicate ACK
@@ -513,17 +563,20 @@ def on_segment_vec(
     count = dup & ~f.in_rec
     f = f._replace(dup_acks=jnp.where(count, f.dup_acks + 1, f.dup_acks))
     fr = count & (f.dup_acks == ltcp.DUP_THRESH)
+    fl_fp = jnp.minimum(_flight(f), 1 << 15) * ltcp.FP
     f = f._replace(
         in_rec=jnp.where(fr, True, f.in_rec),
         recover=jnp.where(fr, f.snd_nxt, f.recover),
         ssthresh_fp=jnp.where(
-            fr, jnp.maximum(_flight(f) * ltcp.FP // 2, ltcp.MIN_SSTHRESH_FP), f.ssthresh_fp
+            fr, jnp.maximum(fl_fp // 2, ltcp.MIN_SSTHRESH_FP), f.ssthresh_fp
         ),
     )
     f = f._replace(
-        cwnd_fp=jnp.where(fr, f.ssthresh_fp + ltcp.DUP_THRESH * ltcp.FP, f.cwnd_fp)
+        cwnd_fp=jnp.where(
+            fr, f.ssthresh_fp + ltcp.DUP_THRESH * ltcp.FP, f.cwnd_fp
+        )
     )
-    f, em = _pull_back(f, now, fr, em)
+    f, em = _pull_back(f, nh, nl, fr, em)
 
     # ---- sender-side teardown / window-opened pump ------------------------
     snd = m & (f.role == ltcp.SENDER)
@@ -534,25 +587,30 @@ def on_segment_vec(
         send_flags=jnp.where(fin_done, ltcp.F_ACK, em.send_flags),
         send_seq=jnp.where(fin_done, f.snd_nxt, em.send_seq),
         send_ack=jnp.where(fin_done, f.rcv_nxt, em.send_ack),
-        send_size=jnp.where(fin_done, ltcp.HDR_BYTES, em.send_size).astype(jnp.int32),
+        send_size=jnp.where(fin_done, ltcp.HDR_BYTES, em.send_size).astype(i32),
         completed_now=em.completed_now | fin_done,
     )
     f = f._replace(
         state=jnp.where(fin_done, ltcp.DONE, f.state),
-        rto_deadline=jnp.where(fin_done, NEVER, f.rto_deadline),
+        rtodl_hi=jnp.where(fin_done, NEVER32, f.rtodl_hi),
+        rtodl_lo=jnp.where(fin_done, NEVER32, f.rtodl_lo),
     )
     # ACK opened the window and nothing else was sent: pump one unit now
-    opened = snd & ~fin_done & (f.state == ltcp.ESTAB) & ~em.send_valid & _can_send_new(f)
-    f2, em2 = on_pump_vec(f, now, opened)
+    opened = (
+        snd & ~fin_done & (f.state == ltcp.ESTAB) & ~em.send_valid
+        & _can_send_new(f)
+    )
+    f2, em2 = on_pump_vec(f, nh, nl, opened)
     f = _merge_cols(f, f2, opened)
     # the scalar law keeps the ACK path's RTO arm unless the pump re-arms
     # (ltcp.py: `if pump.arm_rto is not None: em.arm_rto = ...`) — a plain
     # masked merge would drop an armed owner event that was never queued,
     # killing the flow's retransmission timer
     keep_rv = jnp.where(opened, em.rto_valid | em2.rto_valid, em.rto_valid)
-    keep_rt = jnp.where(opened & em2.rto_valid, em2.rto_time, em.rto_time)
+    keep_rth = jnp.where(opened & em2.rto_valid, em2.rto_thi, em.rto_thi)
+    keep_rtl = jnp.where(opened & em2.rto_valid, em2.rto_tlo, em.rto_tlo)
     em = _merge_emit(em, em2, opened)
-    em = em._replace(rto_valid=keep_rv, rto_time=keep_rt)
+    em = em._replace(rto_valid=keep_rv, rto_thi=keep_rth, rto_tlo=keep_rtl)
     # sender path returns here in the scalar law
     m = m & ~snd
 
@@ -578,27 +636,30 @@ def on_segment_vec(
         send_flags=jnp.where(data_seg, ltcp.F_ACK, em.send_flags),
         send_seq=jnp.where(data_seg, f.snd_nxt, em.send_seq),
         send_ack=jnp.where(data_seg, f.rcv_nxt, em.send_ack),
-        send_size=jnp.where(data_seg, ltcp.HDR_BYTES, em.send_size).astype(jnp.int32),
+        send_size=jnp.where(data_seg, ltcp.HDR_BYTES, em.send_size).astype(i32),
     )
     fin_seg = est & ~is_data & is_fin
     fin_in_order = fin_seg & (seq == f.rcv_nxt)
     unit = f.snd_nxt
+    fresh_ts = fin_in_order & (f.rtt_seq < 0)
     f = f._replace(
         rcv_nxt=jnp.where(fin_in_order, f.rcv_nxt + 1, f.rcv_nxt),
         snd_nxt=jnp.where(fin_in_order, f.snd_nxt + 1, f.snd_nxt),
-        rtt_ts=jnp.where(fin_in_order & (f.rtt_seq < 0), now, f.rtt_ts),
+        rtt_ts_hi=jnp.where(fresh_ts, nh, f.rtt_ts_hi),
+        rtt_ts_lo=jnp.where(fresh_ts, nl, f.rtt_ts_lo),
     )
     f, em = _emit_unit(f, unit, fin_in_order, jnp.asarray(False), em)
     f = f._replace(state=jnp.where(fin_in_order, ltcp.LAST_ACK, f.state))
-    f, rv, rt = _restart_rto(f, now, fin_in_order, em.rto_valid, em.rto_time)
-    em = em._replace(rto_valid=rv, rto_time=rt)
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, fin_in_order, em.rto_valid,
+                                   em.rto_thi, em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
     fin_ooo = fin_seg & ~fin_in_order
     em = em._replace(
         send_valid=em.send_valid | fin_ooo,
         send_flags=jnp.where(fin_ooo, ltcp.F_ACK, em.send_flags),
         send_seq=jnp.where(fin_ooo, f.snd_nxt, em.send_seq),
         send_ack=jnp.where(fin_ooo, f.rcv_nxt, em.send_ack),
-        send_size=jnp.where(fin_ooo, ltcp.HDR_BYTES, em.send_size).astype(jnp.int32),
+        send_size=jnp.where(fin_ooo, ltcp.HDR_BYTES, em.send_size).astype(i32),
     )
 
     # LAST_ACK (elif in the scalar law: a flow the est branch just moved
@@ -607,13 +668,15 @@ def on_segment_vec(
     la_done = la & (f.snd_una >= 2)
     f = f._replace(
         state=jnp.where(la_done, ltcp.DONE, f.state),
-        rto_deadline=jnp.where(la_done, NEVER, f.rto_deadline),
+        rtodl_hi=jnp.where(la_done, NEVER32, f.rtodl_hi),
+        rtodl_lo=jnp.where(la_done, NEVER32, f.rtodl_lo),
     )
     em = em._replace(completed_now=em.completed_now | la_done)
     la_stale = la & ~la_done & (is_data | is_fin) & (seq < f.rcv_nxt)
     f, em = _emit_unit(f, f.snd_una, la_stale, jnp.asarray(True), em)
-    f, rv, rt = _restart_rto(f, now, la_stale, em.rto_valid, em.rto_time)
-    em = em._replace(rto_valid=rv, rto_time=rt)
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, la_stale, em.rto_valid,
+                                   em.rto_thi, em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
 
     return f, em
 
@@ -631,45 +694,25 @@ def _merge_emit(a: StreamEmit, b: StreamEmit, m) -> StreamEmit:
     ])
 
 
-_FIELD_MAP = [
-    # (FlowCols field, cl field, sv field)
-    ("state", "cl_state", "sv_state"),
-    ("snd_una", "cl_snd_una", "sv_snd_una"),
-    ("snd_nxt", "cl_snd_nxt", "sv_snd_nxt"),
-    ("rcv_nxt", "cl_rcv_nxt", "sv_rcv_nxt"),
-    ("cwnd_fp", "cl_cwnd_fp", "sv_cwnd_fp"),
-    ("ssthresh_fp", "cl_ssthresh_fp", "sv_ssthresh_fp"),
-    ("dup_acks", "cl_dup_acks", "sv_dup_acks"),
-    ("in_rec", "cl_in_rec", "sv_in_rec"),
-    ("recover", "cl_recover", "sv_recover"),
-    ("max_sent", "cl_max_sent", "sv_max_sent"),
-    ("srtt", "cl_srtt", "sv_srtt"),
-    ("rttvar", "cl_rttvar", "sv_rttvar"),
-    ("rto", "cl_rto", "sv_rto"),
-    ("rtt_seq", "cl_rtt_seq", "sv_rtt_seq"),
-    ("rtt_ts", "cl_rtt_ts", "sv_rtt_ts"),
-    ("rto_deadline", "cl_rto_deadline", "sv_rto_deadline"),
-    ("rto_evt", "cl_rto_evt", "sv_rto_evt"),
-    ("tx_segs", "cl_tx_segs", "sv_tx_segs"),
-    ("retransmits", "cl_retransmits", "sv_retransmits"),
-    ("rx_segs", None, "sv_rx_segs"),
-    ("rx_bytes", None, "sv_rx_bytes"),
-    ("completed", "cl_completed", "sv_completed"),
-]
+def gather_cols(
+    st: StreamState, flow, server_mask, st_segs, st_mss, st_last,
+    one_to_one: bool,
+):
+    """Unified [N] FlowCols for this slot.
 
-
-def gather_cols(st: StreamState, flow, server_mask, st_segs, st_mss, st_last):
-    """Unified [N] FlowCols for this slot: client lanes read their own
-    columns; server lanes read the flow's server columns at index ``flow``."""
+    Client lanes read their own ``cl`` row.  Server lanes read the flow's
+    server row: at the OWN lane in one-to-one mode (no gather — a masked
+    select), at index ``flow`` otherwise (one [N, F] row-gather, which
+    vectorizes where per-element gathers serialize)."""
     n = flow.shape[0]
-    idx = jnp.clip(flow, 0, n - 1)
-    vals = {}
-    for fc, cl, sv in _FIELD_MAP:
-        sv_col = getattr(st, sv)[idx]
-        if cl is None:  # rx accounting exists on the server side only
-            vals[fc] = sv_col
-        else:
-            vals[fc] = jnp.where(server_mask, sv_col, getattr(st, cl))
+    if one_to_one:
+        sv_rows = st.sv
+    else:
+        sv_rows = st.sv[jnp.clip(flow, 0, n - 1)]
+    src = jnp.where(server_mask[:, None], sv_rows, st.cl)
+    vals = {name: src[:, col] for name, col in _MATRIX_FIELDS}
+    for name, col in _BOOL_FIELDS:
+        vals[name] = src[:, col] != 0
     vals["role"] = jnp.where(server_mask, ltcp.RECEIVER, ltcp.SENDER).astype(
         jnp.int32
     )
@@ -681,19 +724,30 @@ def gather_cols(st: StreamState, flow, server_mask, st_segs, st_mss, st_last):
     return FlowCols(**vals)
 
 
+def _to_rows(f: FlowCols) -> jnp.ndarray:
+    """FlowCols -> [N, F] matrix rows (column order of the layout)."""
+    cols = [None] * N_COLS
+    for name, col in _MATRIX_FIELDS:
+        cols[col] = getattr(f, name)
+    for name, col in _BOOL_FIELDS:
+        cols[col] = getattr(f, name).astype(jnp.int32)
+    return jnp.stack(cols, axis=1)
+
+
 def scatter_cols(
-    st: StreamState, f: FlowCols, flow, client_mask, server_mask
+    st: StreamState, f: FlowCols, flow, client_mask, server_mask,
+    one_to_one: bool,
 ) -> StreamState:
-    """Write the slot's updated FlowCols back: client columns in place
-    under ``client_mask``; server columns scattered at ``flow`` under
-    ``server_mask`` (unique indices: one event per lane per slot, one
-    client lane per flow)."""
+    """Write the slot's updated FlowCols back: client rows in place under
+    ``client_mask``; server rows in place (one-to-one) or row-scattered at
+    ``flow`` (unique indices: one event per lane per slot, one client lane
+    per flow)."""
     n = flow.shape[0]
-    sv_idx = jnp.where(server_mask, flow, n)  # n = dropped
-    out = {}
-    for fc, cl, sv in _FIELD_MAP:
-        new = getattr(f, fc)
-        if cl is not None:
-            out[cl] = jnp.where(client_mask, new, getattr(st, cl))
-        out[sv] = getattr(st, sv).at[sv_idx].set(new, mode="drop")
-    return st._replace(**out)
+    rows = _to_rows(f)
+    cl = jnp.where(client_mask[:, None], rows, st.cl)
+    if one_to_one:
+        sv = jnp.where(server_mask[:, None], rows, st.sv)
+    else:
+        sv_idx = jnp.where(server_mask, flow, n)  # n = dropped
+        sv = st.sv.at[sv_idx].set(rows, mode="drop")
+    return StreamState(cl=cl, sv=sv)

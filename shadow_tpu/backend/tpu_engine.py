@@ -26,6 +26,7 @@ from ..models.tgen import Ping, TgenClient, TgenMesh, TgenServer
 from ..net import codel as codel_mod
 from ..net.token_bucket import bucket_params
 from . import lanes
+from . import lanes_stream as lstr_mod
 from .cpu_engine import LogRecord, SimResult
 
 NEVER = stime.NEVER
@@ -76,9 +77,9 @@ class TpuEngine:
         p_peer = np.zeros(n, dtype=np.int32)
         p_count = np.zeros(n, dtype=np.int64)
         p_stride = np.ones(n, dtype=np.int64)
-        st_segs = np.zeros(n, dtype=np.int64)
-        st_mss = np.zeros(n, dtype=np.int64)
-        st_last = np.zeros(n, dtype=np.int64)
+        st_segs = np.zeros(n, dtype=np.int32)
+        st_mss = np.zeros(n, dtype=np.int32)
+        st_last = np.zeros(n, dtype=np.int32)
         init_events: list[tuple[int, int, int, int, int, int]] = []  # lane,t,kind,src,seq,size
         local_seq0 = np.ones(n, dtype=np.int64)
 
@@ -124,6 +125,19 @@ class TpuEngine:
             elif isinstance(app, StreamClient):
                 model[hid] = lanes.M_STREAM_CLIENT
                 p_peer[hid] = self._resolve(app.server, n)
+                # int32/packed-payload magnitude guards: seq units ride a
+                # 26-bit payload field and rx_bytes an int32 counter
+                if app.fs.segs + 2 >= (1 << lstr_mod.PAY_SEQ_BITS):
+                    raise LaneCompatError(
+                        f"stream flow of {app.fs.segs} segments exceeds the "
+                        f"lane backend's {lstr_mod.PAY_SEQ_BITS}-bit sequence "
+                        "space; use the cpu backend"
+                    )
+                if app.size >= (1 << 31):
+                    raise LaneCompatError(
+                        "stream transfer size exceeds the lane backend's "
+                        "int32 byte counter; use the cpu backend"
+                    )
                 st_segs[hid], st_last[hid] = app.fs.segs, app.fs.last_bytes
                 st_mss[hid] = app.mss
                 init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
@@ -161,6 +175,24 @@ class TpuEngine:
         node_idx, lat, thresh = self.routing.device_tables()
         if log_capacity is None:
             log_capacity = 200_000
+
+        # one-to-one stream pairing: when every stream server is the peer
+        # of exactly one client, server flow rows live at the server's own
+        # lane and the per-slot row gather/scatter disappears (the common
+        # shape — the mixed-mesh bench and paired configs)
+        cl_of = np.arange(n, dtype=np.int32)
+        client_ids = np.nonzero(model == lanes.M_STREAM_CLIENT)[0]
+        server_ids = set(np.nonzero(model == lanes.M_STREAM_SERVER)[0].tolist())
+        peer_counts: dict[int, int] = {}
+        for cid in client_ids:
+            peer_counts[int(p_peer[cid])] = peer_counts.get(int(p_peer[cid]), 0) + 1
+        one_to_one = bool(client_ids.size) and all(
+            peer_counts.get(sid, 0) == 1 for sid in server_ids
+        ) and all(pid in server_ids for pid in peer_counts)
+        if one_to_one:
+            for cid in client_ids:
+                cl_of[int(p_peer[cid])] = int(cid)
+
         self.params = lanes.LaneParams(
             n_lanes=n,
             capacity=capacity,
@@ -175,6 +207,7 @@ class TpuEngine:
             unroll=cfg.experimental.tpu_round_unroll,
             dynamic_runahead=bool(cfg.experimental.use_dynamic_runahead),
             runahead_floor=max(cfg.experimental.runahead or 0, 1),
+            stream_one_to_one=one_to_one,
         )
 
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)
@@ -194,6 +227,11 @@ class TpuEngine:
                     f"({limit}); use the cpu backend"
                 )
 
+        if interval >= lanes.MOD_SMALL_LIMIT:
+            raise LaneCompatError(
+                f"bucket interval {interval} ns exceeds the chunked-mod "
+                f"ceiling ({lanes.MOD_SMALL_LIMIT}); use the cpu backend"
+            )
         # strictly below NEVER32: a latency equal to the sentinel would
         # read as "no sends yet" in the dynamic-runahead scalar
         _check("link latency (ns)", np.asarray(lat), i32max - 1)
@@ -252,6 +290,7 @@ class TpuEngine:
             st_segs=jnp.asarray(st_segs),
             st_mss=jnp.asarray(st_mss),
             st_last=jnp.asarray(st_last),
+            st_cl_of=jnp.asarray(cl_of),
         )
         self._init_events = init_events
         self._local_seq0 = local_seq0
@@ -310,20 +349,11 @@ class TpuEngine:
             np.int32
         )
 
-        from . import lanes_stream as lstr
-
-        # no stream tier -> no stream columns AND no payload column: the
+        # no stream tier -> no stream matrices AND no payload columns: the
         # while-loop carry pays a per-buffer cost every iteration on the
-        # tunneled runtime, so ~40 dead zero arrays are real wall time
+        # tunneled runtime, so dead zero arrays are real wall time
         stream0 = (
-            lstr.init_stream_state(
-                n,
-                np.asarray(self.tables.st_segs),
-                np.asarray(self.tables.st_mss),
-                np.asarray(self.tables.st_last),
-            )
-            if p.stream_present
-            else ()
+            lstr_mod.init_stream_state(n) if p.stream_present else ()
         )
 
         up_burst = np.asarray(self.tables.up_burst)
@@ -339,7 +369,8 @@ class TpuEngine:
             q_auxh=jnp.asarray(q_auxh),
             q_auxl=jnp.asarray(q_auxl),
             q_size=jnp.asarray(q_size),
-            q_pay=jnp.zeros((n, c), dtype=jnp.int64) if p.stream_present else (),
+            q_phi=jnp.zeros((n, c), dtype=jnp.int32) if p.stream_present else (),
+            q_plo=jnp.zeros((n, c), dtype=jnp.int32) if p.stream_present else (),
             stream=stream0,
             send_seq=jnp.asarray(z32),
             local_seq=jnp.asarray(self._local_seq0, dtype=i32),
@@ -492,22 +523,32 @@ class TpuEngine:
         add("lane_sends", int(np.asarray(s.n_sends).sum()))
 
         if self.params.stream_present:
-            st = s.stream
+            cl_m = np.asarray(s.stream.cl)
+            sv_m = np.asarray(s.stream.sv)
             cl_mask = model == lanes.M_STREAM_CLIENT
-            done = np.asarray(st.cl_completed) & cl_mask
+            # server flow rows live at the server lane in one-to-one mode,
+            # at the client lane otherwise
+            sv_mask = (
+                model == lanes.M_STREAM_SERVER
+                if self.params.stream_one_to_one
+                else cl_mask
+            )
+            done = (cl_m[:, lstr_mod.C_COMPLETED] != 0) & cl_mask
             if done.any():
                 # tx/retransmit totals count at completion, like the CPU
                 # _track — including zero-valued keys (counter-set parity)
                 counters["stream_complete"] = int(done.sum())
-                counters["stream_tx_segs"] = int(np.asarray(st.cl_tx_segs)[done].sum())
-                counters["stream_retransmits"] = int(
-                    np.asarray(st.cl_retransmits)[done].sum()
+                counters["stream_tx_segs"] = int(
+                    cl_m[done, lstr_mod.C_TX_SEGS].sum()
                 )
-            add("stream_rx_bytes", int(np.asarray(st.sv_rx_bytes)[cl_mask].sum()))
-            add("stream_rx_segs", int(np.asarray(st.sv_rx_segs)[cl_mask].sum()))
+                counters["stream_retransmits"] = int(
+                    cl_m[done, lstr_mod.C_RETRANS].sum()
+                )
+            add("stream_rx_bytes", int(sv_m[sv_mask, lstr_mod.C_RX_BYTES].sum()))
+            add("stream_rx_segs", int(sv_m[sv_mask, lstr_mod.C_RX_SEGS].sum()))
             add(
                 "stream_flows_done",
-                int((np.asarray(st.sv_completed) & cl_mask).sum()),
+                int(((sv_m[:, lstr_mod.C_COMPLETED] != 0) & sv_mask).sum()),
             )
 
         return SimResult(

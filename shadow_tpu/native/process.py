@@ -767,7 +767,9 @@ class ManagedApp:
         pshm = self._cur.os_proc.chan.shm
         chan.shm.handled_signals = int(pshm.handled_signals)
         chan.shm.ignored_signals = int(pshm.ignored_signals)
-        chan.shm.blocked_signals = int(pshm.blocked_signals)
+        # the child inherits the FORKING thread's sigmask (per-thread state)
+        if self._cur.chan is not None:
+            chan.shm.blocked_signals = int(self._cur.chan.shm.blocked_signals)
         self._pending_chans.append(chan)
         self._reply(api, "prefork", 0, payload=str(path).encode())
 
@@ -914,6 +916,9 @@ class ManagedApp:
             rcvbuf=self._exp.socket_recv_buffer if self._exp else None,
         )
         chan.set_clock(stime.sim_to_emu(api.now))
+        # a new thread inherits its creator's sigmask (per-thread state)
+        if self._cur.chan is not None:
+            chan.shm.blocked_signals = int(self._cur.chan.shm.blocked_signals)
         self._pending_thread_chans[vtid] = chan
         self._reply(api, "prethread", 0, args=[0, vtid],
                     payload=str(path).encode())
@@ -1278,11 +1283,6 @@ class ManagedApp:
         SIG_IGNed signal (the shim-maintained ignored_signals bitmap)
         neither interrupts nor kills — the park stays."""
         shm = target.chan.shm if target.chan else None
-        if shm is not None and (int(shm.blocked_signals) >> (sig - 1)) & 1:
-            # the app's own sigprocmask blocks it: POSIX keeps the signal
-            # pending without interrupting anything — it takes effect when
-            # the app unblocks (park releases would be spurious EINTRs)
-            return
         handled = int(shm.handled_signals) if shm is not None else 0
         has_handler = (handled >> (sig - 1)) & 1
         fatal = False
@@ -1296,6 +1296,15 @@ class ManagedApp:
                 continue
             b = entity.blocked
             if b is None:
+                continue
+            if entity.chan is not None and (
+                int(entity.chan.shm.blocked_signals) >> (sig - 1)
+            ) & 1:
+                # THIS thread's own sigprocmask blocks it: POSIX keeps the
+                # signal pending without interrupting its calls — it takes
+                # effect when the thread unblocks.  Sigmasks are per
+                # thread, so other entities of the process are still
+                # released (the dedicated-signal-thread pattern)
                 continue
             if b[0] not in self._INTERRUPTIBLE:
                 # handled signals EINTR only the POSIX-interruptible set;

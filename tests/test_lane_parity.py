@@ -339,27 +339,36 @@ def test_vector_law_keeps_ack_rto_arm_through_opened_pump():
     from shadow_tpu.backend import lanes_stream as lstr
     from shadow_tpu.net import ltcp
 
-    segs = jnp.array([50])
-    mss = jnp.array([1448])
-    last = jnp.array([1448])
-    st = lstr.init_stream_state(1, segs, mss, last)
-    st = st._replace(
-        cl_state=jnp.array([ltcp.ESTAB], dtype=jnp.int32),
-        cl_snd_una=jnp.array([5]),
-        cl_snd_nxt=jnp.array([10]),
-        cl_rcv_nxt=jnp.array([1]),
-        cl_max_sent=jnp.array([10]),
-        cl_cwnd_fp=jnp.array([20 * ltcp.FP]),
-        cl_srtt=jnp.array([-1]),  # first RTT sample -> RTO collapses to 200ms
-        cl_rttvar=jnp.array([0]),
-        cl_rto=jnp.array([900_000_000]),
-        cl_rtt_seq=jnp.array([5]),
-        cl_rtt_ts=jnp.array([970_000_000]),
-        cl_rto_deadline=jnp.array([1_900_000_000]),
-        cl_rto_evt=jnp.array([1_900_000_000]),
-    )
-    f = lstr.gather_cols(st, jnp.array([0]), jnp.array([False]), segs, mss, last)
-    now = jnp.int64(1_000_000_000)
+    def p(v):  # ns value -> (hi, lo) int32 split
+        return v >> 31, v & ((1 << 31) - 1)
+
+    segs = jnp.array([50], dtype=jnp.int32)
+    mss = jnp.array([1448], dtype=jnp.int32)
+    last = jnp.array([1448], dtype=jnp.int32)
+    st = lstr.init_stream_state(1)
+    cl = st.cl
+    for col, val in (
+        (lstr.C_STATE, ltcp.ESTAB), (lstr.C_SND_UNA, 5), (lstr.C_SND_NXT, 10),
+        (lstr.C_RCV_NXT, 1), (lstr.C_MAX_SENT, 10),
+        (lstr.C_CWND, 20 * ltcp.FP),
+        (lstr.C_SRTT_HI, -1),  # first RTT sample -> RTO collapses to 200ms
+        (lstr.C_SRTT_LO, 0), (lstr.C_RTTVAR_HI, 0), (lstr.C_RTTVAR_LO, 0),
+        (lstr.C_RTO_HI, p(900_000_000)[0]), (lstr.C_RTO_LO, p(900_000_000)[1]),
+        (lstr.C_RTT_SEQ, 5),
+        (lstr.C_RTT_TS_HI, p(970_000_000)[0]),
+        (lstr.C_RTT_TS_LO, p(970_000_000)[1]),
+        (lstr.C_RTODL_HI, p(1_900_000_000)[0]),
+        (lstr.C_RTODL_LO, p(1_900_000_000)[1]),
+        (lstr.C_RTOEV_HI, p(1_900_000_000)[0]),
+        (lstr.C_RTOEV_LO, p(1_900_000_000)[1]),
+    ):
+        cl = cl.at[0, col].set(val)
+    st = st._replace(cl=cl)
+    f = lstr.gather_cols(st, jnp.array([0]), jnp.array([False]), segs, mss,
+                         last, one_to_one=True)
+    now = 1_000_000_000
+    nh = jnp.array([p(now)[0]], dtype=jnp.int32)
+    nl = jnp.array([p(now)[1]], dtype=jnp.int32)
     # mirror the scalar law on the identical state
     fs = ltcp.FlowState(role=ltcp.SENDER, segs=50, mss=1448, last_bytes=1448,
                         state=ltcp.ESTAB, snd_una=5, snd_nxt=10, rcv_nxt=1,
@@ -367,15 +376,18 @@ def test_vector_law_keeps_ack_rto_arm_through_opened_pump():
                         rttvar=0, rto=900_000_000, rtt_seq=5,
                         rtt_ts=970_000_000, rto_deadline=1_900_000_000,
                         rto_evt=1_900_000_000)
-    em_ref = ltcp.on_segment(fs, int(now), ltcp.F_ACK, 0, 6)
+    em_ref = ltcp.on_segment(fs, now, ltcp.F_ACK, 0, 6)
     f2, em = lstr.on_segment_vec(
-        f, now, jnp.array([True]), jnp.array([ltcp.F_ACK]),
-        jnp.array([0]), jnp.array([6]), jnp.array([ltcp.HDR_BYTES], dtype=jnp.int64),
+        f, nh, nl, jnp.array([True]), jnp.array([ltcp.F_ACK]),
+        jnp.array([0], dtype=jnp.int32), jnp.array([6], dtype=jnp.int32),
+        jnp.array([ltcp.HDR_BYTES], dtype=jnp.int32),
     )
     assert em_ref.arm_rto is not None  # the scenario arms a shrunk owner
     assert bool(em.rto_valid[0])
-    assert int(em.rto_time[0]) == em_ref.arm_rto
-    assert int(f2.rto_evt[0]) == fs.rto_evt
+    rto_t = (int(em.rto_thi[0]) << 31) | int(em.rto_tlo[0])
+    assert rto_t == em_ref.arm_rto
+    evt = (int(f2.rtoev_hi[0]) << 31) | int(f2.rtoev_lo[0])
+    assert evt == fs.rto_evt
     assert bool(em.send_valid[0]) == (em_ref.send is not None)
 
 
@@ -434,3 +446,44 @@ hosts:
     cpu, tpu = both_logs(yaml, mode="device")
     assert cpu.log_tuples() == tpu.log_tuples()
     assert len(cpu.event_log) > 40
+
+
+def test_pair_arithmetic_exact():
+    """Property check of the int32 pair helpers against Python bignums —
+    including the mul carry case where (s<<16) + ll*c wraps past 2**31
+    (srtt ≈ 306.8 ms once corrupted RTO timing silently)."""
+    import random
+
+    import numpy as np
+
+    from shadow_tpu.backend import lanes_pairs as lp
+
+    rng = random.Random(7)
+    cases = [(0, 306839551, 7), (0, 1431699455, 3)]
+    for _ in range(20_000):
+        c = rng.randint(1, 7)
+        v = rng.randint(0, ((1 << 31) // c - 1) << 31 | lp.MASK31)
+        cases.append((v >> 31, v & lp.MASK31, c))
+    his = np.array([a for a, _b, _c in cases], dtype=np.int32)
+    los = np.array([b for _a, b, _c in cases], dtype=np.int32)
+    for cval in range(1, 8):
+        mask = np.array([c == cval for _a, _b, c in cases])
+        if not mask.any():
+            continue
+        h, l = lp.pair_mul_small(his[mask], los[mask], cval)
+        h = np.asarray(h, dtype=np.int64)
+        l = np.asarray(l, dtype=np.int64)
+        exp = (
+            his[mask].astype(np.int64) * (1 << 31) + los[mask].astype(np.int64)
+        ) * cval
+        got = h * (1 << 31) + l
+        assert (got == exp).all() and (l >= 0).all() and (l < 1 << 31).all()
+    # div / mod / sub round-trips on the same corpus
+    vs = his.astype(np.int64) * (1 << 31) + los.astype(np.int64)
+    for k in (1, 2, 3, 8, 30):
+        dh, dl = lp.pair_div_pow2(his, los, k)
+        got = np.asarray(dh, np.int64) * (1 << 31) + np.asarray(dl, np.int64)
+        assert (got == vs >> k).all()
+    for m in (3, 1_000_000, (1 << 22) - 1):
+        got = np.asarray(lp.pair_mod_small(his, los, m), np.int64)
+        assert (got == vs % m).all()
