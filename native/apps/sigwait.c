@@ -1,0 +1,51 @@
+/* sigwait: the atomic unmask-and-wait idiom (ppoll/pselect sigmask).
+ * The parent blocks SIGUSR1, arms a child to signal it at +1 simulated
+ * second, then ppoll()s with a mask that ADMITS SIGUSR1: the wait must
+ * be interrupted at exactly +1000 ms with the handler having run —
+ * not time out at +5000 ms (the lost-wakeup race those calls prevent). */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000L;
+}
+
+static volatile sig_atomic_t got;
+static void on_usr1(int sig) { (void)sig; got = 1; }
+
+int main(void) {
+    setvbuf(stdout, NULL, _IOLBF, 0);
+    long long t0 = now_ms();
+    signal(SIGUSR1, on_usr1);
+    sigset_t blk, waitmask;
+    sigemptyset(&blk);
+    sigaddset(&blk, SIGUSR1);
+    sigprocmask(SIG_BLOCK, &blk, &waitmask);
+    sigdelset(&waitmask, SIGUSR1);
+    pid_t parent = getpid();
+    pid_t pid = fork();
+    if (pid == 0) {
+        struct timespec s = {1, 0};
+        nanosleep(&s, NULL);
+        kill(parent, SIGUSR1);
+        exit(0);
+    }
+    struct timespec to = {5, 0};
+    int r = ppoll(NULL, 0, &to, &waitmask);
+    printf("ppoll r=%d errno=%s got=%d at +%lld ms\n", r,
+           r < 0 && errno == EINTR ? "EINTR" : "other", (int)got,
+           now_ms() - t0);
+    int st;
+    waitpid(pid, &st, 0);
+    /* still blocked outside the wait: a second signal stays pending */
+    return 0;
+}

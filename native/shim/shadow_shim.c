@@ -95,7 +95,7 @@ static int g_ready = 0;
 static int g_exit_code = 0;
 
 /* per-fd shim state: kind + O_NONBLOCK, indexed by the real fd number */
-enum { VK_NONE = 0, VK_SOCKET = 1 };
+enum { VK_NONE = 0, VK_SOCKET = 1, VK_NETLINK = 2 };
 static uint8_t vfd_kind[SHIM_MAX_FDS];
 static uint8_t vfd_nonblock[SHIM_MAX_FDS];
 static uint8_t vfd_stream[SHIM_MAX_FDS]; /* SOCK_STREAM (vs SOCK_DGRAM) */
@@ -1090,6 +1090,203 @@ static void vfd_release(int fd) {
     real_close(fd); /* free the /dev/null reservation */
 }
 
+/* ---------------------------------------------- AF_NETLINK emulation */
+/* NETLINK_ROUTE answered ENTIRELY in the shim from the simulated
+ * interface config (lo + eth0 with the host's simulated IP) — a real
+ * netlink socket would leak the host machine's interfaces into the
+ * simulation.  Covers the dump surface real software uses to enumerate
+ * interfaces (glibc getifaddrs internals, iproute2, the Go net package:
+ * RTM_GETLINK / RTM_GETADDR with NLM_F_DUMP); modification requests are
+ * refused with EPERM (the simulated net is static).  The reference
+ * implements the same subset manager-side (socket/netlink.rs); here the
+ * answers are deterministic canned state, so no manager round-trip is
+ * needed. */
+#include <linux/netlink.h>
+#include <linux/rtnetlink.h>
+#include <net/if.h>
+#include <net/if_arp.h>
+
+static int hosts_lookup(const char *name, uint32_t *ip_out);
+
+typedef struct {
+    uint32_t pid;     /* bound netlink pid */
+    uint16_t pending; /* RTM_GETLINK / RTM_GETADDR / 0 */
+    uint32_t seq;
+    uint8_t phase;    /* 0 = payload batch next, 1 = NLMSG_DONE next */
+    uint8_t ack;      /* 1 = NLMSG_ERROR queued */
+    int ack_err;
+    uint32_t ack_seq;
+} shim_nl_state;
+static shim_nl_state nl_state[SHIM_MAX_FDS];
+
+static int is_nlfd(int fd) {
+    return g_ready && fd >= 0 && fd < SHIM_MAX_FDS &&
+           vfd_kind[fd] == VK_NETLINK;
+}
+
+static long raw_gettid(void) { return shim_raw_syscall6(SYS_gettid, 0, 0, 0, 0, 0, 0); }
+
+static size_t nl_attr_put(char *p, size_t off, unsigned short type,
+                          const void *data, size_t len) {
+    struct rtattr *rta = (struct rtattr *)(p + off);
+    rta->rta_type = type;
+    rta->rta_len = (unsigned short)RTA_LENGTH(len);
+    memcpy(RTA_DATA(rta), data, len);
+    return off + RTA_ALIGN(rta->rta_len);
+}
+
+static size_t nl_link_msg(char *p, size_t off, const shim_nl_state *st,
+                          int idx, const char *name, unsigned flags,
+                          unsigned short arphrd, unsigned mtu,
+                          const unsigned char mac[6]) {
+    size_t start = off;
+    struct nlmsghdr *nh = (struct nlmsghdr *)(p + off);
+    off += NLMSG_HDRLEN;
+    struct ifinfomsg ifi;
+    memset(&ifi, 0, sizeof(ifi));
+    ifi.ifi_family = AF_UNSPEC;
+    ifi.ifi_type = arphrd;
+    ifi.ifi_index = idx;
+    ifi.ifi_flags = flags;
+    ifi.ifi_change = 0xFFFFFFFFu;
+    memcpy(p + off, &ifi, sizeof(ifi));
+    off += NLMSG_ALIGN(sizeof(ifi));
+    off = nl_attr_put(p, off, IFLA_IFNAME, name, strlen(name) + 1);
+    off = nl_attr_put(p, off, IFLA_MTU, &mtu, 4);
+    off = nl_attr_put(p, off, IFLA_ADDRESS, mac, 6);
+    unsigned char up = 6; /* IF_OPER_UP */
+    off = nl_attr_put(p, off, IFLA_OPERSTATE, &up, 1);
+    unsigned txq = 1000; /* present so iproute2 skips its ioctl fallback */
+    off = nl_attr_put(p, off, IFLA_TXQLEN, &txq, 4);
+    nh->nlmsg_len = (uint32_t)(off - start);
+    nh->nlmsg_type = RTM_NEWLINK;
+    nh->nlmsg_flags = NLM_F_MULTI;
+    nh->nlmsg_seq = st->seq;
+    nh->nlmsg_pid = st->pid;
+    return off;
+}
+
+static size_t nl_addr_msg(char *p, size_t off, const shim_nl_state *st,
+                          int idx, const char *label, uint32_t ip_be,
+                          unsigned char prefix, unsigned char scope) {
+    size_t start = off;
+    struct nlmsghdr *nh = (struct nlmsghdr *)(p + off);
+    off += NLMSG_HDRLEN;
+    struct ifaddrmsg ifa;
+    memset(&ifa, 0, sizeof(ifa));
+    ifa.ifa_family = AF_INET;
+    ifa.ifa_prefixlen = prefix;
+    ifa.ifa_flags = IFA_F_PERMANENT;
+    ifa.ifa_scope = scope;
+    ifa.ifa_index = (unsigned)idx;
+    memcpy(p + off, &ifa, sizeof(ifa));
+    off += NLMSG_ALIGN(sizeof(ifa));
+    off = nl_attr_put(p, off, IFA_ADDRESS, &ip_be, 4);
+    off = nl_attr_put(p, off, IFA_LOCAL, &ip_be, 4);
+    off = nl_attr_put(p, off, IFA_LABEL, label, strlen(label) + 1);
+    nh->nlmsg_len = (uint32_t)(off - start);
+    nh->nlmsg_type = RTM_NEWADDR;
+    nh->nlmsg_flags = NLM_F_MULTI;
+    nh->nlmsg_seq = st->seq;
+    nh->nlmsg_pid = st->pid;
+    return off;
+}
+
+static ssize_t nl_send(int fd, const void *buf, size_t n) {
+    shim_nl_state *st = &nl_state[fd];
+    size_t remaining = n;
+    const struct nlmsghdr *nh = (const struct nlmsghdr *)buf;
+    while (remaining >= sizeof(struct nlmsghdr) &&
+           nh->nlmsg_len >= sizeof(struct nlmsghdr) &&
+           nh->nlmsg_len <= remaining) {
+        if (nh->nlmsg_type == RTM_GETLINK || nh->nlmsg_type == RTM_GETADDR) {
+            st->pending = nh->nlmsg_type;
+            st->seq = nh->nlmsg_seq;
+            st->phase = 0;
+        } else if (nh->nlmsg_type >= RTM_BASE) {
+            /* modification request: the simulated net is static */
+            st->ack = 1;
+            st->ack_err = -EPERM;
+            st->ack_seq = nh->nlmsg_seq;
+        }
+        size_t adv = NLMSG_ALIGN(nh->nlmsg_len);
+        if (adv >= remaining) break;
+        remaining -= adv;
+        nh = (const struct nlmsghdr *)((const char *)nh + adv);
+    }
+    return (ssize_t)n;
+}
+
+static ssize_t nl_recv(int fd, void *buf, size_t n, int flags,
+                       struct sockaddr *addr, socklen_t *alen) {
+    shim_nl_state *st = &nl_state[fd];
+    char pkt[1024];
+    size_t len = 0;
+    if (st->ack) {
+        struct nlmsghdr *nh = (struct nlmsghdr *)pkt;
+        struct nlmsgerr err;
+        memset(&err, 0, sizeof(err));
+        err.error = st->ack_err;
+        err.msg.nlmsg_seq = st->ack_seq;
+        nh->nlmsg_len = NLMSG_LENGTH(sizeof(err));
+        nh->nlmsg_type = NLMSG_ERROR;
+        nh->nlmsg_flags = 0;
+        nh->nlmsg_seq = st->ack_seq;
+        nh->nlmsg_pid = st->pid;
+        memcpy(NLMSG_DATA(nh), &err, sizeof(err));
+        len = nh->nlmsg_len;
+        if (!(flags & MSG_PEEK)) st->ack = 0;
+    } else if (st->pending && st->phase == 0) {
+        uint32_t ip = 0;
+        const char *hn = getenv("SHADOW_TPU_HOSTNAME");
+        int have_ip = hn && hosts_lookup(hn, &ip) == 0;
+        if (st->pending == RTM_GETLINK) {
+            static const unsigned char mac0[6] = {0};
+            unsigned char mac[6] = {0x02, 0x54, 0, 0, 0, 0};
+            memcpy(mac + 2, &ip, 4); /* deterministic MAC from the sim IP */
+            len = nl_link_msg(pkt, len, st, 1, "lo",
+                              IFF_UP | IFF_LOOPBACK | IFF_RUNNING,
+                              ARPHRD_LOOPBACK, 65536, mac0);
+            if (have_ip)
+                len = nl_link_msg(pkt, len, st, 2, "eth0",
+                                  IFF_UP | IFF_BROADCAST | IFF_RUNNING |
+                                      IFF_MULTICAST,
+                                  ARPHRD_ETHER, 1500, mac);
+        } else {
+            len = nl_addr_msg(pkt, len, st, 1, "lo",
+                              htonl(INADDR_LOOPBACK), 8, RT_SCOPE_HOST);
+            if (have_ip)
+                len = nl_addr_msg(pkt, len, st, 2, "eth0", ip, 8,
+                                  RT_SCOPE_UNIVERSE);
+        }
+        if (!(flags & MSG_PEEK)) st->phase = 1;
+    } else if (st->pending && st->phase == 1) {
+        struct nlmsghdr *nh = (struct nlmsghdr *)pkt;
+        nh->nlmsg_len = NLMSG_LENGTH(4);
+        nh->nlmsg_type = NLMSG_DONE;
+        nh->nlmsg_flags = NLM_F_MULTI;
+        nh->nlmsg_seq = st->seq;
+        nh->nlmsg_pid = st->pid;
+        memset(NLMSG_DATA(nh), 0, 4);
+        len = nh->nlmsg_len;
+        if (!(flags & MSG_PEEK)) st->pending = 0;
+    } else {
+        errno = EAGAIN; /* nothing queued: only reachable without a dump
+                           request in flight */
+        return -1;
+    }
+    if (addr && alen && *alen >= sizeof(struct sockaddr_nl)) {
+        struct sockaddr_nl *snl = (struct sockaddr_nl *)addr;
+        memset(snl, 0, sizeof(*snl));
+        snl->nl_family = AF_NETLINK;
+        *alen = sizeof(*snl);
+    }
+    size_t copy = len < n ? len : n;
+    memcpy(buf, pkt, copy);
+    if (len > n && (flags & MSG_TRUNC)) return (ssize_t)len;
+    return (ssize_t)copy;
+}
+
 /* --------------------------------------------------------------- time */
 
 static uint64_t sim_now_ns(void) {
@@ -1273,6 +1470,14 @@ static void maybe_yield(int fd, short events, int dontwait) {
 int socket(int domain, int type, int protocol) {
     if (!real_socket) resolve_reals();
     int base_type = type & ~(SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (g_ready && domain == AF_NETLINK && protocol == NETLINK_ROUTE) {
+        int fd = reserve_fd();
+        if (fd < 0) return -1;
+        vfd_kind[fd] = VK_NETLINK;
+        vfd_nonblock[fd] = (type & SOCK_NONBLOCK) != 0;
+        memset(&nl_state[fd], 0, sizeof(nl_state[fd]));
+        return fd;
+    }
     if (g_ready && domain == AF_INET6) {
         /* the simulated internet is IPv4; a real IPv6 socket would escape
          * the simulation entirely */
@@ -1297,6 +1502,14 @@ int socket(int domain, int type, int protocol) {
 }
 
 int bind(int fd, const struct sockaddr *addr, socklen_t len) {
+    if (is_nlfd(fd)) {
+        if (addr && len >= sizeof(struct sockaddr_nl)) {
+            const struct sockaddr_nl *snl = (const struct sockaddr_nl *)addr;
+            nl_state[fd].pid = snl->nl_pid ? snl->nl_pid
+                                           : (uint32_t)raw_gettid();
+        }
+        return 0;
+    }
     if (!is_vfd(fd)) return real_bind(fd, addr, len);
     uint32_t ip;
     uint16_t port;
@@ -1452,6 +1665,7 @@ static void iov_scatter(const struct iovec *iov, int cnt, const char *src,
 
 ssize_t sendto(int fd, const void *buf, size_t n, int flags,
                const struct sockaddr *addr, socklen_t len) {
+    if (is_nlfd(fd)) return nl_send(fd, buf, n);
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLOUT, flags & MSG_DONTWAIT);
         return real_sendto(fd, buf, n, flags, addr, len);
@@ -1463,6 +1677,7 @@ ssize_t sendto(int fd, const void *buf, size_t n, int flags,
 }
 
 ssize_t send(int fd, const void *buf, size_t n, int flags) {
+    if (is_nlfd(fd)) return nl_send(fd, buf, n);
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLOUT, flags & MSG_DONTWAIT);
         return (ssize_t)raw_sendto(fd, buf, n, flags, NULL, 0);
@@ -1471,6 +1686,7 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
 }
 
 ssize_t write(int fd, const void *buf, size_t n) {
+    if (is_nlfd(fd)) return nl_send(fd, buf, n);
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLOUT, 0);
         return real_write(fd, buf, n);
@@ -1480,6 +1696,7 @@ ssize_t write(int fd, const void *buf, size_t n) {
 
 ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
                  struct sockaddr *addr, socklen_t *alen) {
+    if (is_nlfd(fd)) return nl_recv(fd, buf, n, flags, addr, alen);
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLIN, flags & MSG_DONTWAIT);
         return real_recvfrom(fd, buf, n, flags, addr, alen);
@@ -1488,6 +1705,7 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
 }
 
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
+    if (is_nlfd(fd)) return nl_recv(fd, buf, n, flags, NULL, NULL);
     if (!is_vfd(fd)) {
 #define real_recv(fd, buf, n, fl) \
     ((ssize_t)raw_recvfrom(fd, buf, n, fl, NULL, NULL))
@@ -1521,6 +1739,7 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
 }
 
 ssize_t read(int fd, void *buf, size_t n) {
+    if (is_nlfd(fd)) return nl_recv(fd, buf, n, 0, NULL, NULL);
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLIN, 0);
         return real_read(fd, buf, n);
@@ -1537,6 +1756,11 @@ int shutdown(int fd, int how) {
 
 int close(int fd) {
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
+    if (is_nlfd(fd)) {
+        memset(&nl_state[fd], 0, sizeof(nl_state[fd]));
+        vfd_release(fd);
+        return 0;
+    }
     if (!is_vfd(fd)) {
         if (g_ready) epoll_forget_fd(fd); /* fd may be an epfd */
         return real_close(fd);
@@ -1562,6 +1786,17 @@ static int name_common(int fd, struct sockaddr *addr, socklen_t *alen,
 }
 
 int getsockname(int fd, struct sockaddr *addr, socklen_t *alen) {
+    if (is_nlfd(fd)) {
+        if (addr && alen && *alen >= sizeof(struct sockaddr_nl)) {
+            struct sockaddr_nl *snl = (struct sockaddr_nl *)addr;
+            memset(snl, 0, sizeof(*snl));
+            snl->nl_family = AF_NETLINK;
+            snl->nl_pid = nl_state[fd].pid ? nl_state[fd].pid
+                                           : (uint32_t)raw_gettid();
+            *alen = sizeof(*snl);
+        }
+        return 0;
+    }
     if (!is_vfd(fd)) return real_getsockname(fd, addr, alen);
     return name_common(fd, addr, alen, SHIM_OP_GETSOCKNAME);
 }
@@ -1573,6 +1808,7 @@ int getpeername(int fd, struct sockaddr *addr, socklen_t *alen) {
 
 int setsockopt(int fd, int level, int optname, const void *optval,
                socklen_t optlen) {
+    if (is_nlfd(fd)) return 0; /* SNDBUF/RCVBUF etc.: accept and ignore */
     if (!is_vfd(fd)) return real_setsockopt(fd, level, optname, optval, optlen);
     (void)level;
     (void)optname;
@@ -1649,7 +1885,7 @@ int fcntl(int fd, int cmd, ...) {
     va_start(ap, cmd);
     void *arg = va_arg(ap, void *);
     va_end(ap);
-    if (!is_vfd(fd)) return real_fcntl(fd, cmd, arg);
+    if (!is_vfd(fd) && !is_nlfd(fd)) return real_fcntl(fd, cmd, arg);
     switch (cmd) {
         case F_GETFL:
             return O_RDWR | (vfd_nonblock[fd] ? O_NONBLOCK : 0);
@@ -1694,6 +1930,49 @@ int ioctl(int fd, unsigned long req, ...) {
 
 /* ----------------------------------------------------------- readiness */
 
+/* Wait-scoped sigmask (ppoll/pselect6/epoll_pwait): the atomic
+ * unmask-and-wait these calls exist for.  Entering swaps BOTH the real
+ * kernel mask (so a pending signal unblocked by the wait mask fires at
+ * shim_call's mask restore, running its handler BEFORE the wait returns
+ * EINTR) and the manager-visible blocked_signals mirror (so the manager
+ * releases the park for a signal the wait mask admits).  SIGSYS is
+ * stripped (a blocked SIGSYS turns the next dispatch into a forced
+ * kill). */
+typedef struct {
+    uint64_t saved_real;
+    uint64_t saved_pub;
+    int active;
+} wait_mask_t;
+
+static void wait_mask_enter(const void *umask, size_t ssz, wait_mask_t *w) {
+    w->active = 0;
+    if (!umask || ssz < 8) return;
+    uint64_t m;
+    memcpy(&m, umask, 8);
+    m &= ~(1ull << (SIGSYS - 1));
+    shim_raw_syscall6(SYS_rt_sigprocmask, SIG_SETMASK, (long)&m,
+                      (long)&w->saved_real, 8, 0, 0);
+    shim_shmem *shm = cur_shm();
+    if (shm) {
+        w->saved_pub = __atomic_load_n(&shm->blocked_signals,
+                                       __ATOMIC_RELAXED);
+        __atomic_store_n(&shm->blocked_signals, m, __ATOMIC_RELAXED);
+    }
+    w->active = 1;
+}
+
+static void wait_mask_leave(wait_mask_t *w) {
+    if (!w->active) return;
+    int saved_errno = errno; /* the wait's errno (EINTR) must survive */
+    shim_raw_syscall6(SYS_rt_sigprocmask, SIG_SETMASK, (long)&w->saved_real,
+                      0, 8, 0, 0);
+    shim_shmem *shm = cur_shm();
+    if (shm)
+        __atomic_store_n(&shm->blocked_signals, w->saved_pub,
+                         __ATOMIC_RELAXED);
+    errno = saved_errno;
+}
+
 /* One manager round-trip evaluating readiness of simulated fds; parks the
  * plugin until an fd is ready or the (simulated) timeout elapses. */
 static int shim_poll_call(shim_pollfd *entries, int n, int64_t timeout_ns,
@@ -1708,12 +1987,42 @@ static int shim_poll_call(shim_pollfd *entries, int n, int64_t timeout_ns,
 
 static int poll_ns(struct pollfd *fds, nfds_t nfds, int64_t timeout_ns) {
     if (!real_socket) resolve_reals();
+    /* netlink fds are synchronous (request/answer in the shim): report
+     * readiness immediately — readable iff a reply is queued */
+    int nl_ready = 0, any_nl = 0;
+    for (nfds_t i = 0; i < nfds; i++) {
+        if (!is_nlfd(fds[i].fd)) continue;
+        any_nl = 1;
+        short rev = 0;
+        shim_nl_state *st = &nl_state[fds[i].fd];
+        if ((fds[i].events & POLLIN) && (st->pending || st->ack))
+            rev |= POLLIN;
+        if (fds[i].events & POLLOUT) rev |= POLLOUT;
+        fds[i].revents = rev;
+        if (rev) nl_ready++;
+    }
+    if (nl_ready) {
+        for (nfds_t i = 0; i < nfds; i++)
+            if (!is_nlfd(fds[i].fd)) fds[i].revents = 0;
+        return nl_ready;
+    }
     int any_virtual = 0, any_real = 0;
     for (nfds_t i = 0; i < nfds; i++) {
         if (is_vfd(fds[i].fd))
             any_virtual = 1;
-        else
+        else if (!is_nlfd(fds[i].fd))
             any_real = 1;
+    }
+    if (any_nl && !any_virtual && !any_real) {
+        /* idle emulated netlink fd(s) only: nothing can arrive without a
+         * request in flight (multicast group notifications are not
+         * emulated) — park in SIMULATED time instead of real_poll()ing
+         * the O_PATH reservation, which reports always-ready and would
+         * hot-spin the wall clock */
+        for (nfds_t i = 0; i < nfds; i++) fds[i].revents = 0;
+        uint32_t rv;
+        int ready = shim_poll_call(NULL, 0, timeout_ns, &rv);
+        return ready < 0 ? -1 : 0;
     }
     if (!any_virtual) {
         if (timeout_ns < 0) /* intentional forever-block on real fds */
@@ -1773,7 +2082,6 @@ int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
 
 int ppoll(struct pollfd *fds, nfds_t nfds, const struct timespec *ts,
           const sigset_t *mask) {
-    (void)mask;
     if (!g_ready) {
         static int (*rp)(struct pollfd *, nfds_t, const struct timespec *,
                          const sigset_t *);
@@ -1784,7 +2092,11 @@ int ppoll(struct pollfd *fds, nfds_t nfds, const struct timespec *ts,
      * degrade into a same-instant spin */
     int64_t timeout_ns =
         ts ? (int64_t)ts->tv_sec * 1000000000ll + ts->tv_nsec : -1;
-    return poll_ns(fds, nfds, timeout_ns);
+    wait_mask_t w;
+    wait_mask_enter(mask, mask ? 8 : 0, &w);
+    int r = poll_ns(fds, nfds, timeout_ns);
+    wait_mask_leave(&w);
+    return r;
 }
 
 int select(int nfds, fd_set *rd, fd_set *wr, fd_set *ex, struct timeval *tv) {
@@ -1987,14 +2299,38 @@ int epoll_wait(int epfd, struct epoll_event *events, int maxevents,
 
 int epoll_pwait(int epfd, struct epoll_event *events, int maxevents,
                 int timeout, const sigset_t *mask) {
-    (void)mask;
     if (!g_ready) {
         static int (*rp)(int, struct epoll_event *, int, int,
                          const sigset_t *);
         if (!rp) rp = dlsym(RTLD_NEXT, "epoll_pwait");
         return rp(epfd, events, maxevents, timeout, mask);
     }
-    return epoll_wait(epfd, events, maxevents, timeout);
+    wait_mask_t w;
+    wait_mask_enter(mask, mask ? 8 : 0, &w);
+    int r = epoll_wait(epfd, events, maxevents, timeout);
+    wait_mask_leave(&w);
+    return r;
+}
+
+int pselect(int nfds, fd_set *rd, fd_set *wr, fd_set *ex,
+            const struct timespec *ts, const sigset_t *mask) {
+    if (!g_ready) {
+        static int (*rp)(int, fd_set *, fd_set *, fd_set *,
+                         const struct timespec *, const sigset_t *);
+        if (!rp) rp = dlsym(RTLD_NEXT, "pselect");
+        return rp(nfds, rd, wr, ex, ts, mask);
+    }
+    struct timeval tv, *tvp = NULL;
+    if (ts) {
+        tv.tv_sec = ts->tv_sec;
+        tv.tv_usec = (ts->tv_nsec + 999) / 1000;
+        tvp = &tv;
+    }
+    wait_mask_t w;
+    wait_mask_enter(mask, mask ? 8 : 0, &w);
+    int r = select(nfds, rd, wr, ex, tvp);
+    wait_mask_leave(&w);
+    return r;
 }
 
 /* ----------------------------------------------- timerfd / eventfd.
@@ -2848,8 +3184,19 @@ int setitimer(__itimer_which_t which, const struct itimerval *new_value,
     static int (*real_seti)(__itimer_which_t, const struct itimerval *,
                             struct itimerval *);
     if (!real_seti) *(void **)&real_seti = dlsym(RTLD_NEXT, "setitimer");
-    if (!g_ready || which != ITIMER_REAL)
-        return real_seti(which, new_value, old_value);
+    if (!g_ready) return real_seti(which, new_value, old_value);
+    if (which != ITIMER_REAL) {
+        /* the shim itself owns ITIMER_VIRTUAL for CPU-time preemption —
+         * an app timer would clobber the quantum AND deliver a real
+         * SIGVTALRM/SIGPROF outside simulated causality.  Refuse loudly
+         * (ENOTSUP) rather than silently breaking determinism. */
+        static int warned;
+        if (!warned++)
+            shim_warn("setitimer(ITIMER_VIRTUAL/PROF) is not simulated; "
+                      "refusing with ENOTSUP");
+        errno = ENOTSUP;
+        return -1;
+    }
     if (!new_value) {
         errno = EFAULT;
         return -1;
@@ -3075,6 +3422,28 @@ int uname(struct utsname *buf) {
  * (ancillary/control data is not carried — SCM_RIGHTS over a simulated
  * INET socket has no meaning); real fds keep the yield discipline. */
 ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
+    if (is_nlfd(fd)) {
+        if (!msg || msg->msg_iovlen < 1) {
+            errno = EFAULT;
+            return -1;
+        }
+        socklen_t slen = msg->msg_namelen;
+        size_t cap = msg->msg_iov[0].iov_len;
+        /* ask for the FULL length (netlink always reports truncation in
+         * msg_flags, whether or not the caller passed MSG_TRUNC) */
+        ssize_t r = nl_recv(fd, msg->msg_iov[0].iov_base, cap,
+                            flags | MSG_TRUNC,
+                            (struct sockaddr *)msg->msg_name,
+                            msg->msg_name ? &slen : NULL);
+        if (r >= 0) {
+            if (msg->msg_name) msg->msg_namelen = slen;
+            msg->msg_controllen = 0;
+            msg->msg_flags = (size_t)r > cap ? MSG_TRUNC : 0;
+            if (!(flags & MSG_TRUNC) && (size_t)r > cap)
+                r = (ssize_t)cap;
+        }
+        return r;
+    }
     if (is_vfd(fd)) {
         if (!msg) {
             errno = EFAULT;
@@ -3113,6 +3482,14 @@ ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
 }
 
 ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
+    if (is_nlfd(fd)) {
+        if (!msg || msg->msg_iovlen < 1) {
+            errno = EFAULT;
+            return -1;
+        }
+        return nl_send(fd, msg->msg_iov[0].iov_base,
+                       msg->msg_iov[0].iov_len);
+    }
     if (is_vfd(fd)) {
         if (!msg) {
             errno = EFAULT;
@@ -3401,6 +3778,14 @@ static long shim_futex_emu(long uaddr, long op, long val, long timeout,
         return wr_ < 0 && errno ? -(long)errno : wr_;                        \
     } while (0)
 
+/* WRAPRET without the return: for cases that must clean up first */
+#define WRAPSET(out, expr)                                                   \
+    do {                                                                     \
+        errno = 0;                                                           \
+        long wr_ = (long)(expr);                                             \
+        (out) = wr_ < 0 && errno ? -(long)errno : wr_;                       \
+    } while (0)
+
 /* The syscall-user-dispatch backstop routes EVERY syscall issued outside
  * the shim's text here.  Simulation-owned calls reuse the exact logic of
  * the LD_PRELOAD wrappers above (which themselves fall back to raw kernel
@@ -3526,9 +3911,17 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
         /* ---- readiness ---- */
         case SYS_poll:
             WRAPRET(poll((struct pollfd *)a1, (nfds_t)a2, (int)a3));
-        case SYS_ppoll:
-            WRAPRET(ppoll((struct pollfd *)a1, (nfds_t)a2,
-                          (const struct timespec *)a3, NULL));
+        case SYS_ppoll: {
+            /* the raw sigmask arg is honored: wait_mask semantics inside
+             * the libc-level wrapper (a4 = kernel sigset, a5 = size) */
+            wait_mask_t w;
+            wait_mask_enter((const void *)a4, (size_t)a5, &w);
+            long r;
+            WRAPSET(r, ppoll((struct pollfd *)a1, (nfds_t)a2,
+                             (const struct timespec *)a3, NULL));
+            wait_mask_leave(&w);
+            return r;
+        }
         case SYS_select:
             WRAPRET(select((int)a1, (fd_set *)a2, (fd_set *)a3, (fd_set *)a4,
                            (struct timeval *)a5));
@@ -3540,8 +3933,21 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
                 tv.tv_usec = (ts->tv_nsec + 999) / 1000;
                 tvp = &tv;
             }
-            WRAPRET(select((int)a1, (fd_set *)a2, (fd_set *)a3, (fd_set *)a4,
-                           tvp));
+            /* a6 -> struct { const sigset_t *ss; size_t ss_len } */
+            wait_mask_t w;
+            w.active = 0;
+            if (a6) {
+                const struct {
+                    const void *ss;
+                    size_t ss_len;
+                } *sx = (const void *)a6;
+                wait_mask_enter(sx->ss, sx->ss_len, &w);
+            }
+            long r;
+            WRAPSET(r, select((int)a1, (fd_set *)a2, (fd_set *)a3,
+                              (fd_set *)a4, tvp));
+            wait_mask_leave(&w);
+            return r;
         }
         case SYS_epoll_ctl:
             WRAPRET(epoll_ctl((int)a1, (int)a2, (int)a3,
@@ -3549,9 +3955,15 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
         case SYS_epoll_wait:
             WRAPRET(epoll_wait((int)a1, (struct epoll_event *)a2, (int)a3,
                                (int)a4));
-        case SYS_epoll_pwait:
-            WRAPRET(epoll_pwait((int)a1, (struct epoll_event *)a2, (int)a3,
-                                (int)a4, NULL));
+        case SYS_epoll_pwait: {
+            wait_mask_t w;
+            wait_mask_enter((const void *)a5, (size_t)a6, &w);
+            long r;
+            WRAPSET(r, epoll_pwait((int)a1, (struct epoll_event *)a2,
+                                   (int)a3, (int)a4, NULL));
+            wait_mask_leave(&w);
+            return r;
+        }
 
         /* ---- virtual timerfd/eventfd ---- */
         case SYS_timerfd_create:

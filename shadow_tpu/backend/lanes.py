@@ -216,6 +216,7 @@ class LaneState(NamedTuple):
     stream: Any
     # round bookkeeping (scalars)
     rounds: jnp.ndarray  # int32
+    iters: jnp.ndarray  # int32: while-loop iterations (perf visibility)
     now_we_hi: jnp.ndarray  # int32 pair: current round's window end
     now_we_lo: jnp.ndarray
     min_used_lat: jnp.ndarray  # int32 scalar: smallest latency sent over
@@ -250,6 +251,11 @@ class LaneParams:
     # at the server's own lane and the per-slot row gather/scatter
     # disappears (TpuEngine detects this from the config)
     stream_one_to_one: bool = False
+    # static stream-client lane ids (burst-channel compaction) and the
+    # wide co-pop gate: every possible lookahead window must end before
+    # RTO_MIN so stream DELIVERY pops cannot insert same-window events
+    stream_clients: tuple = ()
+    stream_wide_pop: bool = False
     # window-advance+pop steps per fused while-loop trip (amortizes the
     # ~350 us per-iteration host round-trip of the tunneled runtime).
     # Multiplies XLA compile time with the body size — worth it for small
@@ -274,7 +280,11 @@ class LaneTables(NamedTuple):
 
     node_of: jnp.ndarray  # [N] int32: lane -> graph node index
     lat: jnp.ndarray  # [G, G] int32 latency ns (< 2**31 enforced)
-    thresh: jnp.ndarray  # [G, G] int64 loss thresholds (u64 domain)
+    # loss thresholds, u64 domain split for pure-int32 compares (the u64
+    # compare was the hot loop's last X64 custom call): u32 draw < thresh
+    # == thresh_all | (draw < thresh_u32)
+    thresh_u32: jnp.ndarray  # [G, G] uint32: thresh & 0xFFFFFFFF
+    thresh_all: jnp.ndarray  # [G, G] bool: thresh == 2**32 (loss = 1.0)
     up_rate: jnp.ndarray  # [N] int32 bits/interval
     up_burst: jnp.ndarray  # [N] int32
     up_kfull: jnp.ndarray  # [N] int32: intervals that certainly fill burst
@@ -459,6 +469,25 @@ def rand_u32_lane(seed: int, stream, counter32):
 # --------------------------------------------------------------------------
 
 
+def scan_or_unroll(step, carry, xs, length: int):
+    """``lax.scan`` on XLA:CPU (whose per-op thunk dispatch makes unrolled
+    bodies pathological) — but a plain Python loop with ONE final stack on
+    the accelerator: scan materializes its stacked outputs via a
+    dynamic-update-slice per step even when fully unrolled, and each DUS
+    ends an XLA fusion, fragmenting the loop into one kernel launch per
+    step (measured: the mixed-mesh iteration ballooned to ~300 fusions).
+    The Python-loop form leaves pure elementwise chains that fuse."""
+    if jax.default_backend() == "cpu":
+        return lax.scan(step, carry, xs, length=length)
+    outs = []
+    for j in range(length):
+        xj = None if xs is None else jax.tree.map(lambda a: a[j], xs)
+        carry, o = step(carry, xj)
+        outs.append(o)
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *outs)
+    return carry, stacked
+
+
 def _sort_queues(s: LaneState, with_pay: bool = False) -> LaneState:
     """Key-sort every lane's queue by the 4-word key — the split form of
     the (time, kind, src, seq) total order; empty slots (NEVER pair) end at
@@ -523,6 +552,20 @@ class _SlotEmit(NamedTuple):
     out_size: jnp.ndarray
     out_phi: jnp.ndarray  # int32 payload words
     out_plo: jnp.ndarray
+    # stream burst channel [PUMP_BURST, N]: the epilogue's data segments
+    # (client lanes; dst is the static p_peer).  () when no stream tier
+    bo_valid: Any
+    bo_thi: Any
+    bo_tlo: Any
+    bo_auxl: Any  # engine send seq
+    bo_size: Any
+    bo_phi: Any
+    bo_plo: Any
+    # burst loss records ([PUMP_BURST, N]; () unless logging+stream)
+    brec_valid: Any
+    brec_time: Any
+    brec_seq: Any
+    brec_size: Any
     # log record channel (int64; zeros when logging is off)
     rec_valid: jnp.ndarray
     rec_time: jnp.ndarray
@@ -667,8 +710,8 @@ def _process_slot(
         )
         f1, em1 = lstr.open_flow_vec(f, thi, tlo, stim_open)
         f = lstr._merge_cols(f, f1, stim_open)
-        f2, em2 = lstr.on_pump_vec(f, thi, tlo, stim_pump)
-        f = lstr._merge_cols(f, f2, stim_pump)
+        # stim_pump (a legacy arm; never queued under the burst law) has no
+        # primary effect — the shared epilogue below IS the scalar on_pump
         f3, em3 = lstr.on_rto_vec(f, thi, tlo, stim_rto)
         f = lstr._merge_cols(f, f3, stim_rto)
         f4, em4 = lstr.on_segment_vec(
@@ -676,15 +719,17 @@ def _process_slot(
         )
         f = lstr._merge_cols(f, f4, stim_seg)
         sem = lstr._merge_emit(
-            lstr._merge_emit(
-                lstr._merge_emit(em1, em2, stim_pump), em3, stim_rto
-            ),
-            em4,
-            stim_seg,
+            lstr._merge_emit(em1, em3, stim_rto), em4, stim_seg
         )
         # completion latches (counted once, like the CPU _track)
         f = f._replace(
             completed=f.completed | (sem.completed_now & stream_stim)
+        )
+        # the transmission-opportunity epilogue: every stimulus ends with
+        # a burst of up to PUMP_BURST window-permitted data segments
+        # (scalar _pump_units) — the law that removed pump LOCAL events
+        f, sem, st_burst = lstr.pump_epilogue_vec(
+            f, thi, tlo, stream_stim, sem
         )
         stream_state = lstr.scatter_cols(
             s.stream, f, flow, stream_stim & ~server_mask, server_mask,
@@ -692,10 +737,10 @@ def _process_slot(
         )
         s = s._replace(stream=stream_state)
         st_send = sem.send_valid & stream_stim
-        st_pump = sem.pump_valid & stream_stim
         st_rto = sem.rto_valid & stream_stim
     else:
-        st_send = st_pump = st_rto = false_n
+        st_send = st_rto = false_n
+        st_burst = []
         sem = None
         flow = lanes
         is_sv = false_n
@@ -781,8 +826,10 @@ def _process_slot(
         )
         bs_hi, bs_lo = p.bootstrap_end >> 31, p.bootstrap_end & MASK31
         past_bootstrap = pair_ge(thi, tlo, bs_hi, bs_lo)
-        thresh = tb.thresh[my_node, dst_node]
-        lost = do_send & past_bootstrap & (u.astype(jnp.uint64).astype(i64) < thresh)
+        lost = do_send & past_bootstrap & (
+            tb.thresh_all[my_node, dst_node]
+            | (u < tb.thresh_u32[my_node, dst_node])
+        )
         s = s._replace(n_loss=s.n_loss + lost)
     else:
         lost = false_n
@@ -800,6 +847,89 @@ def _process_slot(
     out_auxh = pack_aux_hi(jnp.full(n, PACKET, dtype=i32), lanes)
     out_auxl = snd_seq
 
+    # ---- stream burst channel (the epilogue's data segments) -------------
+    # Each burst unit charges the up bucket and draws loss IN ORDER after
+    # the slot-0 send, exactly like the CPU driver's per-api.send sequence;
+    # engine send seqs rank slot-0 first, then the burst prefix.  A scan
+    # over units: rolled on XLA:CPU, fully unrolled on the accelerator.
+    if sp:
+        b_dst = tb.p_peer  # client lanes only (role-gated by the law)
+        b_node = tb.node_of[b_dst]
+        b_lat = tb.lat[my_node, b_node]
+        if p.has_loss:
+            b_thresh_u32 = tb.thresh_u32[my_node, b_node]
+            b_thresh_all = tb.thresh_all[my_node, b_node]
+            bs_hi2, bs_lo2 = p.bootstrap_end >> 31, p.bootstrap_end & MASK31
+            past_bs = pair_ge(thi, tlo, bs_hi2, bs_lo2)
+
+        def bstep(carry, cols):
+            tok, nrh, nrl, ldh, ldl, nloss, mul, sent_before = carry
+            bm, bflags, bunit, back, bsize = cols
+            bbits = (bsize + FRAME_OVERHEAD_BYTES) * 8
+            tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = bucket_charge_vec(
+                tok, nrh, nrl, ldh, ldl,
+                tb.up_rate, tb.up_burst, tb.up_kfull, tb.up_kfi,
+                thi, tlo, bbits, bm, p.bucket_interval,
+            )
+            bseq = snd_seq + sent_before
+            if p.has_loss:
+                bu = rand_u32_lane(
+                    p.seed,
+                    (lanes.astype(jnp.uint32)
+                     | jnp.uint32(rng_mod.LOSS_STREAM)),
+                    bseq,
+                )
+                blost = bm & past_bs & (
+                    b_thresh_all | (bu < b_thresh_u32)
+                )
+                nloss = nloss + blost
+            else:
+                blost = false_n
+            if p.dynamic_runahead:
+                mul = jnp.minimum(
+                    mul, jnp.min(jnp.where(bm, b_lat, NEVER32))
+                )
+            barr_hi, barr_lo = pair_max(
+                *pair_add32(bdep_hi, bdep_lo, b_lat), we_hi, we_lo
+            )
+            bphi, bplo = lstr.pack_pay(bflags, bunit, back)
+            outs = (
+                bm & ~blost, barr_hi, barr_lo, bseq, bsize, bphi, bplo,
+                blost,
+            )
+            return (tok, nrh, nrl, ldh, ldl, nloss, mul,
+                    sent_before + bm), outs
+
+        carry0 = (
+            s.up_tokens, s.up_nr_hi, s.up_nr_lo, s.up_ld_hi, s.up_ld_lo,
+            s.n_loss, s.min_used_lat, do_send.astype(i32),
+        )
+        carry, bouts = scan_or_unroll(
+            bstep, carry0, st_burst, st_burst[0].shape[0]
+        )
+        (tok, nrh, nrl, ldh, ldl, nloss, mul, sent_after) = carry
+        s = s._replace(
+            up_tokens=tok, up_nr_hi=nrh, up_nr_lo=nrl,
+            up_ld_hi=ldh, up_ld_lo=ldl, n_loss=nloss, min_used_lat=mul,
+        )
+        burst_total = sent_after - do_send.astype(i32)
+        s = s._replace(
+            send_seq=s.send_seq + burst_total, n_sends=s.n_sends + burst_total
+        )
+        (bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
+         blost_all) = bouts  # [B, N] each
+        if p.log_capacity:
+            bb = bo_valid.shape[0]
+            brec_valid = blost_all
+            brec_time = jnp.broadcast_to(t64[None, :], (bb, n))
+            brec_seq = bo_auxl.astype(i64)
+            brec_size = bo_size.astype(i64)
+        else:
+            brec_valid = brec_time = brec_seq = brec_size = ()
+    else:
+        bo_valid = bo_thi = bo_tlo = bo_auxl = bo_size = bo_phi = bo_plo = ()
+        brec_valid = brec_time = brec_seq = brec_size = ()
+
     # ---- local arm channels ---------------------------------------------
     has_timer = (
         (model == M_TGEN_MESH) | (model == M_TGEN_CLIENT) | (model == M_PING_CLIENT)
@@ -811,11 +941,11 @@ def _process_slot(
         | ping_tick
         | (is_timer & (model == M_TGEN_MESH) & (n == 1))
     )
-    rearm = rearm_timer | st_pump
+    rearm = rearm_timer
     ti_hi, ti_lo = pair_add_pair(thi, tlo, tb.p_int_hi, tb.p_int_lo)
-    arm_thi, arm_tlo = pair_sel(st_pump, thi, tlo, ti_hi, ti_lo)
-    arm_size = jnp.where(st_pump, lstr.SZ_PUMP, 0).astype(i32)
-    arm_plo = jnp.where(st_pump, flow, 0)
+    arm_thi, arm_tlo = ti_hi, ti_lo
+    arm_size = jnp.zeros(n, dtype=i32)
+    arm_plo = jnp.zeros(n, dtype=i32)
     loc_auxh = pack_aux_hi(jnp.full(n, LOCAL, dtype=i32), lanes)
     arm_auxh = loc_auxh
     arm_auxl = s.local_seq
@@ -854,6 +984,8 @@ def _process_slot(
         arm2_valid, arm2_thi, arm2_tlo, arm2_auxh, arm2_auxl, arm2_plo,
         out_valid, dst, arr_hi, arr_lo, out_auxh, out_auxl, out_size,
         out_phi, out_plo,
+        bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
+        brec_valid, brec_time, brec_seq, brec_size,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
     )
     return s, emit
@@ -901,7 +1033,8 @@ def _window_gather(arrs, start, c):
     return out
 
 
-def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
+def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
+                  emits: _SlotEmit):
     """Append all generated events by **merge**, not scatter (TPU scatters
     serialize; sorts and gathers vectorize):
 
@@ -965,6 +1098,38 @@ def _merge_append(p: LaneParams, s: LaneState, emits: _SlotEmit):
     if sp:
         flat_ops.append(emits.out_phi.reshape(-1))
         flat_ops.append(emits.out_plo.reshape(-1))
+        if p.stream_clients:
+            # the burst channel, COMPACTED to the static client lanes
+            # (the law's role gate makes all other rows invalid): a few
+            # thousand extra sort entries instead of N*K*PUMP_BURST
+            import numpy as _np
+
+            ci = _np.asarray(p.stream_clients, dtype=_np.int32)
+            kk, bb, _nn = emits.bo_valid.shape
+            nc = ci.shape[0]
+            bv = emits.bo_valid[:, :, ci].reshape(-1)
+            peer_ci = jnp.broadcast_to(
+                tb.p_peer[ci][None, None, :], (kk, bb, nc)
+            ).reshape(-1)
+            b_dst = jnp.where(bv, peer_ci, jnp.int32(n))
+            src_ci = jnp.broadcast_to(
+                jnp.asarray(ci)[None, None, :], (kk, bb, nc)
+            ).reshape(-1)
+            b_auxh = pack_aux_hi(jnp.full(b_dst.shape, PACKET,
+                                          dtype=jnp.int32), src_ci)
+            extras = [
+                b_dst,
+                emits.bo_thi[:, :, ci].reshape(-1),
+                emits.bo_tlo[:, :, ci].reshape(-1),
+                b_auxh,
+                emits.bo_auxl[:, :, ci].reshape(-1),
+                emits.bo_size[:, :, ci].reshape(-1),
+                emits.bo_phi[:, :, ci].reshape(-1),
+                emits.bo_plo[:, :, ci].reshape(-1),
+            ]
+            flat_ops = [
+                jnp.concatenate([a, b]) for a, b in zip(flat_ops, extras)
+            ]
     sorted_ops = lax.sort(tuple(flat_ops), dimension=0, num_keys=1)
     dst_s, thi_s, tlo_s, auxh_s, auxl_s, size_s = sorted_ops[:6]
     pay_s = sorted_ops[6:8] if sp else None
@@ -1112,6 +1277,42 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
         for _mid in passive_ids:
             passive_lane = passive_lane | (tb.model == _mid)
         allowed = passive_lane[:, None] | (same_t & (pkt_prefix | first_col))
+        if p.stream_present and p.stream_wide_pop:
+            # Stream lanes may co-pop WITHIN-WINDOW queue prefixes beyond
+            # the same-instant rule (distinct times included):
+            # - PACKET pops touch only per-lane network state (dn bucket,
+            #   CoDel) and insert DELIVERYs whose relative order the merge
+            #   preserves; they COMMUTE with DELIVERY pops (which touch
+            #   only flow state), so the CPU heap's interleaving of an
+            #   inserted DELIVERY between two queued events is
+            #   unobservable;
+            # - DELIVERY pops emit sends that arrive >= window end and RTO
+            #   arms at now + rto >= now + RTO_MIN, which the engine
+            #   guarantees lies beyond every possible window
+            #   (stream_wide_pop is set only then) — and the burst law
+            #   queues no same-instant pump events at all;
+            # - a DELIVERY inserted by an in-prefix PACKET lands at the
+            #   bucket's FIFO departure time, >= every queued delivery
+            #   time, so it never overtakes a co-popped event — EXCEPT on
+            #   an exact tie, where (src, seq) breaks order.  In
+            #   one-to-one mode every flow-state-relevant delivery at a
+            #   lane shares one src (its single peer; foreign datagrams
+            #   are no-ops), making ties benign: MIXED packet/delivery
+            #   prefixes are safe.  In star mode ties across clients are
+            #   real, so prefixes stay single-kind.
+            # - LOCAL-interrupted prefixes fall back to slot 0.
+            stream_lane = (tb.model == M_STREAM_CLIENT) | (
+                tb.model == M_STREAM_SERVER
+            )
+            if p.stream_one_to_one:
+                stream_prefix = jnp.cumprod(
+                    kind_cols != LOCAL, axis=1
+                ).astype(bool)
+            else:
+                stream_prefix = pkt_prefix | jnp.cumprod(
+                    kind_cols == DELIVERY, axis=1
+                ).astype(bool)
+            allowed = allowed | (stream_lane[:, None] & stream_prefix)
         act = allowed & pair_lt(thi, tlo, we_hi, we_lo)
         kcol, srccol = unpack_aux_hi(s.q_auxh[:, :k])
         popped = {
@@ -1159,30 +1360,44 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 nb = jnp.zeros(p.n_lanes, dtype=bool)
                 z64 = jnp.zeros(p.n_lanes, dtype=jnp.int64)
                 z32 = jnp.zeros(p.n_lanes, dtype=jnp.int32)
+                if p.stream_present:
+                    from ..net import ltcp as _ltcp
+
+                    bshape = (_ltcp.PUMP_BURST, p.n_lanes)
+                    bo_b = jnp.zeros(bshape, dtype=bool)
+                    bo_i = jnp.zeros(bshape, dtype=jnp.int32)
+                    if p.log_capacity:
+                        br_b: Any = bo_b
+                        br_i: Any = jnp.zeros(bshape, dtype=jnp.int64)
+                    else:
+                        br_b = br_i = ()
+                else:
+                    bo_b = bo_i = br_b = br_i = ()
                 return st_, _SlotEmit(
                     nb, z32, z32, z32, z32, z32, z32, z32,
                     nb, z32, z32, z32, z32, z32, z32,
                     nb, z32, z32, z32, z32, z32,
                     nb, z32, z32, z32, z32, z32, z32, z32, z32,
+                    bo_b, bo_i, bo_i, bo_i, bo_i, bo_i, bo_i,
+                    br_b, br_i, br_i, br_i,
                     nb, z64, z64, z64, z64, z64, z64,
                 )
 
             return lax.cond(jnp.any(slot_cols["act"]), live, dead, st)
 
         slots = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), popped)  # [K, N]
-        # On TPU, full unroll removes the scan loop's per-step kernel
-        # boundaries so XLA fuses across slots.  On CPU the duplicated
-        # slot bodies multiply the HLO op count, and XLA:CPU pays a
-        # per-op thunk dispatch — K=8 unrolled made tiny parity runs
-        # hundreds of times slower than the rolled loop.
-        slot_unroll = k if jax.default_backend() != "cpu" else 1
-        s, emits = lax.scan(scan_body, s, slots, unroll=slot_unroll)
+        # On the accelerator, a Python loop over slots leaves fusable
+        # chains (scan's stacked outputs fragment fusion into one launch
+        # per step); on CPU the rolled scan keeps the HLO small — K
+        # duplicated slot bodies under XLA:CPU's per-op thunk dispatch
+        # made tiny parity runs hundreds of times slower.
+        s, emits = scan_or_unroll(scan_body, s, slots, k)
 
         if pure_dataflow:
             # always merge: a merge whose insert channels are all empty
             # reduces to the row re-sort that restores the sorted
             # invariant, so one unconditional path replaces the cond
-            s, over_rec = _merge_append(p, s, emits)
+            s, over_rec = _merge_append(p, tb, s, emits)
             s = _append_log(p, s, over_rec)
         else:
             # the merge (exchange + wide row sort) is the expensive step;
@@ -1194,9 +1409,11 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 | jnp.any(emits.arm2_valid)
                 | jnp.any(emits.out_valid)
             )
+            if p.stream_present:
+                any_new = any_new | jnp.any(emits.bo_valid)
 
             def do_merge(st: LaneState) -> LaneState:
-                st, over_rec = _merge_append(p, st, emits)
+                st, over_rec = _merge_append(p, tb, st, emits)
                 return _append_log(p, st, over_rec)
 
             def do_sort(st: LaneState) -> LaneState:
@@ -1214,7 +1431,28 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
             "outcome": emits.rec_outcome.reshape(-1),
         }
         s = _append_log(p, s, per_slot)
-        return s
+        if p.stream_present and p.log_capacity:
+            # burst-channel loss records (DROP_LOSS at the send instant)
+            kk, bb, _nn = emits.brec_valid.shape
+            lanes64 = jnp.broadcast_to(
+                jnp.arange(p.n_lanes, dtype=jnp.int64)[None, None, :],
+                (kk, bb, p.n_lanes),
+            )
+            peer64 = jnp.broadcast_to(
+                tb.p_peer.astype(jnp.int64)[None, None, :],
+                (kk, bb, p.n_lanes),
+            )
+            s = _append_log(p, s, {
+                "valid": emits.brec_valid.reshape(-1),
+                "time": emits.brec_time.reshape(-1),
+                "src": lanes64.reshape(-1),
+                "dst": peer64.reshape(-1),
+                "seq": emits.brec_seq.reshape(-1),
+                "size": emits.brec_size.reshape(-1),
+                "outcome": jnp.full((kk * bb * p.n_lanes,), DROP_LOSS,
+                                    dtype=jnp.int64),
+            })
+        return s._replace(iters=s.iters + 1)
 
     return iter_body
 
@@ -1289,7 +1527,7 @@ _I32_N_FIELDS = (
     "n_delivered", "n_loss", "n_codel", "n_queue", "recv_bytes",
     "n_sends", "n_hops",
 )
-_SCALAR_FIELDS = ("log_count", "log_lost", "rounds", "now_we_hi", "now_we_lo",
+_SCALAR_FIELDS = ("log_count", "log_lost", "rounds", "iters", "now_we_hi", "now_we_lo",
                   "min_used_lat")
 
 

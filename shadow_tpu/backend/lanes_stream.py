@@ -166,18 +166,25 @@ _BOOL_FIELDS = (("in_rec", C_IN_REC), ("completed", C_COMPLETED))
 
 
 class StreamEmit(NamedTuple):
-    """What one stream stimulus emits (all [N], masked by validity)."""
+    """What one stream stimulus emits (all [N], masked by validity).
+    The control/slot-0 send channel; data bursts ride the epilogue's
+    separate channel (pump_epilogue_vec).  There is no pump-arm channel:
+    with PUMP_BURST == RWND_SEGS the epilogue always exhausts the window,
+    so the scalar law's ``arm_pump`` can never fire (asserted below)."""
 
     send_valid: jnp.ndarray
     send_flags: jnp.ndarray
     send_seq: jnp.ndarray
     send_ack: jnp.ndarray
     send_size: jnp.ndarray  # wire size
-    pump_valid: jnp.ndarray  # arm a pump LOCAL at the current time
     rto_valid: jnp.ndarray  # arm an RTO LOCAL
     rto_thi: jnp.ndarray  # pair: RTO event time
     rto_tlo: jnp.ndarray
     completed_now: jnp.ndarray  # flow reached DONE on this stimulus
+
+
+# the no-pump-events invariant the wide co-pop rule in lanes.py rests on
+assert ltcp.PUMP_BURST >= ltcp.RWND_SEGS
 
 
 # --------------------------------------------------------------------------
@@ -319,7 +326,6 @@ def _empty_emit(n: int) -> StreamEmit:
         send_seq=z32,
         send_ack=z32,
         send_size=z32,
-        pump_valid=zb,
         rto_valid=zb,
         rto_thi=z32,
         rto_tlo=z32,
@@ -328,7 +334,8 @@ def _empty_emit(n: int) -> StreamEmit:
 
 
 def _pull_back(f: FlowCols, nh, nl, m, em):
-    """Go-back-N loss response where ``m``."""
+    """Go-back-N loss response where ``m`` (the epilogue pump re-streams
+    the rest)."""
     f = f._replace(
         snd_nxt=jnp.where(m, f.snd_una + 1, f.snd_nxt),
         state=jnp.where(
@@ -341,8 +348,61 @@ def _pull_back(f: FlowCols, nh, nl, m, em):
     f, rv, rth, rtl = _restart_rto(f, nh, nl, m, em.rto_valid, em.rto_thi,
                                    em.rto_tlo)
     em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
-    em = em._replace(pump_valid=em.pump_valid | (m & _can_send_new(f)))
     return f, em
+
+
+def pump_epilogue_vec(f: FlowCols, nh, nl, m, em):
+    """The transmission-opportunity epilogue (scalar ``_pump_units``):
+    transmit up to PUMP_BURST window-permitted units.  Runs ONCE per
+    stimulus, after the handler's primary effects.  Returns
+    ``(f, em, burst)`` where ``burst`` is a ``(valid, flags, seq, ack,
+    size)`` tuple of stacked [PUMP_BURST, N] arrays whose validity is a
+    PREFIX along axis 0 (emissions stop when the window exhausts) — the
+    engine's send-sequence ranking relies on that.
+
+    A ``lanes.scan_or_unroll`` over units: a rolled scan on XLA:CPU, a
+    fusable Python loop on the accelerator."""
+    from . import lanes as _lanes
+
+    def step(carry, _):
+        f, em = carry
+        mi = m & _can_send_new(f)
+        unit = f.snd_nxt
+        f = f._replace(snd_nxt=jnp.where(mi, unit + 1, f.snd_nxt))
+        retransmit = unit < f.max_sent
+        fresh_ts = mi & ~retransmit & (f.rtt_seq < 0)
+        f = f._replace(
+            rtt_ts_hi=jnp.where(fresh_ts, nh, f.rtt_ts_hi),
+            rtt_ts_lo=jnp.where(fresh_ts, nl, f.rtt_ts_lo),
+        )
+        flags = _seg_flags(f, unit)
+        size = _seg_wire_size(f, unit)
+        f = f._replace(
+            tx_segs=f.tx_segs + mi,
+            retransmits=f.retransmits + (mi & retransmit),
+            rtt_seq=jnp.where(
+                mi & retransmit & (f.rtt_seq >= 0) & (unit <= f.rtt_seq),
+                -1,
+                jnp.where(mi & ~retransmit & (f.rtt_seq < 0), unit,
+                          f.rtt_seq),
+            ),
+            max_sent=jnp.where(
+                mi & (unit + 1 > f.max_sent), unit + 1, f.max_sent
+            ),
+        )
+        out = (mi, flags, unit, f.rcv_nxt, size)
+        f = f._replace(
+            state=jnp.where(mi & (unit == f.segs + 1), ltcp.FIN_WAIT, f.state)
+        )
+        f, rv, rth, rtl = _restart_rto(f, nh, nl, mi, em.rto_valid,
+                                       em.rto_thi, em.rto_tlo)
+        em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
+        return (f, em), out
+
+    (f, em), burst = _lanes.scan_or_unroll(
+        step, (f, em), None, ltcp.PUMP_BURST
+    )
+    return f, em, burst
 
 
 # --------------------------------------------------------------------------
@@ -365,28 +425,6 @@ def open_flow_vec(f: FlowCols, nh, nl, m) -> tuple[FlowCols, StreamEmit]:
     f, rv, rth, rtl = _restart_rto(f, nh, nl, m, em.rto_valid, em.rto_thi,
                                    em.rto_tlo)
     em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
-    return f, em
-
-
-def on_pump_vec(f: FlowCols, nh, nl, m) -> tuple[FlowCols, StreamEmit]:
-    em = _empty_emit(f.state.shape[0])
-    m = m & _can_send_new(f)
-    unit = f.snd_nxt
-    f = f._replace(snd_nxt=jnp.where(m, f.snd_nxt + 1, f.snd_nxt))
-    retransmit = unit < f.max_sent
-    fresh_ts = m & ~retransmit & (f.rtt_seq < 0)
-    f = f._replace(
-        rtt_ts_hi=jnp.where(fresh_ts, nh, f.rtt_ts_hi),
-        rtt_ts_lo=jnp.where(fresh_ts, nl, f.rtt_ts_lo),
-    )
-    f, em = _emit_unit(f, unit, m, retransmit, em)
-    f = f._replace(
-        state=jnp.where(m & (unit == f.segs + 1), ltcp.FIN_WAIT, f.state)
-    )
-    f, rv, rth, rtl = _restart_rto(f, nh, nl, m, em.rto_valid, em.rto_thi,
-                                   em.rto_tlo)
-    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
-    em = em._replace(pump_valid=em.pump_valid | (m & _can_send_new(f)))
     return f, em
 
 
@@ -595,22 +633,8 @@ def on_segment_vec(
         rtodl_hi=jnp.where(fin_done, NEVER32, f.rtodl_hi),
         rtodl_lo=jnp.where(fin_done, NEVER32, f.rtodl_lo),
     )
-    # ACK opened the window and nothing else was sent: pump one unit now
-    opened = (
-        snd & ~fin_done & (f.state == ltcp.ESTAB) & ~em.send_valid
-        & _can_send_new(f)
-    )
-    f2, em2 = on_pump_vec(f, nh, nl, opened)
-    f = _merge_cols(f, f2, opened)
-    # the scalar law keeps the ACK path's RTO arm unless the pump re-arms
-    # (ltcp.py: `if pump.arm_rto is not None: em.arm_rto = ...`) — a plain
-    # masked merge would drop an armed owner event that was never queued,
-    # killing the flow's retransmission timer
-    keep_rv = jnp.where(opened, em.rto_valid | em2.rto_valid, em.rto_valid)
-    keep_rth = jnp.where(opened & em2.rto_valid, em2.rto_thi, em.rto_thi)
-    keep_rtl = jnp.where(opened & em2.rto_valid, em2.rto_tlo, em.rto_tlo)
-    em = _merge_emit(em, em2, opened)
-    em = em._replace(rto_valid=keep_rv, rto_thi=keep_rth, rto_tlo=keep_rtl)
+    # a window opened by this ACK is streamed by the epilogue pump
+    # (pump_epilogue_vec, run once per stimulus by the slot driver)
     # sender path returns here in the scalar law
     m = m & ~snd
 

@@ -193,6 +193,15 @@ class TpuEngine:
             for cid in client_ids:
                 cl_of[int(p_peer[cid])] = int(cid)
 
+        # wide stream co-pop is sound only when every possible lookahead
+        # window ends before RTO_MIN (DELIVERY pops then provably insert
+        # nothing same-window); the dynamic window never exceeds the
+        # largest link latency
+        from ..net import ltcp as ltcp_mod
+
+        max_window = max(runahead, int(np.max(np.asarray(lat), initial=0)))
+        stream_wide_pop = max_window < ltcp_mod.RTO_MIN
+
         self.params = lanes.LaneParams(
             n_lanes=n,
             capacity=capacity,
@@ -208,6 +217,8 @@ class TpuEngine:
             dynamic_runahead=bool(cfg.experimental.use_dynamic_runahead),
             runahead_floor=max(cfg.experimental.runahead or 0, 1),
             stream_one_to_one=one_to_one,
+            stream_clients=tuple(int(c) for c in client_ids),
+            stream_wide_pop=stream_wide_pop,
         )
 
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)
@@ -270,7 +281,10 @@ class TpuEngine:
         self.tables = lanes.LaneTables(
             node_of=jnp.asarray(node_idx, dtype=i32),
             lat=jnp.asarray(lat, dtype=i32),
-            thresh=jnp.asarray(thresh),
+            thresh_u32=jnp.asarray(
+                (np.asarray(thresh) & 0xFFFFFFFF).astype(np.uint32)
+            ),
+            thresh_all=jnp.asarray(np.asarray(thresh) >= (1 << 32)),
             up_rate=jnp.asarray(up[:, 0], dtype=i32),
             up_burst=jnp.asarray(up[:, 1], dtype=i32),
             up_kfull=jnp.asarray(up_kfull),
@@ -404,6 +418,7 @@ class TpuEngine:
             log_count=jnp.int32(0),
             log_lost=jnp.int32(0),
             rounds=jnp.int32(0),
+            iters=jnp.int32(0),
             now_we_hi=jnp.int32(0),
             now_we_lo=jnp.int32(0),
             min_used_lat=jnp.int32(lanes.NEVER32),
@@ -516,6 +531,7 @@ class TpuEngine:
         add("tgen_recv_bytes", int(recv_bytes[tgen_mask].sum()))
         hops = np.asarray(s.n_hops)
         add("phold_hops", int(hops[model == lanes.M_PHOLD].sum()))
+        add("lane_iters", int(s.iters))
         add("lane_delivered", int(delivered.sum()))
         add("lane_drop_loss", int(np.asarray(s.n_loss).sum()))
         add("lane_drop_codel", int(np.asarray(s.n_codel).sum()))

@@ -46,8 +46,7 @@ class _FlowDriver:
 
     def _apply(self, api, fs: ltcp.FlowState, em: ltcp.Emit, peer: int,
                client: int, conn: int):
-        if em.send is not None:
-            flags, seq, ack, size = em.send
+        for flags, seq, ack, size in em.sends:
             api.send(peer, size, payload=StreamSeg(client, conn, flags, seq, ack))
         if em.arm_pump:
             api.schedule_at(api.now, self._pump_cb(fs, peer, client, conn))

@@ -75,6 +75,12 @@ DUP_THRESH = 3
 # RTTs this is the per-flow throughput cap (24 * MSS / RTT).
 RWND_SEGS = 24
 MAX_CWND_FP = 2 * RWND_SEGS * FP  # growth past the window is pointless
+# Transmission-opportunity budget: every stimulus ends with an epilogue
+# that transmits up to this many window-permitted units (real stacks
+# likewise burst the permitted window per ACK).  At RWND_SEGS the window
+# always exhausts before the budget, so a same-instant pump event is
+# never queued — the lane backend's wide event co-pop relies on that.
+PUMP_BURST = RWND_SEGS
 
 # -- RTO constants (RFC 6298, ns) ------------------------------------------
 RTO_INIT = 1_000_000_000  # 1 s
@@ -125,12 +131,18 @@ class FlowState:
 @dataclasses.dataclass
 class Emit:
     """What one stimulus produces (the scalar form of the lane channels):
-    at most ONE outbound segment, plus pump/RTO local-event arms."""
+    at most one control segment plus a burst of up to PUMP_BURST data
+    segments (every handler ends with the transmission-opportunity
+    epilogue), plus pump/RTO local-event arms."""
 
-    send: Optional[tuple[int, int, int, int]] = None  # (flags, seq, ack, size)
+    sends: list = dataclasses.field(default_factory=list)  # (flags, seq, ack, size)
     arm_pump: bool = False  # queue a pump event at the current time
     arm_rto: Optional[int] = None  # queue an RTO event at this time
     completed: bool = False  # flow reached DONE on this stimulus
+
+    @property
+    def send(self):  # first send (compat accessor for single-send paths)
+        return self.sends[0] if self.sends else None
 
 
 # ---------------------------------------------------------------------------
@@ -206,7 +218,9 @@ def _restart_rto(fs: FlowState, now: int, em: Emit) -> None:
 
 
 def _emit_unit(fs: FlowState, unit: int, em: Emit, retransmit: bool) -> None:
-    em.send = (seg_flags(fs, unit), unit, fs.rcv_nxt, seg_wire_size(fs, unit))
+    em.sends.append(
+        (seg_flags(fs, unit), unit, fs.rcv_nxt, seg_wire_size(fs, unit))
+    )
     fs.tx_segs += 1
     if retransmit:
         fs.retransmits += 1
@@ -220,13 +234,33 @@ def _emit_unit(fs: FlowState, unit: int, em: Emit, retransmit: bool) -> None:
 
 def _pull_back(fs: FlowState, now: int, em: Emit) -> None:
     """Go-back-N loss response: rewind ``snd_nxt`` to the hole, retransmit
-    it, and let the pump re-stream everything after it (the receiver
-    discarded all out-of-order units anyway)."""
+    it, and let the epilogue pump re-stream everything after it (the
+    receiver discarded all out-of-order units anyway)."""
     fs.snd_nxt = fs.snd_una + 1
     if fs.role == SENDER and fs.state == FIN_WAIT:
         fs.state = ESTAB  # the FIN will be re-sent when the stream re-walks
     _emit_unit(fs, fs.snd_una, em, retransmit=True)
     _restart_rto(fs, now, em)
+
+
+def _pump_units(fs: FlowState, now: int, em: Emit, budget: int) -> None:
+    """The transmission-opportunity epilogue: transmit up to ``budget``
+    window-permitted units (new data or go-back-N re-stream below
+    ``max_sent``), re-arm the pump only if room remains — with
+    budget == PUMP_BURST the window always exhausts first, so the re-arm
+    never fires (see PUMP_BURST)."""
+    sent = 0
+    while sent < budget and can_send_new(fs):
+        unit = fs.snd_nxt
+        fs.snd_nxt += 1
+        retransmit = unit < fs.max_sent
+        if not retransmit and fs.rtt_seq < 0:
+            fs.rtt_ts = now
+        _emit_unit(fs, unit, em, retransmit=retransmit)
+        if unit == fs.segs + 1:
+            fs.state = FIN_WAIT
+        _restart_rto(fs, now, em)
+        sent += 1
     if can_send_new(fs):
         em.arm_pump = True
 
@@ -244,27 +278,16 @@ def open_flow(fs: FlowState, now: int) -> Emit:
     _emit_unit(fs, 0, em, retransmit=False)
     fs.rtt_ts = now
     _restart_rto(fs, now, em)
+    _pump_units(fs, now, em, PUMP_BURST)  # no-op in SYN_SENT (uniform law)
     return em
 
 
 def on_pump(fs: FlowState, now: int) -> Emit:
-    """A transmission-opportunity event: send at most one unit (new data,
-    or a go-back-N re-stream unit below ``max_sent``) and re-arm if the
-    window still has room after it."""
+    """A transmission-opportunity event: burst up to PUMP_BURST permitted
+    units (kept for law completeness — with the epilogue on every
+    stimulus, pump events are no longer queued)."""
     em = Emit()
-    if not can_send_new(fs):
-        return em
-    unit = fs.snd_nxt
-    fs.snd_nxt += 1
-    retransmit = unit < fs.max_sent
-    if not retransmit and fs.rtt_seq < 0:
-        fs.rtt_ts = now
-    _emit_unit(fs, unit, em, retransmit=retransmit)
-    if unit == fs.segs + 1:
-        fs.state = FIN_WAIT
-    _restart_rto(fs, now, em)
-    if can_send_new(fs):
-        em.arm_pump = True
+    _pump_units(fs, now, em, PUMP_BURST)
     return em
 
 
@@ -274,7 +297,15 @@ def on_rto_event(fs: FlowState, now: int) -> Emit:
     re-arm).  Staleness law: if the live deadline moved later, re-arm
     there; if no data is outstanding, lapse.  Processing always moves
     ``rto_evt`` off ``now``, so a coincidentally-reused time cannot
-    double-fire."""
+    double-fire.  Ends with the uniform transmission-opportunity epilogue
+    (a no-op on the stale/lapse/re-arm paths: those change no send
+    state)."""
+    em = _on_rto_inner(fs, now)
+    _pump_units(fs, now, em, PUMP_BURST)
+    return em
+
+
+def _on_rto_inner(fs: FlowState, now: int) -> Emit:
     em = Emit()
     if now != fs.rto_evt:
         return em  # stale (superseded) event
@@ -301,12 +332,21 @@ def on_segment(
 ) -> Emit:
     """An inbound wire segment for this flow.  ``size`` is the wire size
     (engine delivery size); data payload is ``size - HDR_BYTES`` so neither
-    side needs the peer's transfer-shape tables."""
+    side needs the peer's transfer-shape tables.  Like every stimulus, ends
+    with the transmission-opportunity epilogue (burst pump)."""
+    em = _on_segment_inner(fs, now, flags, seq, ack, size)
+    _pump_units(fs, now, em, PUMP_BURST)
+    return em
+
+
+def _on_segment_inner(
+    fs: FlowState, now: int, flags: int, seq: int, ack: int, size: int
+) -> Emit:
     em = Emit()
     if fs.state == DONE:
         # dup FIN from a peer that missed our final ACK: re-ACK it
         if fs.role == SENDER and flags & F_FIN:
-            em.send = (F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES)
+            em.sends.append((F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES))
         return em
 
     # -- passive open -------------------------------------------------------
@@ -381,17 +421,11 @@ def on_segment(
             # server's FIN (its unit 1), and everything of ours (incl. our
             # FIN) is acked — by this segment or earlier
             fs.rcv_nxt = 2
-            em.send = (F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES)
+            em.sends.append((F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES))
             fs.state = DONE
             fs.rto_deadline = NEVER
             em.completed = True
-        elif fs.state == ESTAB and em.send is None and can_send_new(fs):
-            # the ACK opened the window: send one unit now, pump the rest
-            pump = on_pump(fs, now)
-            em.send = pump.send
-            em.arm_pump = pump.arm_pump
-            if pump.arm_rto is not None:
-                em.arm_rto = pump.arm_rto
+        # a window opened by this ACK is streamed by the epilogue pump
         return em
 
     # -- receiver-side data path -------------------------------------------
@@ -404,7 +438,7 @@ def on_segment(
                 fs.rx_segs += 1
                 fs.rx_bytes += size - HDR_BYTES
             # ACK everything (in-order advance or duplicate for OOO)
-            em.send = (F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES)
+            em.sends.append((F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES))
         elif flags & F_FIN:
             if seq == fs.rcv_nxt:
                 # client's FIN in order: consume it, answer with our FIN+ACK
@@ -417,7 +451,7 @@ def on_segment(
                 fs.state = LAST_ACK
                 _restart_rto(fs, now, em)
             else:
-                em.send = (F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES)
+                em.sends.append((F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES))
     elif fs.state == LAST_ACK:
         if fs.snd_una >= 2:
             # the final ACK arrived (processed above): teardown complete

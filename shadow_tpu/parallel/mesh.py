@@ -28,7 +28,7 @@ HOST_AXIS = "hosts"
 
 # LaneState fields that are not per-lane arrays and stay replicated
 _REPLICATED_FIELDS = frozenset(
-    ("log", "log_count", "log_lost", "rounds", "now_we_hi", "now_we_lo",
+    ("log", "log_count", "log_lost", "rounds", "iters", "now_we_hi", "now_we_lo",
      "min_used_lat")
 )
 

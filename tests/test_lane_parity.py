@@ -377,18 +377,26 @@ def test_vector_law_keeps_ack_rto_arm_through_opened_pump():
                         rtt_ts=970_000_000, rto_deadline=1_900_000_000,
                         rto_evt=1_900_000_000)
     em_ref = ltcp.on_segment(fs, now, ltcp.F_ACK, 0, 6)
+    m = jnp.array([True])
     f2, em = lstr.on_segment_vec(
-        f, nh, nl, jnp.array([True]), jnp.array([ltcp.F_ACK]),
+        f, nh, nl, m, jnp.array([ltcp.F_ACK]),
         jnp.array([0], dtype=jnp.int32), jnp.array([6], dtype=jnp.int32),
         jnp.array([ltcp.HDR_BYTES], dtype=jnp.int32),
     )
+    # the slot driver runs the transmission-opportunity epilogue after
+    # every stimulus — mirror it (the scalar wrapper does the same)
+    f2, em, burst = lstr.pump_epilogue_vec(f2, nh, nl, m, em)
     assert em_ref.arm_rto is not None  # the scenario arms a shrunk owner
     assert bool(em.rto_valid[0])
     rto_t = (int(em.rto_thi[0]) << 31) | int(em.rto_tlo[0])
     assert rto_t == em_ref.arm_rto
     evt = (int(f2.rtoev_hi[0]) << 31) | int(f2.rtoev_lo[0])
     assert evt == fs.rto_evt
-    assert bool(em.send_valid[0]) == (em_ref.send is not None)
+    # the epilogue pumped the same units the scalar law emitted
+    n_burst = int(burst[0].sum())
+    assert n_burst == len(em_ref.sends)
+    assert [int(x) for x in jnp.stack([b for b in burst[2]])[
+        jnp.stack([b for b in burst[0]])]] == [sd[1] for sd in em_ref.sends]
 
 
 def test_mixed_mesh_stream_parity():

@@ -41,8 +41,7 @@ class WireSim:
         fs = self.client if who == "c" else self.server
         peer = self.server if who == "c" else self.client
         d = "c2s" if who == "c" else "s2c"
-        if em.send is not None:
-            flags, seq, ack, size = em.send
+        for flags, seq, ack, size in em.sends:
             nth = self.sent[d]
             self.sent[d] += 1
             self.wire_log.append((t, d, flags, seq, ack, size))

@@ -148,3 +148,30 @@ hosts:
     )
     report = determinism_check(cfg)
     assert report.identical, report.describe()
+
+
+def test_atomic_unmask_and_wait():
+    """The ppoll sigmask (the atomic unmask-and-wait those calls exist
+    for): the parent BLOCKS SIGUSR1, then ppoll()s with a mask that
+    admits it.  The simulated signal must interrupt the wait at its
+    delivery instant (+1000 ms) with the handler run — not lose the
+    wakeup and time out at +5000 ms."""
+    res = shadow_exec([str(BUILD / "sigwait")], stop_time="100s")
+    assert res.ok, res.stdout
+    assert "ppoll r=-1 errno=EINTR got=1 at +1000 ms" in res.stdout
+
+
+def test_atomic_unmask_and_wait_deterministic():
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: 100s, seed: 9}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  solo:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'sigwait'}
+"""
+    )
+    report = determinism_check(cfg)
+    assert report.identical, report.describe()

@@ -89,3 +89,49 @@ def test_python_httpd_curl_deterministic(tmp_path):
     _, out1 = _run(tmp_path, "r1")
     _, out2 = _run(tmp_path, "r2")
     assert out1 == out2
+
+
+IP_BIN = "/usr/sbin/ip" if Path("/usr/sbin/ip").exists() else shutil.which("ip")
+
+
+def _run_ip(tmp_path: Path, tag: str):
+    data = tmp_path / tag / "data"
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: 5s, seed: 4, data_directory: {data}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  router:
+    network_node_id: 0
+    processes:
+      - path: {IP_BIN}
+        args: [addr, show]
+"""
+    )
+    result = Simulation(cfg).run()
+    return result, (data / "hosts" / "router" / "ip.stdout").read_text()
+
+
+@pytest.mark.skipif(IP_BIN is None, reason="iproute2 not installed")
+def test_iproute2_sees_simulated_interfaces(tmp_path):
+    """An UNMODIFIED iproute2 `ip addr show` enumerates the SIMULATED
+    interfaces over the emulated AF_NETLINK(NETLINK_ROUTE) dump surface
+    (the reference's socket/netlink.rs answers the same requests): lo +
+    eth0 with the host's simulated 11.0.0.0/8 address — never the real
+    machine's interfaces."""
+    result, out = _run_ip(tmp_path, "a")
+    assert "1: lo:" in out and "LOOPBACK" in out
+    assert "inet 127.0.0.1/8" in out
+    assert "2: eth0:" in out
+    assert "inet 11.0.0.1/8" in out  # the simulated address, /8 assignment
+    assert "state UP" in out
+    # deterministic MAC derived from the simulated IP
+    assert "link/ether 02:54:0b:00:00:01" in out
+    assert not result.process_errors
+
+
+@pytest.mark.skipif(IP_BIN is None, reason="iproute2 not installed")
+def test_iproute2_netlink_deterministic(tmp_path):
+    _, out1 = _run_ip(tmp_path, "r1")
+    _, out2 = _run_ip(tmp_path, "r2")
+    assert out1 == out2

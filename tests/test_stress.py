@@ -1,0 +1,136 @@
+"""The determinism STRESS gate (the reference's determinism suite run as a
+regression hammer, src/test/determinism/CMakeLists.txt:1-45): repeat the
+raciest workloads — fork trees, pthreads, real-software HTTP over the
+simulated TCP stack — many times and require bit-identical results on
+every repetition.  Any unsynchronized ordering in the futex channels, the
+scheduler, or the engine shows up as a diff.
+
+Skipped by default (minutes of wall time); the gate is ONE command:
+
+    SHADOW_TPU_STRESS=1 python -m pytest tests/test_stress.py -q
+
+``SHADOW_TPU_STRESS_REPEATS`` overrides the repetition count (default 20).
+"""
+
+import os
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.determinism import determinism_check
+
+REPO = Path(__file__).resolve().parents[1]
+BUILD = REPO / "native" / "build"
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("SHADOW_TPU_STRESS"),
+    reason="stress gate: set SHADOW_TPU_STRESS=1 to run",
+)
+
+REPEATS = int(os.environ.get("SHADOW_TPU_STRESS_REPEATS", "20"))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_build():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+    )
+
+
+def _repeat_identical(yaml: str) -> None:
+    first = None
+    for i in range(REPEATS):
+        report = determinism_check(ConfigOptions.from_yaml(yaml))
+        assert report.identical, f"repeat {i}: {report.describe()}"
+        if first is None:
+            first = report
+    assert first is not None
+
+
+def test_stress_fork_tree(tmp_path):
+    _repeat_identical(
+        f"""
+general: {{stop_time: 30s, seed: 11, data_directory: {tmp_path / 'd'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'forker'}
+        args: ["2", "300"]
+"""
+    )
+
+
+def test_stress_threads(tmp_path):
+    _repeat_identical(
+        f"""
+general: {{stop_time: 60s, seed: 5, data_directory: {tmp_path / 'd'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'threads'}
+"""
+    )
+
+
+def test_stress_signals(tmp_path):
+    _repeat_identical(
+        f"""
+general: {{stop_time: 100s, seed: 3, data_directory: {tmp_path / 'd'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  solo:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'sigdemo'}
+"""
+    )
+
+
+def test_stress_real_http(tmp_path):
+    """The real-software pair (CPython http.server + curl) run-to-run,
+    REPEATS times: byte-identical client output every time."""
+    import shutil
+    import sys
+
+    curl = shutil.which("curl")
+    if curl is None:
+        pytest.skip("curl not installed")
+    py = "/usr/bin/python3" if Path("/usr/bin/python3").exists() else sys.executable
+    from shadow_tpu.engine.sim import Simulation
+
+    docroot = tmp_path / "www"
+    docroot.mkdir()
+    (docroot / "x.txt").write_text("stress\n")
+    os.utime(docroot / "x.txt", (946684800, 946684800))
+
+    outs = set()
+    for i in range(max(REPEATS // 4, 2)):  # heavier per-rep: fewer reps
+        data = tmp_path / f"d{i}"
+        cfg = ConfigOptions.from_yaml(
+            f"""
+general: {{stop_time: 20s, seed: 11, data_directory: {data}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  www:
+    network_node_id: 0
+    processes:
+      - path: {py}
+        args: [-m, http.server, "8080", --bind, 0.0.0.0, --directory, {docroot}]
+        expected_final_state: running
+  client:
+    network_node_id: 0
+    processes:
+      - path: {curl}
+        args: [-s, -i, --max-time, "15", http://www:8080/x.txt]
+        start_time: 2s
+"""
+        )
+        Simulation(cfg).run()
+        outs.add((data / "hosts" / "client" / "curl.stdout").read_text())
+    assert len(outs) == 1, f"{len(outs)} distinct outputs across repeats"
