@@ -27,9 +27,11 @@ Intentional deviations (documented for the parity harness):
   flag triggers an immediate ACK; interactive-traffic coalescing is a
   wall-clock heuristic that hurts a discrete-event simulation's
   determinism budget and hides send/recv causality.
-- loss recovery is NewReno-style (cumulative ACKs + fast retransmit after
-  3 dup-acks + partial-ack retransmit), no SACK (the reference's C++
-  tcp_retransmit_tally.cc tracks SACK ranges; the Rust crate has none).
+- loss recovery is NewReno + SACK (RFC 2018 receiver blocks from the
+  reassembly stash, an RFC 6675-style sender scoreboard walking un-SACKed
+  holes, ack-paced) — the capability of the reference's C++
+  tcp_retransmit_tally.cc range bookkeeping.  SACK option bytes are not
+  charged to the simulated wire size (documented simplification).
 - no TCP timestamps / PAWS; simulated sequence spaces never wrap within a
   connection's lifetime at simulated bandwidths.
 """
@@ -77,6 +79,19 @@ def seq_max(a: int, b: int) -> int:
     return a if seq_ge(a, b) else b
 
 
+def _merge_ranges(rel: list) -> list:
+    """Fold sorted-or-not relative [a, b) ranges into a merged ascending
+    list (shared by the receiver's SACK blocks and the sender scoreboard —
+    one algorithm, one adjacency rule)."""
+    merged: list[list[int]] = []
+    for a, b in sorted(rel):
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    return merged
+
+
 # -- wire vocabulary --------------------------------------------------------
 
 
@@ -103,6 +118,8 @@ class TcpHeader:
     flags: TcpFlags
     window: int  # as transmitted (already scaled down by the sender)
     wscale: Optional[int] = None  # SYN-only option
+    sack_ok: bool = False  # SYN-only option: SACK permitted (RFC 2018)
+    sack: tuple = ()  # up to 3 (start, end-exclusive) SACK blocks
 
     HEADER_BYTES = 20  # simulated wire size of the TCP header
 
@@ -168,6 +185,7 @@ class TcpConfig:
     data_retries: int = 15
     time_wait: int = 60 * NANOS_PER_SEC  # 2*MSL
     init_cwnd_segments: int = 10  # Linux IW10
+    sack: bool = True  # RFC 2018/6675 selective acknowledgment
 
 
 class TcpState:
@@ -224,6 +242,14 @@ class TcpState:
         self._snd_buf = bytearray()
         self._rcv_buf = bytearray()
         self._ooo: dict[int, bytes] = {}  # seq -> payload (reassembly)
+        # SACK (RFC 2018 receiver blocks + RFC 6675-style sender holes):
+        # negotiated on the SYN exchange; the scoreboard holds merged
+        # (start, end-exclusive) ranges the peer reported holding, always
+        # above snd_una; the cursor walks un-SACKed holes during recovery
+        self.sack_enabled = False
+        self._sacked: list[tuple[int, int]] = []
+        self._rexmit_cursor = 0
+        self._last_ooo: Optional[int] = None  # most recent stash (block 1)
         # control-signal latches
         self.syn_pending = False  # need to emit SYN / SYN-ACK
         self.fin_pending = False  # app closed; FIN not yet sent
@@ -366,6 +392,7 @@ class TcpState:
             else:
                 self.snd_wscale = 0
                 self.rcv_wscale = 0  # peer didn't negotiate: both sides off
+            self.sack_enabled = self.cfg.sack and hdr.sack_ok
             self.snd_wnd = hdr.window << self.snd_wscale
             self.snd_wl1 = hdr.seq
             self.snd_wl2 = hdr.ack
@@ -425,6 +452,8 @@ class TcpState:
         if seq_gt(ack, self.snd_max):
             self.ack_pending = True  # acks data we never sent
             return
+        if self.sack_enabled and hdr.sack:
+            self._sack_merge(hdr.sack)
         # window update (RFC 793 SND.WL1/WL2 discipline)
         if seq_lt(self.snd_wl1, hdr.seq) or (
             self.snd_wl1 == hdr.seq and seq_le(self.snd_wl2, ack)
@@ -470,6 +499,8 @@ class TcpState:
         if data_acked > 0:
             del self._snd_buf[:data_acked]
         self.snd_una = ack
+        if self._sacked:
+            self._sack_trim()
         if seq_gt(ack, self.snd_nxt):
             # a cumulative ACK past an RTO rewind point: everything up to it
             # is delivered, skip re-sending (go-back-N with snd_max memory)
@@ -483,6 +514,7 @@ class TcpState:
                 self.dup_acks = 0
             else:
                 # partial ack: retransmit next hole, stay in recovery
+                self._rexmit_cursor = self.snd_una
                 self.rexmit_pending = True
                 self.cwnd = max(self.cwnd - newly + mss, mss)
         else:
@@ -503,12 +535,18 @@ class TcpState:
         self.dup_acks += 1
         if self.in_recovery:
             self.cwnd += mss  # inflate per extra dup-ack
+            if self._holes_remain():
+                # SACK: each returning dup-ack clocks out the next hole
+                # instead of waiting for a partial ack per hole (the
+                # go-back-N stall the scoreboard exists to avoid)
+                self.rexmit_pending = True
         elif self.dup_acks == 3:
             # fast retransmit (tcp_cong_reno.c)
             self.ssthresh = max(self._outstanding() // 2, 2 * mss)
             self.recover = self.snd_max
             self.in_recovery = True
             self.cwnd = self.ssthresh + 3 * mss
+            self._rexmit_cursor = self.snd_una
             self.rexmit_pending = True
 
     def _maybe_transition_on_ack(self, now: int, ack: int) -> None:
@@ -546,6 +584,7 @@ class TcpState:
                 self._drain_ooo()
         elif room > 0 and len(self._ooo) < 256:
             self._ooo.setdefault(seq, payload)
+            self._last_ooo = seq
         self.ack_pending = True
 
     def _drain_ooo(self) -> None:
@@ -633,6 +672,9 @@ class TcpState:
     def _header(
         self, flags: TcpFlags, seq: int, wscale: Optional[int] = None
     ) -> TcpHeader:
+        sack = ()
+        if self.sack_enabled and self._ooo and not flags & TcpFlags.SYN:
+            sack = self._sack_blocks()
         return TcpHeader(
             src_ip=self.local_ip,
             src_port=self.local_port,
@@ -643,7 +685,28 @@ class TcpState:
             flags=flags,
             window=self._advertised_window(),
             wscale=wscale,
+            sack=sack,
         )
+
+    def _sack_blocks(self) -> tuple:
+        """RFC 2018 blocks from the reassembly stash: merged above-window
+        ranges, the block containing the most recent arrival first, the
+        rest ascending, at most 3 (the option-space limit)."""
+        merged = _merge_ranges([
+            [seq_sub(q, self.rcv_nxt), seq_sub(q, self.rcv_nxt) + len(p)]
+            for q, p in self._ooo.items()
+        ])
+        blocks = [
+            (seq_add(self.rcv_nxt, a), seq_add(self.rcv_nxt, b))
+            for a, b in merged
+        ]
+        if self._last_ooo is not None:
+            lr = seq_sub(self._last_ooo, self.rcv_nxt)
+            for i, (a, b) in enumerate(merged):
+                if a <= lr < b and i != 0:
+                    blocks.insert(0, blocks.pop(i))
+                    break
+        return tuple(blocks[:3])
 
     def _emit_syn(self, now: int) -> tuple[TcpHeader, bytes]:
         self.syn_pending = False
@@ -654,6 +717,7 @@ class TcpState:
         else:  # SYN_RECEIVED: SYN-ACK
             flags = TcpFlags.SYN | TcpFlags.ACK
         hdr = self._header(flags, self.iss, wscale=wscale)
+        hdr = dataclasses.replace(hdr, sack_ok=self.cfg.sack)
         if self.snd_nxt == self.iss:
             self.snd_nxt = seq_add(self.iss, 1)
         self.snd_max = seq_max(self.snd_max, self.snd_nxt)
@@ -734,6 +798,55 @@ class TcpState:
         self._arm_rto(now)
         return (self._header(TcpFlags.FIN | TcpFlags.ACK, self.fin_seq), b"")
 
+    def _sack_merge(self, blocks) -> None:
+        """Fold reported blocks into the scoreboard (merged, above
+        snd_una, relative ordering via wrapping distance from snd_una)."""
+        base = self.snd_una
+        rel = []
+        for a, b in list(self._sacked) + [list(x) for x in blocks]:
+            ra, rb = seq_sub(a, base), seq_sub(b, base)
+            if rb == 0 or rb > 0x7FFFFFFF:
+                continue  # entirely below the cumulative ack (or garbage)
+            if ra > 0x7FFFFFFF:
+                ra = 0  # straddles the ack point: clip to it
+            if ra < rb:
+                rel.append([ra, rb])
+        merged = _merge_ranges(rel)
+        self._sacked = [
+            (seq_add(base, a), seq_add(base, b)) for a, b in merged
+        ]
+
+    def _sack_trim(self) -> None:
+        self._sack_merge(())  # re-normalizing against the new snd_una
+
+    def _next_hole(self, cursor: int) -> tuple[int, int]:
+        """(hole_start, hole_limit) of the first un-SACKed range at/after
+        ``cursor`` (skipping scoreboard ranges); limit caps the hole's
+        length at the next SACKed range.  Falls back to (cursor, huge)
+        when the scoreboard is empty — plain NewReno head retransmit."""
+        base = self.snd_una
+        pos = seq_sub(cursor, base)
+        if pos > 0x7FFFFFFF:
+            pos = 0
+        for a, b in ((seq_sub(x, base), seq_sub(y, base))
+                     for x, y in self._sacked):
+            if pos < a:
+                return (seq_add(base, pos), a - pos)
+            if pos < b:
+                pos = b
+        return (seq_add(base, pos), 1 << 30)
+
+    def _holes_remain(self) -> bool:
+        """Un-SACKed, un-retransmitted sequence space below snd_max?
+        Only meaningful WITH a scoreboard: on a non-SACK connection the
+        empty-scoreboard fallback would claim a hole at the cursor and
+        every dup-ack would blind-resend the next in-flight segment —
+        data the receiver provably already holds."""
+        if not self.in_recovery or not self._sacked:
+            return False
+        hole, _ = self._next_hole(seq_max(self._rexmit_cursor, self.snd_una))
+        return seq_lt(hole, self.snd_max)
+
     def _emit_retransmit(self, now: int) -> tuple[TcpHeader, bytes]:
         """Head-of-line retransmission (fast retransmit / RTO / partial ack)."""
         self.rexmit_pending = False
@@ -751,20 +864,45 @@ class TcpState:
             self.snd_nxt = seq_max(self.snd_nxt, seq_add(self.iss, 1))
             self.snd_max = seq_max(self.snd_max, self.snd_nxt)
             self._arm_rto(now)
-            return (self._header(flags, self.iss, wscale=wscale), b"")
+            hdr = dataclasses.replace(
+                self._header(flags, self.iss, wscale=wscale),
+                sack_ok=self.cfg.sack,
+            )
+            return (hdr, b"")
         # FIN retransmit
         if self.fin_seq is not None and self.snd_una == self.fin_seq:
             self.snd_nxt = seq_max(self.snd_nxt, seq_add(self.fin_seq, 1))
             self.snd_max = seq_max(self.snd_max, self.snd_nxt)
             self._arm_rto(now)
             return (self._header(TcpFlags.FIN | TcpFlags.ACK, self.fin_seq), b"")
-        # data retransmit from snd_una
-        n = min(len(self._snd_buf), self.cfg.mss)
-        payload = bytes(self._snd_buf[:n])
-        self.snd_nxt = seq_max(self.snd_nxt, seq_add(self.snd_una, n))
+        # data retransmit: the lowest un-SACKed hole (RFC 6675 NextSeg;
+        # with an empty scoreboard this is the NewReno head at snd_una)
+        cur = self.snd_una
+        if self.in_recovery:
+            cur = seq_max(self._rexmit_cursor, self.snd_una)
+        hole, limit = self._next_hole(cur)
+        if seq_ge(hole, self.snd_max):
+            hole, limit = self._next_hole(self.snd_una)
+        if self.fin_seq is not None and hole == self.fin_seq:
+            # every data hole is SACKed/acked; the lost segment is the FIN
+            self.snd_nxt = seq_max(self.snd_nxt, seq_add(self.fin_seq, 1))
+            self.snd_max = seq_max(self.snd_max, self.snd_nxt)
+            self._rexmit_cursor = seq_add(self.fin_seq, 1)
+            self._arm_rto(now)
+            return (self._header(TcpFlags.FIN | TcpFlags.ACK, self.fin_seq), b"")
+        off = seq_sub(hole, self.snd_una)
+        n = min(self.cfg.mss, limit, len(self._snd_buf) - off)
+        if n <= 0:
+            # stale cursor (e.g. everything above was just SACKed): head
+            hole = self.snd_una
+            off = 0
+            n = min(self.cfg.mss, len(self._snd_buf))
+        payload = bytes(self._snd_buf[off : off + n])
+        self._rexmit_cursor = seq_add(hole, n)
+        self.snd_nxt = seq_max(self.snd_nxt, seq_add(hole, n))
         self.snd_max = seq_max(self.snd_max, self.snd_nxt)
         self._arm_rto(now)
-        return (self._header(TcpFlags.ACK, self.snd_una), payload)
+        return (self._header(TcpFlags.ACK, hole), payload)
 
     # -------------------------------------------------------------- timers
 
@@ -811,6 +949,10 @@ class TcpState:
         self.in_recovery = False
         self.dup_acks = 0
         # go-back-N: rewind transmission to the cumulative-ack point
+        # (conservative RFC 2018 stance: drop the scoreboard so the
+        # re-walk is a plain linear resend)
+        self._sacked = []
+        self._rexmit_cursor = self.snd_una
         self.snd_nxt = self.snd_una
         if self.fin_seq is not None and seq_lt(self.snd_una, self.fin_seq):
             # data ahead of the FIN rewound too: re-queue the FIN to be
@@ -954,6 +1096,7 @@ class TcpListener:
             child.snd_wscale = 0
         child.irs = hdr.seq
         child.rcv_nxt = seq_add(hdr.seq, 1)
+        child.sack_enabled = child.cfg.sack and hdr.sack_ok
         child.snd_wnd = hdr.window  # unscaled until SYN negotiation done
         child.snd_wl1 = hdr.seq
         child.snd_wl2 = child.iss

@@ -135,3 +135,130 @@ def test_iproute2_netlink_deterministic(tmp_path):
     _, out1 = _run_ip(tmp_path, "r1")
     _, out2 = _run_ip(tmp_path, "r2")
     assert out1 == out2
+
+
+WGET = shutil.which("wget")
+GIT = shutil.which("git")
+
+
+def _run_multihop(tmp_path: Path, tag: str):
+    """BASELINE config #5's stand-in (tor isn't installable here): a
+    3-hop chain topology with CONCURRENT flows from three distinct real
+    client binaries — curl, wget, and a full `git clone` over HTTP (git
+    spawns git-remote-http, itself a libcurl app) — against CPython
+    http.server daemons at the far end."""
+    import os
+
+    base = tmp_path / tag
+    docroot = base / "www"
+    docroot.mkdir(parents=True)
+    (docroot / "a.txt").write_text("multihop says hello\n")
+    os.utime(docroot / "a.txt", (946684800, 946684800))
+    # a real git repo served over the dumb-http protocol
+    src = base / "src"
+    src.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=src, check=True)
+    (src / "f.txt").write_text("simulated clone payload\n")
+    subprocess.run(["git", "add", "f.txt"], cwd=src, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=a@b", "-c", "user.name=t",
+         "commit", "-qm", "init"],
+        cwd=src, check=True,
+        env={**os.environ,
+             "GIT_AUTHOR_DATE": "2000-01-01T00:00:00Z",
+             "GIT_COMMITTER_DATE": "2000-01-01T00:00:00Z"},
+    )
+    gitroot = base / "gitroot"
+    gitroot.mkdir()
+    subprocess.run(
+        ["git", "clone", "-q", "--bare", str(src), str(gitroot / "repo.git")],
+        check=True,
+    )
+    subprocess.run(
+        ["git", "update-server-info"], cwd=gitroot / "repo.git", check=True
+    )
+    clone_dst = base / "cloned"
+    data = base / "data"
+    cfg = ConfigOptions.from_yaml(
+        f"""
+general: {{stop_time: 60s, seed: 17, data_directory: {data}, heartbeat_interval: null}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 2 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 3 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+        edge [ source 3 target 3 latency "1 ms" ]
+        edge [ source 0 target 1 latency "5 ms" ]
+        edge [ source 1 target 2 latency "8 ms" ]
+        edge [ source 2 target 3 latency "12 ms" ]
+      ]
+hosts:
+  www:
+    network_node_id: 0
+    processes:
+      - path: {PY}
+        args: [-m, http.server, "8080", --bind, 0.0.0.0, --directory, {docroot}]
+        expected_final_state: running
+  gitsrv:
+    network_node_id: 0
+    processes:
+      - path: {PY}
+        args: [-m, http.server, "8081", --bind, 0.0.0.0, --directory, {gitroot}]
+        expected_final_state: running
+  curlc:
+    network_node_id: 3
+    processes:
+      - path: {CURL}
+        args: [-s, -i, --max-time, "30", http://www:8080/a.txt]
+        start_time: 2s
+  wgetc:
+    network_node_id: 3
+    processes:
+      - path: {WGET}
+        args: [-q, -O, "-", -T, "30", http://www:8080/a.txt]
+        start_time: 2s
+  gitc:
+    network_node_id: 3
+    processes:
+      - path: {GIT}
+        args: [clone, -q, "http://gitsrv:8081/repo.git", {clone_dst / tag}]
+        start_time: 3s
+"""
+    )
+    result = Simulation(cfg).run()
+    return result, data, clone_dst / tag
+
+
+@pytest.mark.skipif(
+    CURL is None or WGET is None or GIT is None,
+    reason="curl/wget/git not all installed",
+)
+def test_multihop_concurrent_real_clients(tmp_path):
+    result, data, cloned = _run_multihop(tmp_path, "a")
+    curl_out = (data / "hosts" / "curlc" / "curl.stdout").read_text()
+    wget_out = (data / "hosts" / "wgetc" / "wget.stdout").read_text()
+    assert "HTTP/1.0 200 OK" in curl_out
+    assert "multihop says hello" in curl_out
+    assert wget_out == "multihop says hello\n"
+    # the git clone really happened THROUGH the simulated 3-hop network
+    assert (cloned / "f.txt").read_text() == "simulated clone payload\n"
+    assert not result.process_errors
+
+
+@pytest.mark.skipif(
+    CURL is None or WGET is None or GIT is None,
+    reason="curl/wget/git not all installed",
+)
+def test_multihop_deterministic(tmp_path):
+    _, d1, _ = _run_multihop(tmp_path, "r1")
+    _, d2, _ = _run_multihop(tmp_path, "r2")
+    for host, f in (("curlc", "curl.stdout"), ("wgetc", "wget.stdout")):
+        a = (d1 / "hosts" / host / f).read_text()
+        b = (d2 / "hosts" / host / f).read_text()
+        assert a == b, f"{host}/{f} differs between runs"
