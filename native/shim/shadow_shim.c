@@ -1578,6 +1578,37 @@ static ssize_t vfd_sendto(int fd, const void *buf, size_t n, int flags,
         return (ssize_t)ret_errno(shim_call(SHIM_OP_SENDTO, args, buf,
                                             (uint32_t)n, NULL, NULL, NULL));
     }
+    /* stream, large buffer: pass (addr, len) and let the manager copy
+     * straight out of our memory with process_vm_readv (the reference's
+     * MemoryCopier) — one exchange instead of len/64Ki round-trips.  The
+     * manager answers -EOPNOTSUPP when the kernel forbids cross-process
+     * reads (ptrace scope); fall back to chunking then. */
+    static int g_vmcopy_off;
+    if (!g_vmcopy_off && n > SHIM_PAYLOAD_MAX) {
+        const size_t VMCHUNK = 8u << 20; /* bound the manager's staging copy */
+        size_t done = 0;
+        while (done < n) {
+            size_t chunk = n - done;
+            if (chunk > VMCHUNK) chunk = VMCHUNK;
+            int64_t args[6] = {fd, (int64_t)ip, port, nb,
+                               (int64_t)(uintptr_t)buf + (int64_t)done,
+                               (int64_t)chunk};
+            int64_t ret = shim_call(SHIM_OP_SENDTO, args, NULL, 0, NULL,
+                                    NULL, NULL);
+            if (ret == -EOPNOTSUPP && done == 0) {
+                g_vmcopy_off = 1;
+                break; /* fall back to frame chunking below */
+            }
+            if (ret < 0) {
+                if (done > 0) return (ssize_t)done;
+                errno = (int)-ret;
+                return -1;
+            }
+            done += (size_t)ret;
+            if (nb && (size_t)ret < chunk) break; /* buffer full */
+        }
+        if (!g_vmcopy_off) return (ssize_t)done;
+    }
     /* stream: the channel carries 64 KiB per hop; loop so a blocking
      * write(fd, buf, len) queues all len bytes like real Linux */
     size_t off = 0;

@@ -24,6 +24,7 @@ per-worker log/min-latency buffers merge at the barrier in worker order
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -65,12 +66,20 @@ class HostScheduler:
             for host in self.hosts:  # id order; serial == deterministic
                 host.execute(until)
             return
+        # fresh per-worker deques each round; workers drain their own and
+        # then STEAL from their neighbors' tails (thread_per_core.rs:17-50:
+        # per-thread ArrayQueues with cross-thread stealing) — a worker
+        # whose hosts finish early picks up a stalled partition's backlog
+        # (e.g. one host driving a slow managed process)
+        queues = [collections.deque(p) for p in self.partitions]
         futures = [
-            self._pool.submit(_execute_partition, part, until)
-            for part in self.partitions
+            self._pool.submit(_run_stealing, queues, w, until)
+            for w in range(self.workers)
         ]
         for f in futures:  # barrier; re-raise worker exceptions
-            f.result()
+            self.steals += f.result()
+
+    steals = 0  # cumulative cross-worker steals (perf observability)
 
     def shutdown(self) -> None:
         if self._pool is not None:
@@ -78,8 +87,28 @@ class HostScheduler:
             self._pool = None
 
 
-def _execute_partition(hosts, until: int) -> None:
-    for host in hosts:
+def _run_stealing(queues, w: int, until: int) -> int:
+    """Drain own queue head-first; steal from other queues' TAILS when
+    empty (deque.popleft/pop are GIL-atomic, so no extra locking).  Hosts
+    only touch their own state within a round, so which worker runs a
+    host is unobservable — determinism is parallelism-invariant."""
+    my = queues[w]
+    n = len(queues)
+    steals = 0
+    while True:
+        try:
+            host = my.popleft()
+        except IndexError:
+            host = None
+            for i in range(1, n):
+                try:
+                    host = queues[(w + i) % n].pop()
+                    steals += 1
+                    break
+                except IndexError:
+                    continue
+            if host is None:
+                return steals
         host.execute(until)
 
 

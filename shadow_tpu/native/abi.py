@@ -253,3 +253,35 @@ class ShmChannel:
 
 class PluginDied(RuntimeError):
     pass
+
+
+# -- cross-process memory copy (the reference's MemoryCopier,
+# memory_manager/memory_copier.rs: process_vm_readv/writev) -------------------
+
+_SYS_process_vm_readv = 310
+_SYS_process_vm_writev = 311
+
+
+class _IOVec(ctypes.Structure):
+    _fields_ = [("iov_base", ctypes.c_void_p), ("iov_len", ctypes.c_size_t)]
+
+
+def vm_read(pid: int, addr: int, n: int) -> bytes:
+    """Read ``n`` bytes of another process's memory in ONE kernel call —
+    large managed-process buffers (a 1 MiB write()) move without riding
+    the 64 KiB shared-memory frame one chunk per exchange."""
+    buf = ctypes.create_string_buffer(n)
+    local = _IOVec(ctypes.cast(buf, ctypes.c_void_p), n)
+    remote = _IOVec(ctypes.c_void_p(addr), n)
+    # every scalar explicitly 64-bit: ctypes passes bare Python ints as
+    # 32-bit varargs, leaving garbage in the upper register halves the
+    # kernel reads as iovcnt/flags (intermittent EINVAL)
+    r = _libc.syscall(
+        ctypes.c_long(_SYS_process_vm_readv), ctypes.c_long(pid),
+        ctypes.byref(local), ctypes.c_ulong(1),
+        ctypes.byref(remote), ctypes.c_ulong(1), ctypes.c_ulong(0),
+    )
+    if r < 0:
+        raise OSError(ctypes.get_errno(), "process_vm_readv failed")
+    return buf.raw[:r]
+

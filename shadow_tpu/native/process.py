@@ -63,7 +63,8 @@ PREEMPT_QUANTUM_NS = 10_000_000  # 10 ms
 from errno import (  # noqa: E402
     EADDRINUSE, EAGAIN, EALREADY, EBADF, EBUSY, ECHILD, ECONNREFUSED,
     ECONNRESET, EDEADLK, EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINTR,
-    EINVAL, EISCONN, ENOSYS, ENOTCONN, ENOTSOCK, EPERM, EPIPE, ESRCH,
+    EINVAL, EISCONN, ENOSYS, ENOTCONN, ENOTSOCK, EOPNOTSUPP, EPERM,
+    EPIPE, ESRCH,
     ETIMEDOUT,
 )
 
@@ -1600,7 +1601,34 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "sendto", -EBADF)
             return True
-        data = self.chan.req_payload()
+        if req.args[4]:
+            # direct-memory mode (MemoryCopier, memory_copier.rs): the
+            # shim passed (addr, len) instead of riding the 64 KiB frame.
+            # Clamp the staging copy: the send buffer can't queue more
+            # than ~its capacity anyway, and the shim's outer loop
+            # re-issues for the rest — an 8 MiB nonblocking write must
+            # not copy 8 MiB per EAGAIN retry
+            try:
+                data = abi.vm_read(
+                    self._cur.pid, int(req.args[4]),
+                    min(int(req.args[5]), 256 * 1024),
+                )
+                api.count("managed_vmcopy_bytes", len(data))
+            except OSError as e:
+                import errno as _errno
+
+                if e.errno in (_errno.EPERM, _errno.ENOSYS):
+                    # kernel forbids cross-process reads (ptrace scope):
+                    # tell the shim to fall back to frame chunking
+                    self._reply(api, "sendto", -EOPNOTSUPP)
+                else:
+                    # a real fault in the APP's buffer (EFAULT etc.):
+                    # surface it like the kernel would — retrying via the
+                    # frame would memcpy the same bad pointer and SIGSEGV
+                    self._reply(api, "sendto", -(e.errno or EINVAL))
+                return True
+        else:
+            data = self.chan.req_payload()
         if sock.kind == "event":
             return self._event_write(api, sock, data, bool(req.args[3]), vfd)
         if sock.kind == "timer":

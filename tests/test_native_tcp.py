@@ -181,6 +181,30 @@ def test_resolver_client(tmp_path):
     assert f"rclient cli0 resolved srv={_srv_ip(1)} echoed=128" in out
 
 
+def _vm_read_allowed() -> bool:
+    import subprocess as _sp
+    import time as _t
+
+    from shadow_tpu.native import abi as _abi
+
+    p = _sp.Popen(["sleep", "1"])
+    try:
+        _t.sleep(0.05)
+        for line in open(f"/proc/{p.pid}/maps"):
+            if "r" in line.split()[1]:
+                addr = int(line.split("-")[0], 16)
+                break
+        else:
+            return False
+        try:
+            _abi.vm_read(p.pid, addr, 8)
+            return True
+        except OSError:
+            return False
+    finally:
+        p.kill()
+
+
 def test_big_write_waitall_fionread_sleep(tmp_path):
     # one blocking write() larger than the 64 KiB channel payload must
     # report the full count; MSG_WAITALL must assemble the whole echo;
@@ -197,6 +221,11 @@ def test_big_write_waitall_fionread_sleep(tmp_path):
     out = _read(tmp_path, "cli0")
     assert "bigclient done bytes=150000" in out
     assert "slept_ms=" in out
+    # the >64KiB write moved via process_vm_readv (the MemoryCopier path),
+    # not 64KiB frame chunks — unless this kernel forbids cross-process
+    # reads, in which case the frame fallback carried it (also correct)
+    if _vm_read_allowed():
+        assert result.counters.get("managed_vmcopy_bytes", 0) >= 150_000
     slept = int(out.split("slept_ms=")[1].split()[0])
     assert slept >= 50  # the sleep advanced simulated time
     assert "avail_gt0=1" in out

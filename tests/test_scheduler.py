@@ -105,3 +105,33 @@ hosts:
     report = compare_results(serial, par)
     assert report.identical, report.describe()
     assert serial.counters["managed_procs"] == 5
+
+
+def test_work_stealing_drains_unbalanced_partitions():
+    """A worker with an empty partition steals the busy worker's backlog
+    (thread_per_core.rs:17-50): every host executes exactly once per
+    round, and cross-worker steals actually happen."""
+    import threading
+    import time
+
+    class SlowHost:
+        def __init__(self, hid, log, delay=0.0):
+            self.hid = hid
+            self.log = log
+            self.delay = delay
+
+        def execute(self, until):
+            if self.delay:
+                time.sleep(self.delay)
+            self.log.append((self.hid, until))
+
+    log: list = []
+    # 8 hosts, 4 workers: round-robin puts {0,4} on w0 — make host 0 slow
+    # so w0 stalls while w1..w3 finish and steal
+    hosts = [SlowHost(i, log, delay=0.25 if i == 0 else 0.0) for i in range(8)]
+    sched = HostScheduler(hosts, parallelism=4, pin_cpus=False)
+    sched.run_round(123)
+    sched.shutdown()
+    assert sorted(h for h, _ in log) == list(range(8))  # each exactly once
+    assert all(u == 123 for _, u in log)
+    assert sched.steals >= 1  # host 4 (w0's second) was stolen

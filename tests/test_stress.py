@@ -78,6 +78,23 @@ hosts:
     )
 
 
+def test_stress_unix_sockets(tmp_path):
+    """Unix-domain IPC ordering (socket/unix.rs analog): the bytes ride a
+    native socketpair, but blocking order is engine-scheduled (sim-yield
+    polls under strict turn-taking) — REPEATS runs must be identical."""
+    _repeat_identical(
+        f"""
+general: {{stop_time: 10s, seed: 8, data_directory: {tmp_path / 'd'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'unixchat'}
+"""
+    )
+
+
 def test_stress_signals(tmp_path):
     _repeat_identical(
         f"""
