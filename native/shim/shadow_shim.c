@@ -1585,7 +1585,10 @@ static ssize_t vfd_sendto(int fd, const void *buf, size_t n, int flags,
      * reads (ptrace scope); fall back to chunking then. */
     static int g_vmcopy_off;
     if (!g_vmcopy_off && n > SHIM_PAYLOAD_MAX) {
-        const size_t VMCHUNK = 8u << 20; /* bound the manager's staging copy */
+        /* matches the manager's staging clamp exactly: a reply shorter
+         * than the request must mean buffer-full (nonblocking partial),
+         * never a silent manager-side truncation */
+        const size_t VMCHUNK = 256u << 10;
         size_t done = 0;
         while (done < n) {
             size_t chunk = n - done;

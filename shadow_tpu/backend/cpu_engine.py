@@ -349,6 +349,7 @@ class CpuEngine:
             src_host.pcap.capture(
                 stime.sim_to_emu(t_dep), self.ips.by_host[s],
                 self.ips.by_host[d], size_bytes, payload,
+                key=(1, s, d, seq),
             )
 
         # loss (skipped during bootstrap)
@@ -393,6 +394,7 @@ class CpuEngine:
             dst_host.pcap.capture(
                 stime.sim_to_emu(t_deliver), self.ips.by_host[ev.src_host],
                 self.ips.by_host[dst_host.host_id], size_bytes, payload,
+                key=(0, ev.src_host, dst_host.host_id, ev.seq),
             )
         if payload is None and dst_host.passive_delivery:
             # passive fast path: counters apply now; no DELIVERY event.

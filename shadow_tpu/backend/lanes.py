@@ -62,6 +62,10 @@ from . import lanes_stream as lstr
 PACKET, LOCAL, DELIVERY = 0, 1, 2
 # outcomes (must match backend.cpu_engine)
 DELIVERED, DROP_LOSS, DROP_CODEL, DROP_QUEUE = 0, 1, 2, 3
+# device-log record class that is NOT an event outcome: an outbound pcap
+# capture at bucket-departure time (cpu_engine captures the same instant);
+# collect() splits these into per-host capture files
+PCAP_TX = 4
 
 NEVER = stime.NEVER
 
@@ -256,6 +260,9 @@ class LaneParams:
     # RTO_MIN so stream DELIVERY pops cannot insert same-window events
     stream_clients: tuple = ()
     stream_wide_pop: bool = False
+    # any lane captures pcap (static): sends emit PCAP_TX records into the
+    # device log at departure time
+    pcap_any: bool = False
     # window-advance+pop steps per fused while-loop trip (amortizes the
     # ~350 us per-iteration host round-trip of the tunneled runtime).
     # Multiplies XLA compile time with the body size — worth it for small
@@ -306,6 +313,7 @@ class LaneTables(NamedTuple):
     st_last: jnp.ndarray  # [N] int32 final-segment payload bytes
     st_cl_of: jnp.ndarray  # [N] int32: server lane -> its client lane
                            # (one-to-one mode; own lane elsewhere)
+    lane_pcap: jnp.ndarray  # [N] bool: host captures pcap
 
 
 # --------------------------------------------------------------------------
@@ -566,6 +574,12 @@ class _SlotEmit(NamedTuple):
     brec_time: Any
     brec_seq: Any
     brec_size: Any
+    # outbound pcap channel (int64; () unless pcap_any)
+    pc_valid: Any
+    pc_time: Any
+    pc_dst: Any
+    pc_seq: Any
+    pc_size: Any
     # log record channel (int64; zeros when logging is off)
     rec_valid: jnp.ndarray
     rec_time: jnp.ndarray
@@ -847,6 +861,16 @@ def _process_slot(
     out_auxh = pack_aux_hi(jnp.full(n, PACKET, dtype=i32), lanes)
     out_auxl = snd_seq
 
+    # outbound pcap capture at DEPARTURE (pre-loss, like the CPU path)
+    if p.pcap_any:
+        pc_valid = do_send & tb.lane_pcap
+        pc_time = t_join(dep_hi, dep_lo)
+        pc_dst = dst.astype(i64)
+        pc_seq = snd_seq.astype(i64)
+        pc_size = out_size.astype(i64)
+    else:
+        pc_valid = pc_time = pc_dst = pc_seq = pc_size = ()
+
     # ---- stream burst channel (the epilogue's data segments) -------------
     # Each burst unit charges the up bucket and draws loss IN ORDER after
     # the slot-0 send, exactly like the CPU driver's per-api.send sequence;
@@ -986,6 +1010,7 @@ def _process_slot(
         out_phi, out_plo,
         bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
         brec_valid, brec_time, brec_seq, brec_size,
+        pc_valid, pc_time, pc_dst, pc_seq, pc_size,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
     )
     return s, emit
@@ -1373,6 +1398,10 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                         br_b = br_i = ()
                 else:
                     bo_b = bo_i = br_b = br_i = ()
+                if p.pcap_any:
+                    pc = (nb, z64, z64, z64, z64)
+                else:
+                    pc = ((), (), (), (), ())
                 return st_, _SlotEmit(
                     nb, z32, z32, z32, z32, z32, z32, z32,
                     nb, z32, z32, z32, z32, z32, z32,
@@ -1380,6 +1409,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                     nb, z32, z32, z32, z32, z32, z32, z32, z32,
                     bo_b, bo_i, bo_i, bo_i, bo_i, bo_i, bo_i,
                     br_b, br_i, br_i, br_i,
+                    *pc,
                     nb, z64, z64, z64, z64, z64, z64,
                 )
 
@@ -1431,6 +1461,22 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
             "outcome": emits.rec_outcome.reshape(-1),
         }
         s = _append_log(p, s, per_slot)
+        if p.pcap_any and p.log_capacity:
+            kk = emits.pc_valid.shape[0]
+            lanes64 = jnp.broadcast_to(
+                jnp.arange(p.n_lanes, dtype=jnp.int64)[None, :],
+                (kk, p.n_lanes),
+            )
+            s = _append_log(p, s, {
+                "valid": emits.pc_valid.reshape(-1),
+                "time": emits.pc_time.reshape(-1),
+                "src": lanes64.reshape(-1),
+                "dst": emits.pc_dst.reshape(-1),
+                "seq": emits.pc_seq.reshape(-1),
+                "size": emits.pc_size.reshape(-1),
+                "outcome": jnp.full((kk * p.n_lanes,), PCAP_TX,
+                                    dtype=jnp.int64),
+            })
         if p.stream_present and p.log_capacity:
             # burst-channel loss records (DROP_LOSS at the send instant)
             kk, bb, _nn = emits.brec_valid.shape

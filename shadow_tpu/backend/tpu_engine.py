@@ -89,12 +89,9 @@ class TpuEngine:
                     f"host {hopt.hostname!r} has {len(hopt.processes)} processes; "
                     "the lane backend supports at most one per host"
                 )
-            if hopt.pcap_enabled:
-                raise LaneCompatError(
-                    f"host {hopt.hostname!r} enables pcap capture; packet "
-                    "bytes live on device in the lane backend — use the cpu "
-                    "backend for pcap"
-                )
+            # pcap: sends emit PCAP_TX records into the device log, and
+            # collect() reconstructs per-host capture files byte-identical
+            # to the CPU backend's (synthetic payloads either way)
             if not hopt.processes:
                 model[hid] = lanes.M_NONE
                 continue
@@ -202,6 +199,23 @@ class TpuEngine:
         max_window = max(runahead, int(np.max(np.asarray(lat), initial=0)))
         stream_wide_pop = max_window < ltcp_mod.RTO_MIN
 
+        lane_pcap = np.array([h.pcap_enabled for h in cfg.hosts], dtype=bool)
+        pcap_any = bool(lane_pcap.any())
+        if pcap_any and log_capacity == 0:
+            raise LaneCompatError(
+                "pcap capture on the lane backend rides the device event "
+                "log; log_capacity=0 disables it — use the cpu backend or "
+                "enable logging"
+            )
+        if pcap_any and any(
+            int(m) in (lanes.M_STREAM_CLIENT, lanes.M_STREAM_SERVER)
+            for m in model
+        ):
+            raise LaneCompatError(
+                "pcap with the stream tier is not lane-compiled yet; use "
+                "the cpu backend"
+            )
+
         self.params = lanes.LaneParams(
             n_lanes=n,
             capacity=capacity,
@@ -219,6 +233,7 @@ class TpuEngine:
             stream_one_to_one=one_to_one,
             stream_clients=tuple(int(c) for c in client_ids),
             stream_wide_pop=stream_wide_pop,
+            pcap_any=pcap_any,
         )
 
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)
@@ -305,6 +320,7 @@ class TpuEngine:
             st_mss=jnp.asarray(st_mss),
             st_last=jnp.asarray(st_last),
             st_cl_of=jnp.asarray(cl_of),
+            lane_pcap=jnp.asarray(lane_pcap),
         )
         self._init_events = init_events
         self._local_seq0 = local_seq0
@@ -487,6 +503,52 @@ class TpuEngine:
             wall = wall_time.perf_counter() - t0
         return self.collect(state, wall)
 
+    def _write_pcaps(self, event_rows, pcap_rows) -> None:
+        """Reconstruct per-host capture files from the device log:
+        outbound = PCAP_TX records at bucket-departure time, inbound =
+        DELIVERED records at delivery time — the same two capture points
+        as the CPU backend (cpu_engine.send_packet / deliver), so the
+        files diff byte-identical across backends."""
+        from pathlib import Path as _Path
+
+        from ..core import time as _stime
+        from ..utils.pcap import PcapWriter
+
+        for hid, hopt in enumerate(self.cfg.hosts):
+            if not hopt.pcap_enabled:
+                continue
+            # both backends write records sorted by (time, direction,
+            # src, dst, seq) — PcapWriter buffers and sorts at close, so
+            # the files are byte-identical even when bucket backlog makes
+            # departure stamps non-monotone in processing order
+            out_m = pcap_rows[:, 1] == hid if pcap_rows.size else None
+            in_m = (
+                (event_rows[:, 5] == lanes.DELIVERED)
+                & (event_rows[:, 2] == hid)
+                if event_rows.size else None
+            )
+            recs = []
+            if out_m is not None:
+                for t, src, dst, seq, size, _o in pcap_rows[out_m]:
+                    recs.append((int(t), 1, int(src), int(dst), int(seq),
+                                 int(size)))
+            if in_m is not None:
+                for t, src, dst, seq, size, _o in event_rows[in_m]:
+                    recs.append((int(t), 0, int(src), int(dst), int(seq),
+                                 int(size)))
+            w = PcapWriter(
+                _Path(self.cfg.general.data_directory)
+                / "hosts" / hopt.hostname / "eth0.pcap",
+                snaplen=hopt.pcap_capture_size,
+            )
+            for t, dirn, src, dst, seq, size in recs:
+                w.capture(
+                    _stime.sim_to_emu(t), self.ips.by_host[src],
+                    self.ips.by_host[dst], size, None,
+                    key=(dirn, src, dst, seq),
+                )
+            w.close()
+
     def collect(self, s: lanes.LaneState, wall: float) -> SimResult:
         # int32 counter honesty: every per-lane counter is monotone, so a
         # wrap past 2**31 shows as a negative value — raise instead of
@@ -514,6 +576,10 @@ class TpuEngine:
                 "raise log_capacity or disable logging"
             )
         rows = np.asarray(s.log[: min(log_count, self.params.log_capacity)])
+        if self.params.pcap_any:
+            pcap_rows = rows[rows[:, 5] == lanes.PCAP_TX] if rows.size else rows
+            rows = rows[rows[:, 5] != lanes.PCAP_TX] if rows.size else rows
+            self._write_pcaps(rows, pcap_rows)
         event_log = [
             LogRecord(int(t), int(src), int(dst), int(seq), int(size), int(out))
             for t, src, dst, seq, size, out in rows

@@ -1615,9 +1615,7 @@ class ManagedApp:
                 )
                 api.count("managed_vmcopy_bytes", len(data))
             except OSError as e:
-                import errno as _errno
-
-                if e.errno in (_errno.EPERM, _errno.ENOSYS):
+                if e.errno in (EPERM, ENOSYS):
                     # kernel forbids cross-process reads (ptrace scope):
                     # tell the shim to fall back to frame chunking
                     self._reply(api, "sendto", -EOPNOTSUPP)

@@ -57,9 +57,20 @@ class PcapWriter:
             )
         )
         self.records = 0
+        # records buffer until close() and are written SORTED by
+        # (timestamp, key): a capture stamped with a future bucket
+        # departure would otherwise land before an earlier-stamped inbound
+        # written later, making the file order depend on internal
+        # processing order — sorting gives both backends one well-defined
+        # byte-identical layout
+        self._buf: list = []
 
     def close(self) -> None:
         if self._f is not None:
+            self._buf.sort(key=lambda r: (r[0], r[1]))
+            for emu_ns, _key, body, orig in self._buf:
+                self._record(emu_ns, body, orig)
+            self._buf = []
             self._f.close()
             self._f = None
 
@@ -75,18 +86,22 @@ class PcapWriter:
             )
         )
         self._f.write(packet[:incl])
-        self.records += 1
 
     # -- packet synthesis ---------------------------------------------------
 
     def capture(
-        self, emu_ns: int, src_ip: str, dst_ip: str, size_bytes: int, payload
+        self, emu_ns: int, src_ip: str, dst_ip: str, size_bytes: int, payload,
+        key: tuple = (),
     ) -> None:
-        """Write one simulated packet.  ``payload`` is the engine's opaque
-        delivery cargo: a UDP tuple, a TcpSegment, or None (model traffic).
-        ``size_bytes`` is the wire size the simulation charged."""
+        """Record one simulated packet (written at close, sorted by
+        ``(emu_ns, key)``; pass ``key=(direction, src_id, dst_id, seq)``
+        for a total deterministic order).  ``payload`` is the engine's
+        opaque delivery cargo: a UDP tuple, a TcpSegment, or None (model
+        traffic).  ``size_bytes`` is the wire size the simulation
+        charged."""
         body = self._synthesize(src_ip, dst_ip, size_bytes, payload)
-        self._record(emu_ns, body, size_bytes)
+        self._buf.append((emu_ns, key, body, size_bytes))
+        self.records += 1
 
     def _synthesize(self, src_ip, dst_ip, size_bytes, payload) -> bytes:
         from ..net.stack import TcpSegment

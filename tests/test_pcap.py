@@ -119,7 +119,8 @@ hosts:
     assert any(orig == 5000 for _s, _u, _incl, orig, _p in recs)
 
 
-def test_pcap_rejected_on_lane_backend(tmp_path):
+def test_pcap_rejected_without_device_log(tmp_path):
+    # lane pcap rides the device event log: log_capacity=0 cannot carry it
     from shadow_tpu.backend.tpu_engine import LaneCompatError, TpuEngine
 
     cfg = ConfigOptions.from_yaml(
@@ -131,4 +132,52 @@ hosts:
 """
     )
     with pytest.raises(LaneCompatError, match="pcap"):
-        TpuEngine(cfg)
+        TpuEngine(cfg, log_capacity=0)
+
+
+def test_lane_backend_pcap_matches_cpu(tmp_path):
+    """Lane-backend pcap readback (round-2 LaneCompatError lifted): the
+    device log's PCAP_TX + DELIVERED records reconstruct per-host capture
+    files byte-identical to the CPU backend's."""
+    from shadow_tpu.backend.tpu_engine import TpuEngine
+
+    def yaml(tag):
+        return f"""
+general: {{stop_time: 300ms, seed: 6, data_directory: {tmp_path / tag}}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 0 latency "4 ms" ]
+      ]
+hosts:
+  capt:
+    network_node_id: 0
+    pcap_enabled: true
+    processes: [{{path: tgen-client, args: [--server, sink, --interval, 9ms, --size, "600"]}}]
+  sink:
+    network_node_id: 0
+    pcap_enabled: true
+    processes: [{{path: tgen-server}}]
+  other:
+    network_node_id: 0
+    processes: [{{path: tgen-mesh, args: [--interval, 11ms, --size, "300"]}}]
+"""
+
+    from shadow_tpu.backend.cpu_engine import CpuEngine
+
+    cpu = CpuEngine(ConfigOptions.from_yaml(yaml("cpu")))
+    cpu.run()
+    tpu = TpuEngine(ConfigOptions.from_yaml(yaml("tpu")))
+    tpu.run(mode="device")
+    for host in ("capt", "sink"):
+        a = (tmp_path / "cpu" / "hosts" / host / "eth0.pcap").read_bytes()
+        b = (tmp_path / "tpu" / "hosts" / host / "eth0.pcap").read_bytes()
+        assert len(a) > 100
+        assert a == b, f"{host} pcap differs between backends"
+    assert not (tmp_path / "tpu" / "hosts" / "other").exists() or not (
+        tmp_path / "tpu" / "hosts" / "other" / "eth0.pcap"
+    ).exists()
