@@ -13,18 +13,17 @@ keys record:
   of the north-star ratio — the same workload timed on the CPU
   thread-per-host path (shorter sim; the rate is steady-state);
 - ``mixed_sim_s_per_wall_s`` (+ flow counters): the MIXED TCP/UDP mesh
-  of the north-star config — the UDP mesh with lane-TCP stream flows
-  (handshake, NewReno, RTO — backend/lanes_stream.py on device) crossing
-  it — timed at 1000 lanes.  The stream tier's inlined slot body is
-  ~10x the per-iteration cost of the passive mesh today, and the 10k
-  mixed program currently faults the tunneled device (known issue,
-  docs/tpu-backend.md), so the mixed number is reported alongside
-  rather than as the headline.
+  of north-star config #4 at FULL scale — the UDP mesh with lane-TCP
+  stream flows (handshake, NewReno, burst transmission, RTO —
+  backend/lanes_stream.py on device, int32 pairs) crossing it.  The
+  round-2 device fault is fixed and all flows complete; the rate is
+  below the headline because stream workloads need several while-loop
+  iterations per window (see docs/tpu-backend.md's cost model).
 
 Env knobs (for local runs; the driver uses the defaults):
   SHADOW_TPU_BENCH_HOSTS         lanes in the mesh    (default 10000)
   SHADOW_TPU_BENCH_SIM_SECONDS   simulated duration   (default 30)
-  SHADOW_TPU_BENCH_MIXED_HOSTS   mixed-mesh lanes     (default 1000; 0 skips)
+  SHADOW_TPU_BENCH_MIXED_HOSTS   mixed-mesh lanes     (default 10000; 0 skips)
   SHADOW_TPU_BENCH_CPU_SIM_SECONDS  cpu-side duration (default 1; 0 skips)
 """
 
@@ -45,6 +44,14 @@ MIXED_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_MIXED_HOSTS", "10000"))
 CPU_SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_CPU_SIM_SECONDS", "1"))
 
 
+# the tunneled runtime caches EXECUTIONS across processes keyed on
+# (program, input buffers): re-running an identical simulation can return
+# the cached result in ~ms and record an absurd rate.  Every timed run
+# passes a unique cache_salt (written into an inert queue slot — zero
+# effect on results, forces a real execution).
+_SALT = ((os.getpid() << 16) ^ int(time.time())) & 0x3FFFFFFF
+
+
 def _pure_cfg(sim_seconds, backend="tpu"):
     return flagship_mesh_config(
         N_HOSTS, sim_seconds=sim_seconds, queue_capacity=16,
@@ -57,11 +64,12 @@ def main() -> None:
     # precompile: the timed run is the steady-state device program;
     # collect() raises on queue/log overflow, so the number can't silently
     # come from a diverged simulation.  The chip is shared/remote, so take
-    # the best of a few runs (the reference's published numbers are
-    # likewise best-case single measurements)
-    result = engine.run(mode="device", precompile=True)
-    for _ in range(max(REPEATS - 1, 0)):
-        r = engine.run(mode="device", precompile=False)
+    # the best of a few runs — each input-salted so none can be served
+    # from the runtime's execution cache
+    result = engine.run(mode="device", precompile=True,
+                        cache_salt=_SALT + 1)
+    for i in range(max(REPEATS - 1, 0)):
+        r = engine.run(mode="device", cache_salt=_SALT + 2 + i)
         if r.sim_seconds_per_wall_second > result.sim_seconds_per_wall_second:
             result = r
     value = result.sim_seconds_per_wall_second
@@ -83,9 +91,10 @@ def main() -> None:
             pops_per_round=4, stream_pairs=pairs, stream_bytes=2_000_000,
         )
         meng = TpuEngine(mixed_cfg, log_capacity=0)
-        mr = meng.run(mode="device", precompile=True)
-        for _ in range(max(REPEATS - 1, 0)):
-            r2 = meng.run(mode="device")
+        mr = meng.run(mode="device", precompile=True,
+                      cache_salt=_SALT + 100)
+        for i in range(max(REPEATS - 1, 0)):
+            r2 = meng.run(mode="device", cache_salt=_SALT + 101 + i)
             if r2.sim_seconds_per_wall_second > mr.sim_seconds_per_wall_second:
                 mr = r2
         out["mixed_hosts"] = MIXED_HOSTS

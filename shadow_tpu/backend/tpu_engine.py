@@ -443,15 +443,27 @@ class TpuEngine:
     # -- running -----------------------------------------------------------
 
     def run(
-        self, mode: str = "device", precompile: bool = False, on_window=None
+        self, mode: str = "device", precompile: bool = False, on_window=None,
+        cache_salt: int = 0,
     ) -> SimResult:
         """``mode='device'``: one fused while_loop on the accelerator;
         ``mode='step'``: one device call per round (debuggable, pausable —
         ``on_window(window_start, window_end, next_event_time)`` runs after
         every round, the run-control/heartbeat seam).
         ``precompile``: AOT-compile before starting the wall-clock timer so
-        ``wall_seconds`` measures only the steady-state device program."""
+        ``wall_seconds`` measures only the steady-state device program.
+        ``cache_salt``: nonzero writes the salt into an INERT queue slot
+        (a NEVER-keyed empty slot's aux word — never popped, dropped by
+        the first merge, zero effect on results) so repeat timings cannot
+        be served from the tunneled runtime's cross-process execution
+        cache, which keys on (program, input buffers)."""
         state = self.initial_state()
+        if cache_salt:
+            state = state._replace(
+                q_auxl=state.q_auxl.at[0, -1].set(
+                    int(cache_salt) & 0x7FFFFFFF
+                )
+            )
         if mode == "device":
             # cache the program: repeat runs (bench best-of-N) must not
             # retrace/recompile
@@ -514,6 +526,22 @@ class TpuEngine:
         from ..core import time as _stime
         from ..utils.pcap import PcapWriter
 
+        # one sort per array, then per-host SLICES via searchsorted —
+        # not a full-array mask per host (O(hosts x rows) otherwise)
+        if pcap_rows.size:
+            out_sorted = pcap_rows[np.argsort(pcap_rows[:, 1], kind="stable")]
+            out_keys = out_sorted[:, 1]
+        else:
+            out_sorted = out_keys = np.zeros((0,), dtype=np.int64)
+        delivered = (
+            event_rows[event_rows[:, 5] == lanes.DELIVERED]
+            if event_rows.size else event_rows
+        )
+        if delivered.size:
+            in_sorted = delivered[np.argsort(delivered[:, 2], kind="stable")]
+            in_keys = in_sorted[:, 2]
+        else:
+            in_sorted = in_keys = np.zeros((0,), dtype=np.int64)
         for hid, hopt in enumerate(self.cfg.hosts):
             if not hopt.pcap_enabled:
                 continue
@@ -521,19 +549,15 @@ class TpuEngine:
             # src, dst, seq) — PcapWriter buffers and sorts at close, so
             # the files are byte-identical even when bucket backlog makes
             # departure stamps non-monotone in processing order
-            out_m = pcap_rows[:, 1] == hid if pcap_rows.size else None
-            in_m = (
-                (event_rows[:, 5] == lanes.DELIVERED)
-                & (event_rows[:, 2] == hid)
-                if event_rows.size else None
-            )
             recs = []
-            if out_m is not None:
-                for t, src, dst, seq, size, _o in pcap_rows[out_m]:
+            if out_keys.size:
+                lo, hi = np.searchsorted(out_keys, [hid, hid + 1])
+                for t, src, dst, seq, size, _o in out_sorted[lo:hi]:
                     recs.append((int(t), 1, int(src), int(dst), int(seq),
                                  int(size)))
-            if in_m is not None:
-                for t, src, dst, seq, size, _o in event_rows[in_m]:
+            if in_keys.size:
+                lo, hi = np.searchsorted(in_keys, [hid, hid + 1])
+                for t, src, dst, seq, size, _o in in_sorted[lo:hi]:
                     recs.append((int(t), 0, int(src), int(dst), int(seq),
                                  int(size)))
             w = PcapWriter(

@@ -62,7 +62,8 @@ class PcapWriter:
         # departure would otherwise land before an earlier-stamped inbound
         # written later, making the file order depend on internal
         # processing order — sorting gives both backends one well-defined
-        # byte-identical layout
+        # byte-identical layout.  Trade-off: records reach disk only at
+        # close(), so a crashed run leaves a header-only file
         self._buf: list = []
 
     def close(self) -> None:
@@ -100,7 +101,9 @@ class PcapWriter:
         traffic).  ``size_bytes`` is the wire size the simulation
         charged."""
         body = self._synthesize(src_ip, dst_ip, size_bytes, payload)
-        self._buf.append((emu_ns, key, body, size_bytes))
+        # buffer only the snaplen prefix (what _record would write): the
+        # sorted-at-close design costs O(records) memory, not O(bytes)
+        self._buf.append((emu_ns, key, body[: self.snaplen], size_bytes))
         self.records += 1
 
     def _synthesize(self, src_ip, dst_ip, size_bytes, payload) -> bytes:
