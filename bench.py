@@ -53,10 +53,15 @@ _SALT = ((os.getpid() << 16) ^ int(time.time())) & 0x3FFFFFFF
 
 
 def _pure_cfg(sim_seconds, backend="tpu"):
-    return flagship_mesh_config(
+    cfg = flagship_mesh_config(
         N_HOSTS, sim_seconds=sim_seconds, queue_capacity=16,
         pops_per_round=2, backend=backend,
     )
+    # the mesh's round-robin spray is a permutation: each lane receives
+    # exactly one packet per window, so a narrow cross block suffices
+    # (strict mode would raise if it ever overflowed)
+    cfg.experimental.tpu_cross_capacity = 8
+    return cfg
 
 
 def main() -> None:

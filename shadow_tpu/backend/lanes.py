@@ -23,8 +23,9 @@ relay token buckets (relay/token_bucket.rs), and the per-host event queues
   (bit-identical to the CPU reference);
 - token bucket + CoDel as masked integer vector arithmetic (identical
   update laws to ``net.token_bucket`` / ``net.codel``);
-- cross-lane packet exchange as a single-key stable sort by destination →
-  segment bounds by ``searchsorted`` → an aligned row-gather + barrel shift
+- cross-lane packet exchange as a single-key sort by destination →
+  segment bounds from a one-hot histogram matmul + cumsum (no
+  data-dependent control flow) → an aligned row-gather + barrel shift
   into a lane-aligned block (the shared-memory queue push's batched
   equivalent; under a sharded mesh the exchange rides XLA collectives).
   Same-lane insertions (delivery self-inserts, timer re-arms) skip the
@@ -251,6 +252,14 @@ class LaneParams:
     # smallest latency actually used so far, never below the floor
     dynamic_runahead: bool = False
     runahead_floor: int = 1
+    # cross-lane receive block width PER ITERATION (0 = the queue
+    # capacity).  A lane receiving more than this many packets in one
+    # iteration sheds the excess exactly like queue overflow (counted,
+    # strict mode raises) — but a narrow block makes the exchange gather
+    # and the merge row sort substantially cheaper, so workloads with
+    # bounded per-iteration fan-in (the all-to-all mesh receives ~1) run
+    # with a small value
+    cross_capacity: int = 0
     # every stream server serves exactly one client: server flow rows live
     # at the server's own lane and the per-slot row gather/scatter
     # disappears (TpuEngine detects this from the config)
@@ -273,10 +282,22 @@ class LaneParams:
     def stream_present(self) -> bool:
         return bool(set(self.models_present) & STREAM_MODELS)
 
+    @property
+    def all_passive(self) -> bool:
+        return set(self.models_present) <= PASSIVE_MODELS
+
+    @property
+    def cross_cap(self) -> int:
+        return min(self.cross_capacity, self.capacity) or self.capacity
+
     def __post_init__(self) -> None:
         if self.n_lanes > MAX_LANES:
             raise ValueError(
                 f"n_lanes={self.n_lanes} exceeds the packed-key limit {MAX_LANES}"
+            )
+        if self.cross_capacity < 0:
+            raise ValueError(
+                f"cross_capacity={self.cross_capacity} must be >= 0"
             )
 
 
@@ -509,13 +530,13 @@ def _sort_queues(s: LaneState, with_pay: bool = False) -> LaneState:
         thi, tlo, ah, al, size, phi, plo = lax.sort(
             (s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size, s.q_phi,
              s.q_plo),
-            dimension=1, num_keys=4,
+            dimension=1, num_keys=4, is_stable=False,
         )
         return s._replace(q_thi=thi, q_tlo=tlo, q_auxh=ah, q_auxl=al,
                           q_size=size, q_phi=phi, q_plo=plo)
     thi, tlo, ah, al, size = lax.sort(
         (s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl, s.q_size),
-        dimension=1, num_keys=4,
+        dimension=1, num_keys=4, is_stable=False,
     )
     return s._replace(q_thi=thi, q_tlo=tlo, q_auxh=ah, q_auxl=al,
                       q_size=size)
@@ -1064,12 +1085,14 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     serialize; sorts and gathers vectorize):
 
     1. same-lane channels (delivery self-inserts, timer re-arms) are already
-       lane-aligned ``[N, 2K]`` blocks — invalid entries get time=NEVER;
-    2. outbound packets take one stable single-key sort by destination, then
-       a segment gather (``searchsorted`` for each lane's slice bounds) into
-       a lane-aligned ``[N, C]`` block — the batched equivalent of the
-       reference's cross-host queue push (worker.rs:603-615);
-    3. one row-sort of ``[old C | self 2K | cross C]`` by the 4-word key
+       lane-aligned ``[N, 2K]`` blocks (``[N, K]`` when every model is
+       passive) — invalid entries get time=NEVER;
+    2. outbound packets take one single-key sort by destination (unstable —
+       the event key is re-sorted below), with each lane's slice bounds from
+       a one-hot histogram matmul + 2D cumsum, into a lane-aligned
+       ``[N, Cx]`` block (``Cx = cross_cap``) — the batched equivalent of
+       the reference's cross-host queue push (worker.rs:603-615);
+    3. one row-sort of ``[old C | self | cross Cx]`` by the 4-word key
        keeps the first C per lane — the queue's sorted invariant is
        maintained, so the pop phase needs no sort at all.
 
@@ -1086,15 +1109,27 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     i64 = jnp.int64
     sp = p.stream_present
 
-    # -- same-lane block [N, 2K] (3K with the stream RTO channel) ----------
-    self_parts = [emits.ins_valid.T, emits.arm_valid.T]
-    thi_parts = [emits.ins_thi.T, emits.arm_thi.T]
-    tlo_parts = [emits.ins_tlo.T, emits.arm_tlo.T]
-    auxh_parts = [emits.ins_auxh.T, emits.arm_auxh.T]
-    auxl_parts = [emits.ins_auxl.T, emits.arm_auxl.T]
-    size_parts = [emits.ins_size.T, emits.arm_size.T]
-    phi_parts = [emits.ins_phi.T, jnp.zeros_like(emits.arm_plo.T)]
-    plo_parts = [emits.ins_plo.T, emits.arm_plo.T]
+    # -- same-lane block [N, 2K] (3K with the stream RTO channel; K when
+    # every model is passive — the DELIVERY self-insert channel is then
+    # statically dead and its always-NEVER columns are dropped) ----------
+    if p.all_passive:
+        self_parts = [emits.arm_valid.T]
+        thi_parts = [emits.arm_thi.T]
+        tlo_parts = [emits.arm_tlo.T]
+        auxh_parts = [emits.arm_auxh.T]
+        auxl_parts = [emits.arm_auxl.T]
+        size_parts = [emits.arm_size.T]
+        phi_parts = [jnp.zeros_like(emits.arm_plo.T)]
+        plo_parts = [emits.arm_plo.T]
+    else:
+        self_parts = [emits.ins_valid.T, emits.arm_valid.T]
+        thi_parts = [emits.ins_thi.T, emits.arm_thi.T]
+        tlo_parts = [emits.ins_tlo.T, emits.arm_tlo.T]
+        auxh_parts = [emits.ins_auxh.T, emits.arm_auxh.T]
+        auxl_parts = [emits.ins_auxl.T, emits.arm_auxl.T]
+        size_parts = [emits.ins_size.T, emits.arm_size.T]
+        phi_parts = [emits.ins_phi.T, jnp.zeros_like(emits.arm_plo.T)]
+        plo_parts = [emits.ins_plo.T, emits.arm_plo.T]
     if sp:
         self_parts.append(emits.arm2_valid.T)
         thi_parts.append(emits.arm2_thi.T)
@@ -1113,7 +1148,7 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     self_phi = jnp.concatenate(phi_parts, axis=1)
     self_plo = jnp.concatenate(plo_parts, axis=1)
 
-    # -- cross-lane block [N, C] via sort-by-dst + segment gather ----------
+    # -- cross-lane block [N, Cx] via sort-by-dst + histogram bounds -------
     valid = emits.out_valid.reshape(-1)
     dst = jnp.where(valid, emits.out_dst.reshape(-1), jnp.int32(n))
     out_thi = emits.out_thi.reshape(-1)
@@ -1155,21 +1190,54 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
             flat_ops = [
                 jnp.concatenate([a, b]) for a, b in zip(flat_ops, extras)
             ]
-    sorted_ops = lax.sort(tuple(flat_ops), dimension=0, num_keys=1)
-    dst_s, thi_s, tlo_s, auxh_s, auxl_s, size_s = sorted_ops[:6]
+    # the sort need not be stable: within a destination's segment the real
+    # entries carry the 4-word event key, a TOTAL order (ties impossible
+    # between distinct events), and the merge sort below re-orders by that
+    # key anyway.  Unstable drops XLA's hidden iota tiebreaker operand
+    # from every compare-exchange stage.  The one observable: when a
+    # segment overflows cross_cap, WHICH entries are shed is no longer
+    # emission order but the sort network's choice — still deterministic
+    # for a given compiled program, and strict mode (the default) raises
+    # on any shed; non-strict overflow was already documented as
+    # non-parity (see tpu_engine.py's strict_capacity note).
+    sorted_ops = lax.sort(
+        tuple(flat_ops), dimension=0, num_keys=1, is_stable=False
+    )
+    _dst_s, thi_s, tlo_s, auxh_s, auxl_s, size_s = sorted_ops[:6]
     pay_s = sorted_ops[6:8] if sp else None
-    # one search over [0..N]: start of lane n+1 is the end of lane n
-    bounds = jnp.searchsorted(
-        dst_s, jnp.arange(n + 1, dtype=dst_s.dtype), side="left"
-    ).astype(jnp.int32)
-    start = bounds[:n]
-    cnt = bounds[1:] - start
-    r = jnp.arange(c, dtype=jnp.int32)[None, :]  # [1, C]
+    # segment bounds per destination lane.  NOT jnp.searchsorted — the
+    # vmapped binary search lowers to a nested lax.while_loop (~15
+    # sequential sub-iterations with gathers) inside the hot body.  The
+    # counts come instead from a one-hot HISTOGRAM as a single MXU
+    # matmul: dst decomposes as (dst >> 7, dst & 127) and
+    # counts[q, r] = sum_m oh_q[m, q] * oh_r[m, r] — exact in f32
+    # (counts < 2**24) — then one small 2D cumsum gives the exclusive
+    # prefix (= segment starts) with no data-dependent control flow.
+    dst_all = flat_ops[0]  # pre-sort values: the histogram is order-free
+    dq = -(-(n + 1) // 128)
+    oh_q = (
+        (dst_all[:, None] >> 7) == jnp.arange(dq, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)
+    oh_r = (
+        (dst_all[:, None] & 127) == jnp.arange(128, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)
+    counts_grid = lax.dot_general(
+        oh_q, oh_r, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)  # [dq, 128]
+    row_cum = jnp.cumsum(counts_grid, axis=1)
+    row_tot = row_cum[:, -1]
+    row_off = jnp.cumsum(row_tot) - row_tot  # exclusive row offsets
+    start_grid = row_cum - counts_grid + row_off[:, None]
+    start = start_grid.reshape(-1)[:n]
+    cnt = counts_grid.reshape(-1)[:n]
+    cx = p.cross_cap
+    r = jnp.arange(cx, dtype=jnp.int32)[None, :]  # [1, Cx]
     in_seg = r < cnt[:, None]
     gather_ops = [thi_s, tlo_s, auxh_s, auxl_s, size_s] + (
         list(pay_s) if sp else []
     )
-    gathered = _window_gather(gather_ops, start, c)
+    gathered = _window_gather(gather_ops, start, cx)
     g_thi, g_tlo, g_auxh, g_auxl, g_size = gathered[:5]
     cross_thi = jnp.where(in_seg, g_thi, NEVER32).astype(jnp.int32)
     cross_tlo = jnp.where(in_seg, g_tlo, NEVER32).astype(jnp.int32)
@@ -1179,11 +1247,11 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     if sp:
         cross_phi = jnp.where(in_seg, gathered[5], 0)
         cross_plo = jnp.where(in_seg, gathered[6], 0)
-    # receivers of more than C events in one iteration lose the tail
+    # receivers of more than Cx events in one iteration lose the tail
     # before the merge even sees it; count those drops too
-    lost_pre = jnp.maximum(cnt - c, 0)
+    lost_pre = jnp.maximum(cnt - cx, 0)
 
-    # -- merge [N, C + self + C], keep first C ----------------------------
+    # -- merge [N, C + self + Cx], keep first C ---------------------------
     # queue state is ALREADY the int32 4-word key: no conversions at all
     mthi = jnp.concatenate([s.q_thi, self_thi, cross_thi], axis=1)
     mtlo = jnp.concatenate([s.q_tlo, self_tlo, cross_tlo], axis=1)
@@ -1194,11 +1262,13 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
         mphi = jnp.concatenate([s.q_phi, self_phi, cross_phi], axis=1)
         mplo = jnp.concatenate([s.q_plo, self_plo, cross_plo], axis=1)
         mthi, mtlo, mh, ml, ms, mphi, mplo = lax.sort(
-            (mthi, mtlo, mh, ml, ms, mphi, mplo), dimension=1, num_keys=4
+            (mthi, mtlo, mh, ml, ms, mphi, mplo), dimension=1, num_keys=4,
+            is_stable=False,
         )
     else:
         mthi, mtlo, mh, ml, ms = lax.sort(
-            (mthi, mtlo, mh, ml, ms), dimension=1, num_keys=4
+            (mthi, mtlo, mh, ml, ms), dimension=1, num_keys=4,
+            is_stable=False,
         )
     tail_mask = mthi[:, c:] != NEVER32
     s = s._replace(

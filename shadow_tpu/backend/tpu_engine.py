@@ -39,10 +39,12 @@ class LaneCompatError(ValueError):
 
 # NOTE on ``strict_capacity=False``: queue overflow on this backend evicts
 # the *latest-keyed* events of the full lane (the merge keeps the earliest C)
-# and burst arrivals past C per iteration are counted but not logged, whereas
-# the CPU reference never drops (its queues are unbounded).  Non-strict runs
-# are therefore NOT log-parity comparable once any lane overflows; strict
-# mode (the default) raises instead of diverging silently.
+# and burst arrivals past the cross block's width per iteration are shed in
+# an order chosen by the (unstable) exchange sort network — deterministic
+# for a compiled program but unspecified — whereas the CPU reference never
+# drops (its queues are unbounded).  Non-strict runs are therefore NOT
+# log-parity comparable once any lane overflows; strict mode (the default)
+# raises instead of diverging silently.
 
 
 class TpuEngine:
@@ -159,6 +161,11 @@ class TpuEngine:
                 )
 
         capacity = cfg.experimental.tpu_lane_queue_capacity
+        if cfg.experimental.tpu_cross_capacity < 0:
+            raise LaneCompatError(
+                f"tpu_cross_capacity={cfg.experimental.tpu_cross_capacity} "
+                "must be >= 0 (0 = queue capacity)"
+            )
         max_init = max(
             (sum(1 for e in init_events if e[0] == hid) for hid in range(n)),
             default=0,
@@ -234,6 +241,7 @@ class TpuEngine:
             stream_clients=tuple(int(c) for c in client_ids),
             stream_wide_pop=stream_wide_pop,
             pcap_any=pcap_any,
+            cross_capacity=cfg.experimental.tpu_cross_capacity,
         )
 
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)

@@ -100,6 +100,10 @@ class ExperimentalOptions:
     tpu_lane_queue_capacity: int = 64  # per-host in-flight packet slots
     tpu_events_per_round: int = 8  # max pops per lane per inner step
     tpu_round_unroll: int = 1  # fused-loop steps per device loop trip
+    # cross-lane receive block width per iteration (0 = queue capacity);
+    # narrower is faster when per-iteration fan-in is bounded — overflow
+    # is counted and strict mode raises, exactly like queue overflow
+    tpu_cross_capacity: int = 0
     tpu_mesh_shape: Optional[tuple[int, ...]] = None  # None = all devices
 
 

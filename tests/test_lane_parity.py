@@ -113,6 +113,27 @@ MESH_UNROLLED = MESH.replace(
 )
 
 
+MESH_NARROW_CROSS = MESH.replace(
+    "hosts:", "experimental: {tpu_cross_capacity: 4}\nhosts:"
+)
+
+
+def test_narrow_cross_block_parity():
+    """tpu_cross_capacity narrows the per-iteration receive block below the
+    queue capacity (the bench's configuration); logs stay bit-identical
+    when fan-in fits, and strict mode still raises when it doesn't."""
+    cpu, tpu = both_logs(MESH_NARROW_CROSS, mode="device")
+    assert cpu.log_tuples() == tpu.log_tuples()
+
+
+def test_negative_cross_capacity_rejected():
+    cfg = ConfigOptions.from_yaml(
+        MESH.replace("hosts:", "experimental: {tpu_cross_capacity: -1}\nhosts:")
+    )
+    with pytest.raises(LaneCompatError):
+        TpuEngine(cfg)
+
+
 def test_unrolled_device_loop_parity():
     """tpu_round_unroll > 1 runs several window steps per device-loop trip
     (trailing no-op steps past the end included) — logs stay identical.
