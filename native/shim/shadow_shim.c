@@ -650,17 +650,24 @@ static void sigsys_handler(int sig, siginfo_t *si, void *uctx) {
     long a4 = gr[REG_R10], a5 = gr[REG_R8], a6 = gr[REG_R9];
     unsigned long insn_ip = (unsigned long)gr[REG_RIP] - 2; /* rip is past
                                                 the 2-byte syscall insn */
-    if (nr == SYS_rt_sigprocmask && (size_t)a4 == 8 &&
+    if (nr == SYS_rt_sigprocmask &&
         !(insn_ip >= g_text_lo && insn_ip < g_text_hi)) {
         /* An app mask change must land in uc_sigmask — the kernel
          * restores THAT at our sigreturn, so a mask set natively inside
          * this handler would be silently undone.  Operate on the saved
          * context directly (SIGSYS stripped: blocking it turns the next
          * dispatch into a forced kill) and mirror the app's logical
-         * blocked set for the manager's park-release decisions. */
+         * blocked set for the manager's park-release decisions.
+         * sigsetsize != 8 gets the kernel's own answer (-EINVAL) rather
+         * than a native fallthrough whose effect sigreturn would undo. */
         uint64_t *ucm = (uint64_t *)&uc->uc_sigmask;
         uint64_t old = *ucm;
         long r = 0;
+        if ((size_t)a4 != 8) {
+            gr[REG_RAX] = -EINVAL;
+            errno = saved_errno;
+            return;
+        }
         if (a2) {
             uint64_t m;
             memcpy(&m, (void *)a2, 8);
@@ -2358,6 +2365,10 @@ int pselect(int nfds, fd_set *rd, fd_set *wr, fd_set *ex,
     if (ts) {
         tv.tv_sec = ts->tv_sec;
         tv.tv_usec = (ts->tv_nsec + 999) / 1000;
+        if (tv.tv_usec >= 1000000) { /* nsec > 999999000 rounds up a sec */
+            tv.tv_sec += 1;
+            tv.tv_usec = 0;
+        }
         tvp = &tv;
     }
     wait_mask_t w;

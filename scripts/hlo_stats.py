@@ -17,7 +17,8 @@ from shadow_tpu.config.presets import flagship_mesh_config
 
 
 def main() -> None:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if args else 10000
     cfg = flagship_mesh_config(
         n, sim_seconds=5, queue_capacity=16, pops_per_round=2
     )

@@ -1213,24 +1213,39 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     # counts[q, r] = sum_m oh_q[m, q] * oh_r[m, r] — exact in f32
     # (counts < 2**24) — then one small 2D cumsum gives the exclusive
     # prefix (= segment starts) with no data-dependent control flow.
+    # The one-hot operands are [M, ceil((n+1)/128)] and [M, 128]: fine at
+    # bench scale (10k lanes, K=2 -> ~6 MB) but quadratic-ish in n, so
+    # past a static budget the bounds fall back to searchsorted on the
+    # sorted keys — paying the nested loop only where the matmul would
+    # blow memory.
     dst_all = flat_ops[0]  # pre-sort values: the histogram is order-free
     dq = -(-(n + 1) // 128)
-    oh_q = (
-        (dst_all[:, None] >> 7) == jnp.arange(dq, dtype=jnp.int32)[None, :]
-    ).astype(jnp.float32)
-    oh_r = (
-        (dst_all[:, None] & 127) == jnp.arange(128, dtype=jnp.int32)[None, :]
-    ).astype(jnp.float32)
-    counts_grid = lax.dot_general(
-        oh_q, oh_r, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.int32)  # [dq, 128]
-    row_cum = jnp.cumsum(counts_grid, axis=1)
-    row_tot = row_cum[:, -1]
-    row_off = jnp.cumsum(row_tot) - row_tot  # exclusive row offsets
-    start_grid = row_cum - counts_grid + row_off[:, None]
-    start = start_grid.reshape(-1)[:n]
-    cnt = counts_grid.reshape(-1)[:n]
+    m_entries = dst_all.shape[0]
+    if m_entries * (dq + 128) <= (1 << 25):  # <= 128 MiB of f32 one-hots
+        oh_q = (
+            (dst_all[:, None] >> 7)
+            == jnp.arange(dq, dtype=jnp.int32)[None, :]
+        ).astype(jnp.float32)
+        oh_r = (
+            (dst_all[:, None] & 127)
+            == jnp.arange(128, dtype=jnp.int32)[None, :]
+        ).astype(jnp.float32)
+        counts_grid = lax.dot_general(
+            oh_q, oh_r, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)  # [dq, 128]
+        row_cum = jnp.cumsum(counts_grid, axis=1)
+        row_tot = row_cum[:, -1]
+        row_off = jnp.cumsum(row_tot) - row_tot  # exclusive row offsets
+        start_grid = row_cum - counts_grid + row_off[:, None]
+        start = start_grid.reshape(-1)[:n]
+        cnt = counts_grid.reshape(-1)[:n]
+    else:
+        bounds = jnp.searchsorted(
+            _dst_s, jnp.arange(n + 1, dtype=_dst_s.dtype), side="left"
+        ).astype(jnp.int32)
+        start = bounds[:n]
+        cnt = bounds[1:] - start
     cx = p.cross_cap
     r = jnp.arange(cx, dtype=jnp.int32)[None, :]  # [1, Cx]
     in_seg = r < cnt[:, None]

@@ -18,8 +18,12 @@ binaries genuinely run concurrently, which is exactly the workload the
 reference parallelizes.  Determinism holds for ANY worker count: within a
 round hosts only touch their own state, cross-host effects are inbox
 appends whose drain order is normalized by the total event order, and
-per-worker log/min-latency buffers merge at the barrier in worker order
-(the determinism suite asserts parallelism-invariance).
+per-HOST log/min-latency buffers (cpu_engine.Host.log_buf / min_used_lat)
+merge at the barrier in host-id order — which is precisely why work
+stealing preserves determinism: no accumulation is keyed on which worker
+ran a host.  Any future per-WORKER state must be steal-order-invariant
+or it will break parallelism-invariance (the determinism suite asserts
+it).
 """
 
 from __future__ import annotations
@@ -41,6 +45,8 @@ class HostScheduler:
         pin_cpus: bool = True,
     ) -> None:
         n_hosts = len(hosts)
+        # cumulative cross-worker steals (perf observability)
+        self.steals = 0
         if policy == "thread-per-host":
             workers = n_hosts
         else:
@@ -78,8 +84,6 @@ class HostScheduler:
         ]
         for f in futures:  # barrier; re-raise worker exceptions
             self.steals += f.result()
-
-    steals = 0  # cumulative cross-worker steals (perf observability)
 
     def shutdown(self) -> None:
         if self._pool is not None:

@@ -14,8 +14,11 @@ plugin-observed clocks.
 
 from __future__ import annotations
 
+import heapq
+import pickle
 import socket
 import struct
+import tempfile
 from pathlib import Path
 
 LINKTYPE_IPV4 = 228
@@ -62,15 +65,57 @@ class PcapWriter:
         # departure would otherwise land before an earlier-stamped inbound
         # written later, making the file order depend on internal
         # processing order — sorting gives both backends one well-defined
-        # byte-identical layout.  Trade-off: records reach disk only at
-        # close(), so a crashed run leaves a header-only file
+        # byte-identical layout.  Memory stays bounded: once the in-RAM
+        # buffer passes ``spill_bytes`` it is sorted and spilled to an
+        # unlinked temp file, and close() streams an external merge of
+        # all chunks (stable, so the output is byte-identical to the
+        # single-buffer sort).  Trade-off kept from the sorted design:
+        # the FINAL file is written only at close(), so a crashed run
+        # leaves a header-only pcap (the spill chunks die with the
+        # process)
         self._buf: list = []
+        self._buf_bytes = 0
+        self._chunks: list = []
+        self.spill_bytes = 32 << 20
+
+    def _spill(self) -> None:
+        self._buf.sort(key=lambda r: (r[0], r[1]))
+        f = tempfile.TemporaryFile()
+        for rec in self._buf:
+            pickle.dump(rec, f, protocol=pickle.HIGHEST_PROTOCOL)
+        self._chunks.append(f)
+        self._buf = []
+        self._buf_bytes = 0
+
+    @staticmethod
+    def _iter_chunk(f):
+        f.seek(0)
+        unpickler = pickle.Unpickler(f)
+        while True:
+            try:
+                yield unpickler.load()
+            except EOFError:
+                return
 
     def close(self) -> None:
         if self._f is not None:
             self._buf.sort(key=lambda r: (r[0], r[1]))
-            for emu_ns, _key, body, orig in self._buf:
+            if self._chunks:
+                # heapq.merge is stable in stream order, and chunks are
+                # listed in capture order: ties land exactly where the
+                # single-buffer stable sort would put them
+                merged = heapq.merge(
+                    *(self._iter_chunk(f) for f in self._chunks),
+                    self._buf,
+                    key=lambda r: (r[0], r[1]),
+                )
+            else:
+                merged = iter(self._buf)
+            for emu_ns, _key, body, orig in merged:
                 self._record(emu_ns, body, orig)
+            for f in self._chunks:
+                f.close()
+            self._chunks = []
             self._buf = []
             self._f.close()
             self._f = None
@@ -101,9 +146,13 @@ class PcapWriter:
         traffic).  ``size_bytes`` is the wire size the simulation
         charged."""
         body = self._synthesize(src_ip, dst_ip, size_bytes, payload)
-        # buffer only the snaplen prefix (what _record would write): the
-        # sorted-at-close design costs O(records) memory, not O(bytes)
-        self._buf.append((emu_ns, key, body[: self.snaplen], size_bytes))
+        # buffer only the snaplen prefix (what _record would write), and
+        # spill sorted chunks to disk past the memory budget
+        prefix = body[: self.snaplen]
+        self._buf.append((emu_ns, key, prefix, size_bytes))
+        self._buf_bytes += len(prefix) + 64
+        if self._buf_bytes >= self.spill_bytes:
+            self._spill()
         self.records += 1
 
     def _synthesize(self, src_ip, dst_ip, size_bytes, payload) -> bytes:

@@ -181,3 +181,27 @@ hosts:
     assert not (tmp_path / "tpu" / "hosts" / "other").exists() or not (
         tmp_path / "tpu" / "hosts" / "other" / "eth0.pcap"
     ).exists()
+
+
+def test_pcap_spill_chunks_byte_identical(tmp_path):
+    """The bounded-memory spill path (sorted chunks + external merge)
+    writes byte-identical output to the all-in-RAM sort."""
+    from shadow_tpu.utils.pcap import PcapWriter
+
+    def write(path, spill_bytes):
+        w = PcapWriter(path, snaplen=256)
+        if spill_bytes:
+            w.spill_bytes = spill_bytes
+        # deliberately out of order, with timestamp ties broken by key
+        for i in range(500):
+            t = ((i * 7919) % 100) * 1_000_000
+            w.capture(t, "11.0.0.1", "11.0.0.2", 200 + (i % 3),
+                      (1000, 2000, b"x" * (i % 50)),
+                      key=(i % 2, 1, 2, i))
+        w.close()
+        return path.read_bytes()
+
+    plain = write(tmp_path / "plain.pcap", 0)
+    spilled = write(tmp_path / "spill.pcap", 2048)  # many tiny chunks
+    assert len(plain) > 1000
+    assert plain == spilled
