@@ -33,7 +33,10 @@ import time
 
 import shadow_tpu  # noqa: F401  (enables jax x64 mode)
 from shadow_tpu.backend.tpu_engine import TpuEngine
-from shadow_tpu.config.presets import flagship_mesh_config
+from shadow_tpu.config.presets import (
+    flagship_mesh_config,
+    mixed_flagship_config,
+)
 
 REFERENCE_SPEEDUP = 6.38  # BASELINE.md: 180 sim-s in 28.23 wall-s
 
@@ -91,10 +94,7 @@ def main() -> None:
     # lanes (the round-2 device fault is fixed; flows complete)
     if MIXED_HOSTS > 0:
         pairs = max(MIXED_HOSTS // 100, 1)
-        mixed_cfg = flagship_mesh_config(
-            MIXED_HOSTS, sim_seconds=5, queue_capacity=48,
-            pops_per_round=4, stream_pairs=pairs, stream_bytes=2_000_000,
-        )
+        mixed_cfg = mixed_flagship_config(MIXED_HOSTS, sim_seconds=5)
         meng = TpuEngine(mixed_cfg, log_capacity=0)
         mr = meng.run(mode="device", precompile=True,
                       cache_salt=_SALT + 100)

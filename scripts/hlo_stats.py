@@ -13,15 +13,21 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import shadow_tpu  # noqa: F401
 from shadow_tpu.backend import lanes
 from shadow_tpu.backend.tpu_engine import TpuEngine
-from shadow_tpu.config.presets import flagship_mesh_config
+from shadow_tpu.config.presets import (
+    flagship_mesh_config,
+    mixed_flagship_config,
+)
 
 
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     n = int(args[0]) if args else 10000
-    cfg = flagship_mesh_config(
-        n, sim_seconds=5, queue_capacity=16, pops_per_round=2
-    )
+    if "--mixed" in sys.argv:
+        cfg = mixed_flagship_config(n, sim_seconds=5)
+    else:
+        cfg = flagship_mesh_config(
+            n, sim_seconds=5, queue_capacity=16, pops_per_round=2
+        )
     eng = TpuEngine(cfg, log_capacity=0)
     run_fn = lanes.make_run_fn(eng.params, eng.tables)
     state = eng.initial_state()

@@ -216,14 +216,10 @@ def _flight(f: FlowCols):
     return f.snd_nxt - f.snd_una
 
 
-def _can_send_new(f: FlowCols):
-    cwnd_segs = f.cwnd_fp // ltcp.FP
-    return (
-        (f.role == ltcp.SENDER)
-        & (f.state == ltcp.ESTAB)
-        & (f.snd_nxt <= f.segs + 1)
-        & (_flight(f) < jnp.minimum(cwnd_segs, ltcp.RWND_SEGS))
-    )
+# NOTE: the scalar law's per-unit send gate (ltcp._can_send_new) has no
+# vector twin here — pump_epilogue_vec's closed form derives the whole
+# burst length from the gate's components at once (can0/lim_w/lim_fin);
+# change the gate THERE when the scalar law changes.
 
 
 def _rtt_sample(f: FlowCols, nh, nl, m) -> FlowCols:
@@ -360,49 +356,72 @@ def pump_epilogue_vec(f: FlowCols, nh, nl, m, em):
     PREFIX along axis 0 (emissions stop when the window exhausts) — the
     engine's send-sequence ranking relies on that.
 
-    A ``lanes.scan_or_unroll`` over units: a rolled scan on XLA:CPU, a
-    fusable Python loop on the accelerator."""
-    from . import lanes as _lanes
+    CLOSED FORM — not a loop.  The scalar law's per-unit loop is exactly
+    derivable because nothing the gate depends on changes mid-burst
+    (cwnd, snd_una, role are fixed; state flips to FIN_WAIT only at the
+    final sendable unit; snd_nxt is affine in the unit index), so:
 
-    def step(carry, _):
-        f, em = carry
-        mi = m & _can_send_new(f)
-        unit = f.snd_nxt
-        f = f._replace(snd_nxt=jnp.where(mi, unit + 1, f.snd_nxt))
-        retransmit = unit < f.max_sent
-        fresh_ts = mi & ~retransmit & (f.rtt_seq < 0)
-        f = f._replace(
-            rtt_ts_hi=jnp.where(fresh_ts, nh, f.rtt_ts_hi),
-            rtt_ts_lo=jnp.where(fresh_ts, nl, f.rtt_ts_lo),
-        )
-        flags = _seg_flags(f, unit)
-        size = _seg_wire_size(f, unit)
-        f = f._replace(
-            tx_segs=f.tx_segs + mi,
-            retransmits=f.retransmits + (mi & retransmit),
-            rtt_seq=jnp.where(
-                mi & retransmit & (f.rtt_seq >= 0) & (unit <= f.rtt_seq),
-                -1,
-                jnp.where(mi & ~retransmit & (f.rtt_seq < 0), unit,
-                          f.rtt_seq),
-            ),
-            max_sent=jnp.where(
-                mi & (unit + 1 > f.max_sent), unit + 1, f.max_sent
-            ),
-        )
-        out = (mi, flags, unit, f.rcv_nxt, size)
-        f = f._replace(
-            state=jnp.where(mi & (unit == f.segs + 1), ltcp.FIN_WAIT, f.state)
-        )
-        f, rv, rth, rtl = _restart_rto(f, nh, nl, mi, em.rto_valid,
-                                       em.rto_thi, em.rto_tlo)
-        em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
-        return (f, em), out
+    - the burst length is ``B = clip(min(window_room, fin_room), 0,
+      PUMP_BURST)`` with units ``u0 .. u0+B-1``;
+    - retransmit units are the prefix below the entry ``max_sent``
+      (``nR = clip(max_sent - u0, 0, B)``), so the retransmit counter
+      adds ``nR`` and the fresh-sample bookkeeping reduces to: a clear
+      happens iff a retransmit unit exists at or below ``rtt_seq``
+      (only the FIRST unit can satisfy ``unit <= rtt_seq``: units grow),
+      and the first FRESH unit samples iff ``rtt_seq`` was negative or
+      just cleared;
+    - the per-step ``_restart_rto`` is idempotent across the burst (the
+      deadline ``now + rto`` is constant and the dedup law arms at most
+      once), so one call under ``m & (B > 0)`` is exact.
 
-    (f, em), burst = _lanes.scan_or_unroll(
-        step, (f, em), None, ltcp.PUMP_BURST
+    Per-unit wire fields (flags/size/ack) depend only on the unit index
+    and static shape columns, so they broadcast to [PUMP_BURST, N] with
+    no sequential dependency at all — this removed ~PUMP_BURST
+    dependent fusion blocks per slot from the mixed-mesh iteration."""
+    i32 = jnp.int32
+    b_max = ltcp.PUMP_BURST
+    u0 = f.snd_nxt
+    cwnd_segs = f.cwnd_fp // ltcp.FP
+    can0 = m & (f.role == ltcp.SENDER) & (f.state == ltcp.ESTAB)
+    lim_w = jnp.minimum(cwnd_segs, ltcp.RWND_SEGS) - (u0 - f.snd_una)
+    lim_fin = f.segs + 2 - u0
+    b_cnt = jnp.where(
+        can0, jnp.clip(jnp.minimum(lim_w, lim_fin), 0, b_max), 0
+    ).astype(i32)
+    sent_any = b_cnt > 0
+
+    ks = jnp.arange(b_max, dtype=i32)[:, None]  # [B, 1]
+    units = u0[None, :] + ks  # [B, N]
+    valid = ks < b_cnt[None, :]  # prefix along axis 0
+    flags = _seg_flags(f, units)  # broadcasts: shape cols are [N]
+    sizes = _seg_wire_size(f, units)
+    acks = jnp.broadcast_to(f.rcv_nxt[None, :], units.shape)
+
+    n_re = jnp.clip(f.max_sent - u0, 0, b_cnt)  # retransmit prefix length
+    cleared = (n_re > 0) & (f.rtt_seq >= 0) & (u0 <= f.rtt_seq)
+    fresh_exists = b_cnt > n_re
+    take_ts = fresh_exists & ((f.rtt_seq < 0) | cleared)
+    new_rtt_seq = jnp.where(
+        take_ts, u0 + n_re, jnp.where(cleared, -1, f.rtt_seq)
     )
-    return f, em, burst
+    f = f._replace(
+        rtt_ts_hi=jnp.where(take_ts, nh, f.rtt_ts_hi),
+        rtt_ts_lo=jnp.where(take_ts, nl, f.rtt_ts_lo),
+        rtt_seq=new_rtt_seq,
+        tx_segs=f.tx_segs + b_cnt,
+        retransmits=f.retransmits + n_re,
+        max_sent=jnp.where(
+            sent_any, jnp.maximum(f.max_sent, u0 + b_cnt), f.max_sent
+        ),
+        snd_nxt=u0 + b_cnt,
+        state=jnp.where(
+            sent_any & (u0 + b_cnt == f.segs + 2), ltcp.FIN_WAIT, f.state
+        ),
+    )
+    f, rv, rth, rtl = _restart_rto(f, nh, nl, m & sent_any, em.rto_valid,
+                                   em.rto_thi, em.rto_tlo)
+    em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
+    return f, em, (valid, flags, units, acks, sizes)
 
 
 # --------------------------------------------------------------------------

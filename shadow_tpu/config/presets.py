@@ -82,3 +82,16 @@ hosts:
     if pops_per_round is not None:
         cfg.experimental.tpu_events_per_round = pops_per_round
     return cfg
+
+
+def mixed_flagship_config(
+    n_hosts: int, sim_seconds: int = 5, backend: str = "tpu"
+) -> ConfigOptions:
+    """The MIXED TCP/UDP mesh at its north-star tuning (the bench's and
+    the probe/HLO scripts' single source of truth): 1 stream pair per 100
+    hosts streaming 2 MB across the datagram mesh."""
+    return flagship_mesh_config(
+        n_hosts, sim_seconds=sim_seconds, queue_capacity=48,
+        pops_per_round=4, stream_pairs=max(n_hosts // 100, 1),
+        stream_bytes=2_000_000, backend=backend,
+    )
