@@ -1,0 +1,16 @@
+# Repo-level targets.  `make gate` is the pre-snapshot ritual: the full
+# suite PLUS the 20x-repeat determinism stress gate (tests/test_stress.py)
+# that is otherwise env-gated off.  Mirrors the reference's determinism
+# CTest gate (src/test/determinism/CMakeLists.txt).
+
+.PHONY: test gate native
+
+test: native
+	python -m pytest tests/ -q
+
+gate: native
+	python -m pytest tests/ -q
+	SHADOW_TPU_STRESS=1 python -m pytest tests/test_stress.py -q
+
+native:
+	$(MAKE) -C native

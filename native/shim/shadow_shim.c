@@ -1647,6 +1647,40 @@ static ssize_t vfd_recvfrom(int fd, void *buf, size_t n, int flags,
     int waitall = vfd_stream[fd] && (flags & MSG_WAITALL) && !nb && !peek;
     size_t off = 0;
     if (trunc_out) *trunc_out = 0;
+    /* stream, large buffer, consuming read: pass (addr, len) and let the
+     * manager copy straight INTO our memory with process_vm_writev (the
+     * MemoryCopier's write side) — one exchange per 256 KiB instead of
+     * one per 64 KiB frame.  -EOPNOTSUPP on the first try means the
+     * kernel forbids cross-process writes: fall back to frames for the
+     * process's lifetime, like the send side. */
+    static int g_vmwrite_off;
+    if (!g_vmwrite_off && vfd_stream[fd] && !peek && n > SHIM_PAYLOAD_MAX) {
+        const size_t VMCHUNK = 256u << 10;
+        for (;;) {
+            size_t want = n - off;
+            if (want > VMCHUNK) want = VMCHUNK;
+            int64_t args[6] = {fd, (int64_t)want, nb, peek,
+                               (int64_t)(uintptr_t)buf + (int64_t)off, 0};
+            int64_t reply[6];
+            int64_t ret = shim_call(SHIM_OP_RECVFROM, args, NULL, 0, NULL,
+                                    NULL, reply);
+            if (ret == -EOPNOTSUPP && off == 0) {
+                g_vmwrite_off = 1;
+                break; /* frame path below */
+            }
+            if (ret < 0) {
+                if (off > 0) return (ssize_t)off;
+                errno = (int)-ret;
+                return -1;
+            }
+            if (off == 0)
+                fill_sockaddr(addr, alen, (uint32_t)reply[1],
+                              (uint16_t)reply[2]);
+            off += (size_t)ret;
+            if (ret == 0 || off >= n || !waitall) break;
+        }
+        if (!g_vmwrite_off) return (ssize_t)off;
+    }
     for (;;) {
         size_t want = n - off;
         if (want > SHIM_PAYLOAD_MAX) want = SHIM_PAYLOAD_MAX;

@@ -329,11 +329,24 @@ class LaneTables(NamedTuple):
     p_count: jnp.ndarray  # [N] int32 message budget (ping client)
     p_stride: jnp.ndarray  # [N] int32 (tgen-mesh)
     codel_div: jnp.ndarray  # [1025] int32
-    st_segs: jnp.ndarray  # [N] int32 stream-client data segments
-    st_mss: jnp.ndarray  # [N] int32
-    st_last: jnp.ndarray  # [N] int32 final-segment payload bytes
-    st_cl_of: jnp.ndarray  # [N] int32: server lane -> its client lane
-                           # (one-to-one mode; own lane elsewhere)
+    # COMPACTED stream-flow tables [2S] (S flows; rows 0..S-1 = client
+    # endpoints, S..2S-1 = server endpoints — lanes_stream.endpoint_cols).
+    # All static per flow, so the stream tier runs on [2S] rows instead
+    # of [N] lanes and its sends need no latency/loss gathers at all.
+    # Shapes are [2] placeholder when no stream models are present.
+    flow_lanes: jnp.ndarray  # [2S] int32: endpoint's own lane
+    flow_peers: jnp.ndarray  # [2S] int32: endpoint's peer lane
+    flow_clid: jnp.ndarray  # [2S] int32: the flow's CLIENT lane
+    flow_lat: jnp.ndarray  # [2S] int32: latency lane -> peer
+    flow_thresh_u32: jnp.ndarray  # [2S] uint32 loss threshold
+    flow_thresh_all: jnp.ndarray  # [2S] bool
+    flow_segs: jnp.ndarray  # [2S] int32 (zeros on the server half)
+    flow_mss: jnp.ndarray  # [2S] int32
+    flow_last: jnp.ndarray  # [2S] int32
+    flow_up_rate: jnp.ndarray  # [2S] int32: the endpoint lane's up bucket
+    flow_up_burst: jnp.ndarray  # [2S] int32
+    flow_up_kfull: jnp.ndarray  # [2S] int32
+    flow_up_kfi: jnp.ndarray  # [2S] int32
     lane_pcap: jnp.ndarray  # [N] bool: host captures pcap
 
 
@@ -606,13 +619,6 @@ class _SlotEmit(NamedTuple):
     arm_auxl: jnp.ndarray
     arm_size: jnp.ndarray  # int32 (0 timer, -2 pump)
     arm_plo: jnp.ndarray  # int32 (stream flow id; phi is always 0)
-    # same-lane insert channel 3: stream RTO arm (LOCAL, size -3)
-    arm2_valid: jnp.ndarray
-    arm2_thi: jnp.ndarray
-    arm2_tlo: jnp.ndarray
-    arm2_auxh: jnp.ndarray
-    arm2_auxl: jnp.ndarray
-    arm2_plo: jnp.ndarray
     # cross-lane channel: outbound packets
     out_valid: jnp.ndarray
     out_dst: jnp.ndarray  # int32
@@ -623,8 +629,23 @@ class _SlotEmit(NamedTuple):
     out_size: jnp.ndarray
     out_phi: jnp.ndarray  # int32 payload words
     out_plo: jnp.ndarray
-    # stream burst channel [PUMP_BURST, N]: the epilogue's data segments
-    # (client lanes; dst is the static p_peer).  () when no stream tier
+    # COMPACTED stream channels (endpoint rows; () when no stream tier).
+    # Destinations/aux words come from the static flow tables, so only
+    # the dynamic fields travel here.
+    # slot-0 control sends [2S]
+    se_valid: Any
+    se_thi: Any  # arrival pair
+    se_tlo: Any
+    se_seq: Any  # engine send seq
+    se_size: Any
+    se_phi: Any
+    se_plo: Any
+    # stream RTO arms [2S] (LOCAL self-inserts, size SZ_RTO)
+    sa_valid: Any
+    sa_thi: Any
+    sa_tlo: Any
+    sa_auxl: Any  # local seq
+    # burst data segments [PUMP_BURST, S] (client rows; dst = server lane)
     bo_valid: Any
     bo_thi: Any
     bo_tlo: Any
@@ -632,7 +653,12 @@ class _SlotEmit(NamedTuple):
     bo_size: Any
     bo_phi: Any
     bo_plo: Any
-    # burst loss records ([PUMP_BURST, N]; () unless logging+stream)
+    # stream loss records ([2S] slot-0 / [PUMP_BURST, S] burst; () unless
+    # logging+stream)
+    srec_valid: Any
+    srec_time: Any
+    srec_seq: Any
+    srec_size: Any
     brec_valid: Any
     brec_time: Any
     brec_seq: Any
@@ -756,43 +782,60 @@ def _process_slot(
         else false_n
     )
 
-    # ---- stream tier (vectorized lane-TCP; static gate) ------------------
+    # ---- stream tier (COMPACTED lane-TCP on [2S] endpoint rows) ----------
+    # The flow matrices are resident per ENDPOINT (rows 0..S-1 = clients,
+    # S..2S-1 = servers, flow order — lanes_stream.endpoint_cols), so the
+    # whole TCP law runs on a few hundred rows instead of every lane: at
+    # bench scale this removed ~96% of the stream tier's tile work per
+    # slot.  The popped slot columns reach the endpoints through ONE
+    # [N, 9]-row gather; sends/arms leave through compacted channels that
+    # ride the exchange sort (see _merge_append), and per-lane counters
+    # and the up-bucket state round-trip through one row gather + one
+    # masked row scatter (at most one active endpoint per lane per slot,
+    # so the scatter is write-unique).
     if sp:
-        is_cl = model == M_STREAM_CLIENT
-        is_sv = model == M_STREAM_SERVER
-        st_any = is_cl | is_sv
-        flags_in, sseq_in, sack_in = lstr.unpack_pay(phi, plo)
-        stim_open = is_start & is_cl
-        stim_pump = is_loc & (size == lstr.SZ_PUMP) & st_any
-        stim_rto = is_loc & (size == lstr.SZ_RTO) & st_any
+        s2 = int(tb.flow_lanes.shape[0])  # 2S
+        s_flows = s2 // 2
+        el = tb.flow_lanes
+        false_e = jnp.zeros(s2, dtype=bool)
+        pm = jnp.stack(
+            [thi, tlo, kind, src, size, phi, plo,
+             active.astype(i32)], axis=1
+        )
+        pe = pm[el]  # [2S, 8] row gather
+        ethi, etlo = pe[:, 0], pe[:, 1]
+        ekind, esrc = pe[:, 2], pe[:, 3]
+        esize = pe[:, 4]
+        ephi, eplo = pe[:, 5], pe[:, 6]
+        eact = pe[:, 7].astype(bool)
+        is_cl_e = jnp.arange(s2, dtype=i32) < s_flows
+        flags_in, sseq_in, sack_in = lstr.unpack_pay(ephi, eplo)
+        e_loc = eact & (ekind == LOCAL)
+        stim_open = e_loc & (esize == -1) & is_cl_e
+        # RTO locals carry the flow's client lane in the payload word:
+        # that also picks WHICH flow of a shared server lane owns it
+        stim_rto = e_loc & (esize == lstr.SZ_RTO) & (eplo == tb.flow_clid)
         # zero payload words mark a foreign (non-ltcp) datagram delivered
         # to a stream lane in a mixed workload: every real segment carries
         # flags != 0.  The CPU oracle ignores those via its isinstance
-        # check (tcpflow.StreamServer.on_delivery) — mirror it exactly
-        stim_seg = is_del & st_any & ((phi | plo) != 0)
-        stream_stim = stim_open | stim_pump | stim_rto | stim_seg
-        # flow id: the client lane (delivery src at the server, payload
-        # word on server locals, own lane otherwise).  In one-to-one mode
-        # the server's flow is a static table lookup — no payload read
-        if p.stream_one_to_one:
-            flow = jnp.where(is_sv, tb.st_cl_of, lanes)
-        else:
-            flow = jnp.where(
-                is_sv, jnp.where(stim_seg, src, plo), lanes
-            )
-        server_mask = stream_stim & is_sv
-        f = lstr.gather_cols(
-            s.stream, flow, server_mask, tb.st_segs, tb.st_mss, tb.st_last,
-            p.stream_one_to_one,
+        # check (tcpflow.StreamServer.on_delivery) — mirror it exactly.
+        # Server endpoints answer only their own client's segments (the
+        # scalar law keys server flows by src); client endpoints keep the
+        # oracle's isinstance-only check
+        stim_seg = (
+            eact & (ekind == DELIVERY) & ((ephi | eplo) != 0)
+            & (is_cl_e | (esrc == tb.flow_clid))
         )
-        f1, em1 = lstr.open_flow_vec(f, thi, tlo, stim_open)
+        stream_stim = stim_open | stim_rto | stim_seg
+        f = lstr.endpoint_cols(
+            s.stream, tb.flow_segs, tb.flow_mss, tb.flow_last
+        )
+        f1, em1 = lstr.open_flow_vec(f, ethi, etlo, stim_open)
         f = lstr._merge_cols(f, f1, stim_open)
-        # stim_pump (a legacy arm; never queued under the burst law) has no
-        # primary effect — the shared epilogue below IS the scalar on_pump
-        f3, em3 = lstr.on_rto_vec(f, thi, tlo, stim_rto)
+        f3, em3 = lstr.on_rto_vec(f, ethi, etlo, stim_rto)
         f = lstr._merge_cols(f, f3, stim_rto)
         f4, em4 = lstr.on_segment_vec(
-            f, thi, tlo, stim_seg, flags_in, sseq_in, sack_in, size
+            f, ethi, etlo, stim_seg, flags_in, sseq_in, sack_in, esize
         )
         f = lstr._merge_cols(f, f4, stim_seg)
         sem = lstr._merge_emit(
@@ -806,26 +849,17 @@ def _process_slot(
         # a burst of up to PUMP_BURST window-permitted data segments
         # (scalar _pump_units) — the law that removed pump LOCAL events
         f, sem, st_burst = lstr.pump_epilogue_vec(
-            f, thi, tlo, stream_stim, sem
+            f, ethi, etlo, stream_stim, sem
         )
-        stream_state = lstr.scatter_cols(
-            s.stream, f, flow, stream_stim & ~server_mask, server_mask,
-            p.stream_one_to_one,
-        )
-        s = s._replace(stream=stream_state)
+        s = s._replace(stream=lstr.endpoint_split(f))
         st_send = sem.send_valid & stream_stim
         st_rto = sem.rto_valid & stream_stim
-    else:
-        st_send = st_rto = false_n
-        st_burst = []
-        sem = None
-        flow = lanes
-        is_sv = false_n
 
-    # ---- unified send channel (≤1 send per lane per slot) ----------------
+    # ---- unified send channel (≤1 send per lane per slot; stream lanes
+    # send through the compacted channels below, not this one) ------------
     send_phold = del_send_phold | loc_send_phold
     do_send = (
-        send_phold | del_send_echo | mesh_tick | client_tick | ping_tick | st_send
+        send_phold | del_send_echo | mesh_tick | client_tick | ping_tick
     )
 
     # phold peer draw (consumes an app draw only where it happens; traced
@@ -861,18 +895,7 @@ def _process_slot(
         ),
     ).astype(i32)
     out_size = jnp.where(del_send_echo, size, tb.p_size).astype(i32)
-    if sp:
-        # server sends go to the flow's client lane; clients to p_peer
-        dst = jnp.where(st_send, jnp.where(is_sv, flow, tb.p_peer), dst).astype(i32)
-        out_size = jnp.where(st_send, sem.send_size, out_size).astype(i32)
-        pk_phi, pk_plo = lstr.pack_pay(
-            sem.send_flags, sem.send_seq, sem.send_ack
-        )
-        z32n = jnp.zeros(n, dtype=i32)
-        out_phi = jnp.where(st_send, pk_phi, z32n)
-        out_plo = jnp.where(st_send, pk_plo, z32n)
-    else:
-        out_phi = out_plo = jnp.zeros(n, dtype=i32)
+    out_phi = out_plo = jnp.zeros(n, dtype=i32)
 
     # per-send sequence numbers
     snd_seq = s.send_seq
@@ -934,20 +957,81 @@ def _process_slot(
     else:
         pc_valid = pc_time = pc_dst = pc_seq = pc_size = ()
 
-    # ---- stream burst channel (the epilogue's data segments) -------------
-    # Each burst unit charges the up bucket and draws loss IN ORDER after
-    # the slot-0 send, exactly like the CPU driver's per-api.send sequence;
-    # engine send seqs rank slot-0 first, then the burst prefix.  A scan
-    # over units: rolled on XLA:CPU, fully unrolled on the accelerator.
+    # ---- compacted stream send/arm channels ([2S] and [B, S]) ------------
+    # Slot-0 control send, then the burst's data segments, charging the
+    # endpoint lane's up bucket and drawing losses IN ORDER exactly like
+    # the CPU driver's per-api.send sequence; engine send seqs rank
+    # slot-0 first, then the burst prefix.  Per-lane counters and bucket
+    # state round-trip through one row gather + one write-unique scatter.
     if sp:
-        b_dst = tb.p_peer  # client lanes only (role-gated by the law)
-        b_node = tb.node_of[b_dst]
-        b_lat = tb.lat[my_node, b_node]
+        lane_mat = jnp.stack(
+            [s.up_tokens, s.up_nr_hi, s.up_nr_lo, s.up_ld_hi, s.up_ld_lo,
+             s.send_seq, s.local_seq, s.n_sends, s.n_loss], axis=1
+        )
+        lm = lane_mat[el]  # [2S, 9] row gather
+        g_tok, g_nrh, g_nrl = lm[:, 0], lm[:, 1], lm[:, 2]
+        g_ldh, g_ldl = lm[:, 3], lm[:, 4]
+        g_sseq, g_lseq = lm[:, 5], lm[:, 6]
+        g_nsend, g_nloss = lm[:, 7], lm[:, 8]
+
+        # slot-0 control send
+        se_size = sem.send_size
+        se_bits = (se_size + FRAME_OVERHEAD_BYTES) * 8
+        g_tok, g_nrh, g_nrl, g_ldh, g_ldl, se_dep_hi, se_dep_lo = (
+            bucket_charge_vec(
+                g_tok, g_nrh, g_nrl, g_ldh, g_ldl,
+                tb.flow_up_rate, tb.flow_up_burst, tb.flow_up_kfull,
+                tb.flow_up_kfi, ethi, etlo, se_bits, st_send,
+                p.bucket_interval,
+            )
+        )
+        se_seq = g_sseq
+        g_sseq = g_sseq + st_send
+        g_nsend = g_nsend + st_send
         if p.has_loss:
-            b_thresh_u32 = tb.thresh_u32[my_node, b_node]
-            b_thresh_all = tb.thresh_all[my_node, b_node]
             bs_hi2, bs_lo2 = p.bootstrap_end >> 31, p.bootstrap_end & MASK31
-            past_bs = pair_ge(thi, tlo, bs_hi2, bs_lo2)
+            e_past_bs = pair_ge(ethi, etlo, bs_hi2, bs_lo2)
+            eu = rand_u32_lane(
+                p.seed,
+                (el.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
+                se_seq,
+            )
+            se_lost = st_send & e_past_bs & (
+                tb.flow_thresh_all | (eu < tb.flow_thresh_u32)
+            )
+            g_nloss = g_nloss + se_lost
+        else:
+            se_lost = false_e
+        if p.dynamic_runahead:
+            s = s._replace(min_used_lat=jnp.minimum(
+                s.min_used_lat,
+                jnp.min(jnp.where(st_send, tb.flow_lat, NEVER32)),
+            ))
+        se_thi, se_tlo = pair_max(
+            *pair_add32(se_dep_hi, se_dep_lo, tb.flow_lat), we_hi, we_lo
+        )
+        se_valid = st_send & ~se_lost
+        se_phi, se_plo = lstr.pack_pay(
+            sem.send_flags, sem.send_seq, sem.send_ack
+        )
+
+        # RTO arm channel (LOCAL self-insert at the endpoint lane)
+        sa_valid = st_rto
+        sa_thi, sa_tlo = sem.rto_thi, sem.rto_tlo
+        sa_auxl = g_lseq
+        g_lseq = g_lseq + sa_valid
+
+        # burst chain on the CLIENT half only (the law's role gate makes
+        # server rows' bursts empty)
+        cl_sl = slice(0, s_flows)
+        b_lat_c = tb.flow_lat[cl_sl]
+        cthi, ctlo = ethi[cl_sl], etlo[cl_sl]
+        false_c = jnp.zeros(s_flows, dtype=bool)
+        if p.has_loss:
+            b_thresh_u32 = tb.flow_thresh_u32[cl_sl]
+            b_thresh_all = tb.flow_thresh_all[cl_sl]
+            c_past_bs = e_past_bs[cl_sl]
+        cl_lanes_u32 = el[cl_sl].astype(jnp.uint32)
 
         def bstep_body(carry, cols, first: bool):
             tok, nrh, nrl, ldh, ldl, nloss, mul, sent_before = carry
@@ -960,37 +1044,38 @@ def _process_slot(
                 tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = (
                     bucket_charge_vec(
                         tok, nrh, nrl, ldh, ldl,
-                        tb.up_rate, tb.up_burst, tb.up_kfull, tb.up_kfi,
-                        thi, tlo, bbits, bm, p.bucket_interval,
+                        tb.flow_up_rate[cl_sl], tb.flow_up_burst[cl_sl],
+                        tb.flow_up_kfull[cl_sl], tb.flow_up_kfi[cl_sl],
+                        cthi, ctlo, bbits, bm, p.bucket_interval,
                     )
                 )
             else:
                 tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = (
                     bucket_charge_chained_vec(
-                        tok, nrh, nrl, ldh, ldl, tb.up_rate, tb.up_burst,
-                        bbits, bm, p.bucket_interval, thi, tlo,
+                        tok, nrh, nrl, ldh, ldl, tb.flow_up_rate[cl_sl],
+                        tb.flow_up_burst[cl_sl], bbits, bm,
+                        p.bucket_interval, cthi, ctlo,
                     )
                 )
-            bseq = snd_seq + sent_before
+            bseq = se_seq[cl_sl] + sent_before
             if p.has_loss:
                 bu = rand_u32_lane(
                     p.seed,
-                    (lanes.astype(jnp.uint32)
-                     | jnp.uint32(rng_mod.LOSS_STREAM)),
+                    (cl_lanes_u32 | jnp.uint32(rng_mod.LOSS_STREAM)),
                     bseq,
                 )
-                blost = bm & past_bs & (
+                blost = bm & c_past_bs & (
                     b_thresh_all | (bu < b_thresh_u32)
                 )
                 nloss = nloss + blost
             else:
-                blost = false_n
+                blost = false_c
             if p.dynamic_runahead:
                 mul = jnp.minimum(
-                    mul, jnp.min(jnp.where(bm, b_lat, NEVER32))
+                    mul, jnp.min(jnp.where(bm, b_lat_c, NEVER32))
                 )
             barr_hi, barr_lo = pair_max(
-                *pair_add32(bdep_hi, bdep_lo, b_lat), we_hi, we_lo
+                *pair_add32(bdep_hi, bdep_lo, b_lat_c), we_hi, we_lo
             )
             bphi, bplo = lstr.pack_pay(bflags, bunit, back)
             outs = (
@@ -1001,13 +1086,15 @@ def _process_slot(
                     sent_before + bm), outs
 
         carry0 = (
-            s.up_tokens, s.up_nr_hi, s.up_nr_lo, s.up_ld_hi, s.up_ld_lo,
-            s.n_loss, s.min_used_lat, do_send.astype(i32),
+            g_tok[cl_sl], g_nrh[cl_sl], g_nrl[cl_sl], g_ldh[cl_sl],
+            g_ldl[cl_sl], g_nloss[cl_sl], s.min_used_lat,
+            st_send[cl_sl].astype(i32),
         )
-        first_cols = jax.tree.map(lambda a: a[0], st_burst)
-        rest_cols = jax.tree.map(lambda a: a[1:], st_burst)
+        st_burst_c = jax.tree.map(lambda a: a[:, cl_sl], tuple(st_burst))
+        first_cols = jax.tree.map(lambda a: a[0], st_burst_c)
+        rest_cols = jax.tree.map(lambda a: a[1:], st_burst_c)
         carry, out0 = bstep_body(carry0, first_cols, True)
-        n_rest = st_burst[0].shape[0] - 1
+        n_rest = st_burst_c[0].shape[0] - 1
         if n_rest:
             carry, bouts_rest = scan_or_unroll(
                 lambda c, x: bstep_body(c, x, False), carry, rest_cols,
@@ -1019,27 +1106,63 @@ def _process_slot(
             )
         else:
             bouts = jax.tree.map(lambda a0: a0[None], out0)
-        (tok, nrh, nrl, ldh, ldl, nloss, mul, sent_after) = carry
-        s = s._replace(
-            up_tokens=tok, up_nr_hi=nrh, up_nr_lo=nrl,
-            up_ld_hi=ldh, up_ld_lo=ldl, n_loss=nloss, min_used_lat=mul,
+        (tok_c, nrh_c, nrl_c, ldh_c, ldl_c, nloss_c, mul, sent_after) = carry
+        if p.dynamic_runahead:
+            s = s._replace(min_used_lat=mul)
+        sv_sl = slice(s_flows, s2)
+        g_tok = jnp.concatenate([tok_c, g_tok[sv_sl]])
+        g_nrh = jnp.concatenate([nrh_c, g_nrh[sv_sl]])
+        g_nrl = jnp.concatenate([nrl_c, g_nrl[sv_sl]])
+        g_ldh = jnp.concatenate([ldh_c, g_ldh[sv_sl]])
+        g_ldl = jnp.concatenate([ldl_c, g_ldl[sv_sl]])
+        g_nloss = jnp.concatenate([nloss_c, g_nloss[sv_sl]])
+        burst_total = sent_after - st_send[cl_sl].astype(i32)
+        g_sseq = g_sseq + jnp.concatenate(
+            [burst_total, jnp.zeros(s_flows, dtype=i32)]
         )
-        burst_total = sent_after - do_send.astype(i32)
-        s = s._replace(
-            send_seq=s.send_seq + burst_total, n_sends=s.n_sends + burst_total
+        g_nsend = g_nsend + jnp.concatenate(
+            [burst_total, jnp.zeros(s_flows, dtype=i32)]
         )
+
+        # write-back: one masked row scatter (at most one endpoint of a
+        # lane is stimulated per slot, so indices are write-unique)
+        new_rows = jnp.stack(
+            [g_tok, g_nrh, g_nrl, g_ldh, g_ldl, g_sseq, g_lseq, g_nsend,
+             g_nloss], axis=1
+        )
+        sc_idx = jnp.where(stream_stim, el, jnp.int32(n))
+        lane_mat = lane_mat.at[sc_idx].set(new_rows, mode="drop")
+        s = s._replace(
+            up_tokens=lane_mat[:, 0], up_nr_hi=lane_mat[:, 1],
+            up_nr_lo=lane_mat[:, 2], up_ld_hi=lane_mat[:, 3],
+            up_ld_lo=lane_mat[:, 4], send_seq=lane_mat[:, 5],
+            local_seq=lane_mat[:, 6], n_sends=lane_mat[:, 7],
+            n_loss=lane_mat[:, 8],
+        )
+
         (bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
-         blost_all) = bouts  # [B, N] each
+         blost_all) = bouts  # [B, S] each
         if p.log_capacity:
+            et64 = t_join(ethi, etlo)
+            srec_valid = se_lost
+            srec_time = et64
+            srec_seq = se_seq.astype(i64)
+            srec_size = se_size.astype(i64)
             bb = bo_valid.shape[0]
             brec_valid = blost_all
-            brec_time = jnp.broadcast_to(t64[None, :], (bb, n))
+            brec_time = jnp.broadcast_to(et64[cl_sl][None, :],
+                                         (bb, s_flows))
             brec_seq = bo_auxl.astype(i64)
             brec_size = bo_size.astype(i64)
         else:
+            srec_valid = srec_time = srec_seq = srec_size = ()
             brec_valid = brec_time = brec_seq = brec_size = ()
     else:
+        se_valid = se_thi = se_tlo = se_phi = se_plo = ()
+        se_seq = se_size = ()
+        sa_valid = sa_thi = sa_tlo = sa_auxl = ()
         bo_valid = bo_thi = bo_tlo = bo_auxl = bo_size = bo_phi = bo_plo = ()
+        srec_valid = srec_time = srec_seq = srec_size = ()
         brec_valid = brec_time = brec_seq = brec_size = ()
 
     # ---- local arm channels ---------------------------------------------
@@ -1058,23 +1181,12 @@ def _process_slot(
     arm_thi, arm_tlo = ti_hi, ti_lo
     arm_size = jnp.zeros(n, dtype=i32)
     arm_plo = jnp.zeros(n, dtype=i32)
-    loc_auxh = pack_aux_hi(jnp.full(n, LOCAL, dtype=i32), lanes)
-    arm_auxh = loc_auxh
+    arm_auxh = pack_aux_hi(jnp.full(n, LOCAL, dtype=i32), lanes)
     arm_auxl = s.local_seq
     s = s._replace(local_seq=s.local_seq + rearm)
-    # stream RTO arm consumes the NEXT local_seq (the CPU driver arms the
-    # pump before the RTO inside one stimulus)
-    arm2_valid = st_rto
-    if sp:
-        arm2_thi, arm2_tlo = sem.rto_thi, sem.rto_tlo
-        arm2_plo = jnp.where(st_rto, flow, 0)
-        s = s._replace(local_seq=s.local_seq + arm2_valid)
-    else:
-        arm2_thi = jnp.zeros(n, dtype=i32)
-        arm2_tlo = jnp.zeros(n, dtype=i32)
-        arm2_plo = arm_plo
-    arm2_auxh = loc_auxh
-    arm2_auxl = s.local_seq
+    # (stream RTO arms ride the compacted sa_* channel above: stream
+    # lanes never take this generic timer re-arm, so their local_seq is
+    # consumed only through the gathered counters)
 
     # ---- log record (≤1 per slot: packet outcome, or send loss) ----------
     rec_valid = pk_rec_valid | lost
@@ -1093,10 +1205,12 @@ def _process_slot(
         ins_valid, ins_thi, ins_tlo, ins_auxh, ins_auxl, ins_size, ins_phi,
         ins_plo,
         rearm, arm_thi, arm_tlo, arm_auxh, arm_auxl, arm_size, arm_plo,
-        arm2_valid, arm2_thi, arm2_tlo, arm2_auxh, arm2_auxl, arm2_plo,
         out_valid, dst, arr_hi, arr_lo, out_auxh, out_auxl, out_size,
         out_phi, out_plo,
+        se_valid, se_thi, se_tlo, se_seq, se_size, se_phi, se_plo,
+        sa_valid, sa_thi, sa_tlo, sa_auxl,
         bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
+        srec_valid, srec_time, srec_seq, srec_size,
         brec_valid, brec_time, brec_seq, brec_size,
         pc_valid, pc_time, pc_dst, pc_seq, pc_size,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
@@ -1197,15 +1311,6 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
         size_parts = [emits.ins_size.T, emits.arm_size.T]
         phi_parts = [emits.ins_phi.T, jnp.zeros_like(emits.arm_plo.T)]
         plo_parts = [emits.ins_plo.T, emits.arm_plo.T]
-    if sp:
-        self_parts.append(emits.arm2_valid.T)
-        thi_parts.append(emits.arm2_thi.T)
-        tlo_parts.append(emits.arm2_tlo.T)
-        auxh_parts.append(emits.arm2_auxh.T)
-        auxl_parts.append(emits.arm2_auxl.T)
-        size_parts.append(jnp.full_like(emits.ins_size.T, lstr.SZ_RTO))
-        phi_parts.append(jnp.zeros_like(emits.arm2_plo.T))
-        plo_parts.append(emits.arm2_plo.T)
     self_valid = jnp.concatenate(self_parts, axis=1)
     self_thi = jnp.where(self_valid, jnp.concatenate(thi_parts, axis=1), NEVER32)
     self_tlo = jnp.where(self_valid, jnp.concatenate(tlo_parts, axis=1), NEVER32)
@@ -1222,41 +1327,94 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     out_tlo = emits.out_tlo.reshape(-1)
     flat_ops = [dst, out_thi, out_tlo, emits.out_auxh.reshape(-1),
                 emits.out_auxl.reshape(-1), emits.out_size.reshape(-1)]
-    if sp:
+    # one-to-one stream configs take the SPLIT exchange: every stream
+    # channel entry's destination is static (each lane has one flow, one
+    # role), so stream events skip the flat sort entirely and merge
+    # through a tiny [2S, C+W] row sort below (_merge_stream_rows); the
+    # big exchange then carries only the [N]-wide model sends, with
+    # all-zero payloads.  Star-shaped configs (several clients per
+    # server) keep the combined exchange: their per-lane fan-in is not
+    # static.  Which path an event rides is unobservable — placement is
+    # by the keyed merge either way.
+    split_se = sp and p.stream_one_to_one
+    if sp and not split_se:
         flat_ops.append(emits.out_phi.reshape(-1))
         flat_ops.append(emits.out_plo.reshape(-1))
-        if p.stream_clients:
-            # the burst channel, COMPACTED to the static client lanes
-            # (the law's role gate makes all other rows invalid): a few
-            # thousand extra sort entries instead of N*K*PUMP_BURST
-            import numpy as _np
+        # the COMPACTED stream channels join the exchange here: slot-0
+        # control sends (dst = peer lane), burst data segments (dst =
+        # server lane), and RTO self-arms (dst = OWN lane, kind LOCAL) —
+        # a few thousand extra sort entries against static flow tables
+        # instead of [N]-wide channels.  All placement is by the keyed
+        # merge sort, so which channel an event rides is unobservable.
+        kk, s2 = emits.se_valid.shape
+        s_flows = s2 // 2
+        bb = emits.bo_valid.shape[1]
 
-            ci = _np.asarray(p.stream_clients, dtype=_np.int32)
-            kk, bb, _nn = emits.bo_valid.shape
-            nc = ci.shape[0]
-            bv = emits.bo_valid[:, :, ci].reshape(-1)
-            peer_ci = jnp.broadcast_to(
-                tb.p_peer[ci][None, None, :], (kk, bb, nc)
+        def bc2(table):  # [2S] static -> [K*2S] flat
+            return jnp.broadcast_to(table[None, :], (kk, s2)).reshape(-1)
+
+        def bcb(table):  # [S] static -> [K*B*S] flat
+            return jnp.broadcast_to(
+                table[None, None, :], (kk, bb, s_flows)
             ).reshape(-1)
-            b_dst = jnp.where(bv, peer_ci, jnp.int32(n))
-            src_ci = jnp.broadcast_to(
-                jnp.asarray(ci)[None, None, :], (kk, bb, nc)
-            ).reshape(-1)
-            b_auxh = pack_aux_hi(jnp.full(b_dst.shape, PACKET,
-                                          dtype=jnp.int32), src_ci)
-            extras = [
-                b_dst,
-                emits.bo_thi[:, :, ci].reshape(-1),
-                emits.bo_tlo[:, :, ci].reshape(-1),
-                b_auxh,
-                emits.bo_auxl[:, :, ci].reshape(-1),
-                emits.bo_size[:, :, ci].reshape(-1),
-                emits.bo_phi[:, :, ci].reshape(-1),
-                emits.bo_plo[:, :, ci].reshape(-1),
-            ]
-            flat_ops = [
-                jnp.concatenate([a, b]) for a, b in zip(flat_ops, extras)
-            ]
+
+        se_v = emits.se_valid.reshape(-1)
+        sa_v = emits.sa_valid.reshape(-1)
+        bo_v = emits.bo_valid.reshape(-1)
+        pkt_auxh_e = pack_aux_hi(
+            jnp.full(s2, PACKET, dtype=jnp.int32), tb.flow_lanes
+        )
+        loc_auxh_e = pack_aux_hi(
+            jnp.full(s2, LOCAL, dtype=jnp.int32), tb.flow_lanes
+        )
+        bo_auxh_c = pack_aux_hi(
+            jnp.full(s_flows, PACKET, dtype=jnp.int32),
+            tb.flow_lanes[:s_flows],
+        )
+        extras = [
+            # dst
+            jnp.concatenate([
+                jnp.where(se_v, bc2(tb.flow_peers), jnp.int32(n)),
+                jnp.where(sa_v, bc2(tb.flow_lanes), jnp.int32(n)),
+                jnp.where(bo_v, bcb(tb.flow_peers[:s_flows]), jnp.int32(n)),
+            ]),
+            # thi / tlo
+            jnp.concatenate([
+                emits.se_thi.reshape(-1), emits.sa_thi.reshape(-1),
+                emits.bo_thi.reshape(-1),
+            ]),
+            jnp.concatenate([
+                emits.se_tlo.reshape(-1), emits.sa_tlo.reshape(-1),
+                emits.bo_tlo.reshape(-1),
+            ]),
+            # auxh / auxl
+            jnp.concatenate([
+                bc2(pkt_auxh_e), bc2(loc_auxh_e), bcb(bo_auxh_c),
+            ]),
+            jnp.concatenate([
+                emits.se_seq.reshape(-1), emits.sa_auxl.reshape(-1),
+                emits.bo_auxl.reshape(-1),
+            ]),
+            # size (RTO arms carry the SZ_RTO marker)
+            jnp.concatenate([
+                emits.se_size.reshape(-1),
+                jnp.full(kk * s2, lstr.SZ_RTO, dtype=jnp.int32),
+                emits.bo_size.reshape(-1),
+            ]),
+            # phi / plo (arms carry the flow's client lane in plo)
+            jnp.concatenate([
+                emits.se_phi.reshape(-1),
+                jnp.zeros(kk * s2, dtype=jnp.int32),
+                emits.bo_phi.reshape(-1),
+            ]),
+            jnp.concatenate([
+                emits.se_plo.reshape(-1), bc2(tb.flow_clid),
+                emits.bo_plo.reshape(-1),
+            ]),
+        ]
+        flat_ops = [
+            jnp.concatenate([a, b]) for a, b in zip(flat_ops, extras)
+        ]
     # the sort need not be stable: within a destination's segment the real
     # entries carry the 4-word event key, a TOTAL order (ties impossible
     # between distinct events), and the merge sort below re-orders by that
@@ -1271,7 +1429,7 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
         tuple(flat_ops), dimension=0, num_keys=1, is_stable=False
     )
     _dst_s, thi_s, tlo_s, auxh_s, auxl_s, size_s = sorted_ops[:6]
-    pay_s = sorted_ops[6:8] if sp else None
+    pay_s = sorted_ops[6:8] if sp and not split_se else None
     # segment bounds per destination lane.  NOT jnp.searchsorted — the
     # vmapped binary search lowers to a nested lax.while_loop (~15
     # sequential sub-iterations with gathers) inside the hot body.  The
@@ -1316,8 +1474,9 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     cx = p.cross_cap
     r = jnp.arange(cx, dtype=jnp.int32)[None, :]  # [1, Cx]
     in_seg = r < cnt[:, None]
+    has_pay_flat = sp and not split_se
     gather_ops = [thi_s, tlo_s, auxh_s, auxl_s, size_s] + (
-        list(pay_s) if sp else []
+        list(pay_s) if has_pay_flat else []
     )
     gathered = _window_gather(gather_ops, start, cx)
     g_thi, g_tlo, g_auxh, g_auxl, g_size = gathered[:5]
@@ -1327,8 +1486,13 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     cross_auxl = jnp.where(in_seg, g_auxl, 0).astype(jnp.int32)
     cross_size = jnp.where(in_seg, g_size, 0).astype(jnp.int32)
     if sp:
-        cross_phi = jnp.where(in_seg, gathered[5], 0)
-        cross_plo = jnp.where(in_seg, gathered[6], 0)
+        if has_pay_flat:
+            cross_phi = jnp.where(in_seg, gathered[5], 0)
+            cross_plo = jnp.where(in_seg, gathered[6], 0)
+        else:
+            # split exchange: the [N] channel never carries payloads
+            cross_phi = jnp.zeros((n, cx), dtype=jnp.int32)
+            cross_plo = jnp.zeros((n, cx), dtype=jnp.int32)
     # receivers of more than Cx events in one iteration lose the tail
     # before the merge even sees it; count those drops too
     lost_pre = jnp.maximum(cnt - cx, 0)
@@ -1369,17 +1533,144 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     # only in n_queue; both paths raise in strict mode).  Only materialized
     # when logging is on: the int64 joins are edge work the bench never pays
     if p.log_capacity == 0:
+        over_rec = None
+    else:
+        t_tail = t_join(mthi[:, c:], mtlo[:, c:])
+        o_kind, o_src = unpack_aux_hi(mh[:, c:])
+        rows = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int64)[:, None], tail_mask.shape
+        )
+        over_rec = {
+            "valid": tail_mask.reshape(-1),
+            "time": t_tail.reshape(-1),
+            "src": o_src.reshape(-1).astype(i64),
+            "dst": rows.reshape(-1),
+            "seq": ml[:, c:].reshape(-1).astype(i64),
+            "size": ms[:, c:].reshape(-1).astype(i64),
+            "outcome": jnp.full(tail_mask.size, DROP_QUEUE, dtype=i64),
+        }
+    if split_se:
+        s, over_b = _merge_stream_rows(p, tb, s, emits)
+        if over_rec is not None and over_b is not None:
+            over_rec = {
+                k: jnp.concatenate([over_rec[k], over_b[k]])
+                for k in over_rec
+            }
+    return s, over_rec
+
+
+def _merge_stream_rows(p: LaneParams, tb: LaneTables, s: LaneState,
+                       emits: _SlotEmit):
+    """Split-exchange merge of the compacted stream channels, for
+    one-to-one configs: every channel entry's destination LANE is static
+    (client row s receives its server's control sends + its own arms;
+    server row s receives its client's control sends + bursts + its own
+    arms), so the candidate block is pure reshaping — no flat sort, no
+    histogram, no window gather — and one [2S, C + W] row sort merges it
+    into the stream lanes' queue rows (gathered and scattered back by the
+    static ``flow_lanes`` indices).
+
+    Two-stage overflow note: events shed by the MAIN merge cannot be
+    revived here; strict mode (the default) raises on any shed either
+    way, and non-strict overflow is documented non-parity."""
+    n, c = p.n_lanes, p.capacity
+    i64 = jnp.int64
+    kk, s2 = emits.se_valid.shape
+    s_flows = s2 // 2
+    bb = emits.bo_valid.shape[1]
+    el = tb.flow_lanes  # [2S] unique in one-to-one mode
+
+    never_kb = jnp.full((s_flows, kk * bb), NEVER32, dtype=jnp.int32)
+    zero_kb = jnp.zeros((s_flows, kk * bb), dtype=jnp.int32)
+
+    def chan(arr_se, arr_sa, arr_bo, pad_cl):
+        """Build the [2S, W] candidate block (W = K + K + K*B): client
+        rows take the SERVER half of se (their peer's sends), the CLIENT
+        half of sa (their own arms), and padding; server rows take the
+        client half of se, the server half of sa, and the bursts."""
+        se_cl = arr_se[:, s_flows:].T  # [S, K]
+        se_sv = arr_se[:, :s_flows].T
+        sa_cl = arr_sa[:, :s_flows].T
+        sa_sv = arr_sa[:, s_flows:].T
+        bo_sv = jnp.moveaxis(arr_bo, 2, 0).reshape(s_flows, kk * bb)
+        cl_rows = jnp.concatenate([se_cl, sa_cl, pad_cl], axis=1)
+        sv_rows = jnp.concatenate([se_sv, sa_sv, bo_sv], axis=1)
+        return jnp.concatenate([cl_rows, sv_rows], axis=0)  # [2S, W]
+
+    v = chan(emits.se_valid, emits.sa_valid, emits.bo_valid,
+             jnp.zeros((s_flows, kk * bb), dtype=bool))
+    cthi = chan(emits.se_thi, emits.sa_thi, emits.bo_thi, never_kb)
+    ctlo = chan(emits.se_tlo, emits.sa_tlo, emits.bo_tlo, never_kb)
+    cauxl = chan(emits.se_seq, emits.sa_auxl, emits.bo_auxl, zero_kb)
+    csize = chan(
+        emits.se_size,
+        jnp.full((kk, s2), lstr.SZ_RTO, dtype=jnp.int32),
+        emits.bo_size, zero_kb,
+    )
+    cphi = chan(emits.se_phi, jnp.zeros((kk, s2), dtype=jnp.int32),
+                emits.bo_phi, zero_kb)
+    cplo = chan(
+        emits.se_plo,
+        jnp.broadcast_to(tb.flow_clid[None, :], (kk, s2)),
+        emits.bo_plo, zero_kb,
+    )
+    # aux-hi words are fully static per position: se entries are PACKETs
+    # from the peer lane, sa entries LOCALs from the own lane, bursts
+    # PACKETs from the client lane
+    pk = jnp.full(s2, PACKET, dtype=jnp.int32)
+    lc = jnp.full(s2, LOCAL, dtype=jnp.int32)
+    se_auxh = pack_aux_hi(pk, el)  # indexed by SENDER endpoint
+    sa_auxh = pack_aux_hi(lc, el)
+    bo_auxh_c = pack_aux_hi(pk[:s_flows], el[:s_flows])
+    cauxh = chan(
+        jnp.broadcast_to(se_auxh[None, :], (kk, s2)),
+        jnp.broadcast_to(sa_auxh[None, :], (kk, s2)),
+        jnp.broadcast_to(bo_auxh_c[None, None, :], (kk, bb, s_flows)),
+        zero_kb,
+    )
+    cthi = jnp.where(v, cthi, NEVER32)
+    ctlo = jnp.where(v, ctlo, NEVER32)
+
+    # gather the stream lanes' queue rows, merge, keep first C, scatter
+    q_rows = [a[el] for a in (s.q_thi, s.q_tlo, s.q_auxh, s.q_auxl,
+                              s.q_size, s.q_phi, s.q_plo)]
+    mthi, mtlo, mh, ml, ms, mphi, mplo = lax.sort(
+        (
+            jnp.concatenate([q_rows[0], cthi], axis=1),
+            jnp.concatenate([q_rows[1], ctlo], axis=1),
+            jnp.concatenate([q_rows[2], cauxh], axis=1),
+            jnp.concatenate([q_rows[3], cauxl], axis=1),
+            jnp.concatenate([q_rows[4], csize], axis=1),
+            jnp.concatenate([q_rows[5], cphi], axis=1),
+            jnp.concatenate([q_rows[6], cplo], axis=1),
+        ),
+        dimension=1, num_keys=4, is_stable=False,
+    )
+    tail_mask = mthi[:, c:] != NEVER32
+    s = s._replace(
+        q_thi=s.q_thi.at[el].set(mthi[:, :c]),
+        q_tlo=s.q_tlo.at[el].set(mtlo[:, :c]),
+        q_auxh=s.q_auxh.at[el].set(mh[:, :c]),
+        q_auxl=s.q_auxl.at[el].set(ml[:, :c]),
+        q_size=s.q_size.at[el].set(ms[:, :c]),
+        q_phi=s.q_phi.at[el].set(mphi[:, :c]),
+        q_plo=s.q_plo.at[el].set(mplo[:, :c]),
+        n_queue=s.n_queue.at[el].add(
+            tail_mask.sum(axis=1, dtype=jnp.int32)
+        ),
+    )
+    if p.log_capacity == 0:
         return s, None
     t_tail = t_join(mthi[:, c:], mtlo[:, c:])
-    o_kind, o_src = unpack_aux_hi(mh[:, c:])
-    rows = jnp.broadcast_to(
-        jnp.arange(n, dtype=jnp.int64)[:, None], tail_mask.shape
+    _k, o_src = unpack_aux_hi(mh[:, c:])
+    rows64 = jnp.broadcast_to(
+        el.astype(i64)[:, None], tail_mask.shape
     )
     over_rec = {
         "valid": tail_mask.reshape(-1),
         "time": t_tail.reshape(-1),
         "src": o_src.reshape(-1).astype(i64),
-        "dst": rows.reshape(-1),
+        "dst": rows64.reshape(-1),
         "seq": ml[:, c:].reshape(-1).astype(i64),
         "size": ms[:, c:].reshape(-1).astype(i64),
         "outcome": jnp.full(tail_mask.size, DROP_QUEUE, dtype=i64),
@@ -1540,16 +1831,29 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 if p.stream_present:
                     from ..net import ltcp as _ltcp
 
-                    bshape = (_ltcp.PUMP_BURST, p.n_lanes)
+                    s2 = 2 * len(p.stream_clients)
+                    eb = jnp.zeros(s2, dtype=bool)
+                    ei = jnp.zeros(s2, dtype=jnp.int32)
+                    se = (eb, ei, ei, ei, ei, ei, ei)
+                    sa = (eb, ei, ei, ei)
+                    bshape = (_ltcp.PUMP_BURST, s2 // 2)
                     bo_b = jnp.zeros(bshape, dtype=bool)
                     bo_i = jnp.zeros(bshape, dtype=jnp.int32)
+                    bo = (bo_b, bo_i, bo_i, bo_i, bo_i, bo_i, bo_i)
                     if p.log_capacity:
-                        br_b: Any = bo_b
-                        br_i: Any = jnp.zeros(bshape, dtype=jnp.int64)
+                        e64 = jnp.zeros(s2, dtype=jnp.int64)
+                        b64 = jnp.zeros(bshape, dtype=jnp.int64)
+                        srec = (eb, e64, e64, e64)
+                        brec = (bo_b, b64, b64, b64)
                     else:
-                        br_b = br_i = ()
+                        srec = ((), (), (), ())
+                        brec = ((), (), (), ())
                 else:
-                    bo_b = bo_i = br_b = br_i = ()
+                    se = ((),) * 7
+                    sa = ((),) * 4
+                    bo = ((),) * 7
+                    srec = ((),) * 4
+                    brec = ((),) * 4
                 if p.pcap_any:
                     pc = (nb, z64, z64, z64, z64)
                 else:
@@ -1557,10 +1861,8 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 return st_, _SlotEmit(
                     nb, z32, z32, z32, z32, z32, z32, z32,
                     nb, z32, z32, z32, z32, z32, z32,
-                    nb, z32, z32, z32, z32, z32,
                     nb, z32, z32, z32, z32, z32, z32, z32, z32,
-                    bo_b, bo_i, bo_i, bo_i, bo_i, bo_i, bo_i,
-                    br_b, br_i, br_i, br_i,
+                    *se, *sa, *bo, *srec, *brec,
                     *pc,
                     nb, z64, z64, z64, z64, z64, z64,
                 )
@@ -1588,11 +1890,15 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
             any_new = (
                 jnp.any(emits.ins_valid)
                 | jnp.any(emits.arm_valid)
-                | jnp.any(emits.arm2_valid)
                 | jnp.any(emits.out_valid)
             )
             if p.stream_present:
-                any_new = any_new | jnp.any(emits.bo_valid)
+                any_new = (
+                    any_new
+                    | jnp.any(emits.se_valid)
+                    | jnp.any(emits.sa_valid)
+                    | jnp.any(emits.bo_valid)
+                )
 
             def do_merge(st: LaneState) -> LaneState:
                 st, over_rec = _merge_append(p, tb, st, emits)
@@ -1630,25 +1936,35 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                                     dtype=jnp.int64),
             })
         if p.stream_present and p.log_capacity:
-            # burst-channel loss records (DROP_LOSS at the send instant)
-            kk, bb, _nn = emits.brec_valid.shape
-            lanes64 = jnp.broadcast_to(
-                jnp.arange(p.n_lanes, dtype=jnp.int64)[None, None, :],
-                (kk, bb, p.n_lanes),
-            )
-            peer64 = jnp.broadcast_to(
-                tb.p_peer.astype(jnp.int64)[None, None, :],
-                (kk, bb, p.n_lanes),
-            )
+            # stream loss records (DROP_LOSS at the send instant): slot-0
+            # control sends [K, 2S] and burst data segments [K, B, S],
+            # with lanes/peers from the static flow tables
+            kk, s2 = emits.srec_valid.shape
+            s_flows = s2 // 2
+            el64 = tb.flow_lanes.astype(jnp.int64)
+            pe64 = tb.flow_peers.astype(jnp.int64)
+            s = _append_log(p, s, {
+                "valid": emits.srec_valid.reshape(-1),
+                "time": emits.srec_time.reshape(-1),
+                "src": jnp.broadcast_to(el64[None, :], (kk, s2)).reshape(-1),
+                "dst": jnp.broadcast_to(pe64[None, :], (kk, s2)).reshape(-1),
+                "seq": emits.srec_seq.reshape(-1),
+                "size": emits.srec_size.reshape(-1),
+                "outcome": jnp.full((kk * s2,), DROP_LOSS, dtype=jnp.int64),
+            })
+            kk, bb, _ss = emits.brec_valid.shape
+            shape_b = (kk, bb, s_flows)
             s = _append_log(p, s, {
                 "valid": emits.brec_valid.reshape(-1),
                 "time": emits.brec_time.reshape(-1),
-                "src": lanes64.reshape(-1),
-                "dst": peer64.reshape(-1),
+                "src": jnp.broadcast_to(
+                    el64[:s_flows][None, None, :], shape_b).reshape(-1),
+                "dst": jnp.broadcast_to(
+                    pe64[:s_flows][None, None, :], shape_b).reshape(-1),
                 "seq": emits.brec_seq.reshape(-1),
                 "size": emits.brec_size.reshape(-1),
-                "outcome": jnp.full((kk * bb * p.n_lanes,), DROP_LOSS,
-                                    dtype=jnp.int64),
+                "outcome": jnp.full(
+                    (kk * bb * s_flows,), DROP_LOSS, dtype=jnp.int64),
             })
         return s._replace(iters=s.iters + 1)
 

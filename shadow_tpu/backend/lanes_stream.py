@@ -737,38 +737,33 @@ def _merge_emit(a: StreamEmit, b: StreamEmit, m) -> StreamEmit:
     ])
 
 
-def gather_cols(
-    st: StreamState, flow, server_mask, st_segs, st_mss, st_last,
-    one_to_one: bool,
-):
-    """Unified [N] FlowCols for this slot.
-
-    Client lanes read their own ``cl`` row.  Server lanes read the flow's
-    server row: at the OWN lane in one-to-one mode (no gather — a masked
-    select), at index ``flow`` otherwise (one [N, F] row-gather, which
-    vectorizes where per-element gathers serialize)."""
-    n = flow.shape[0]
-    if one_to_one:
-        sv_rows = st.sv
-    else:
-        sv_rows = st.sv[jnp.clip(flow, 0, n - 1)]
-    src = jnp.where(server_mask[:, None], sv_rows, st.cl)
+def endpoint_cols(st: StreamState, flow_segs, flow_mss, flow_last):
+    """The COMPACTED [2S] FlowCols view of the flow matrices: rows
+    0..S-1 are the S client endpoints, rows S..2S-1 the matching server
+    endpoints (flow slot order).  No per-lane gather/scatter exists any
+    more — the endpoint axis IS the resident layout, so building the
+    view is a concatenate plus column slices, and writing back is a
+    split.  ``flow_*`` are the [2S] static transfer-shape tables (zeros
+    on the server half: its units 0/1 are control segments, like the
+    scalar receiver)."""
+    s_flows = st.cl.shape[0]
+    src = jnp.concatenate([st.cl, st.sv], axis=0)  # [2S, F]
     vals = {name: src[:, col] for name, col in _MATRIX_FIELDS}
     for name, col in _BOOL_FIELDS:
         vals[name] = src[:, col] != 0
-    vals["role"] = jnp.where(server_mask, ltcp.RECEIVER, ltcp.SENDER).astype(
-        jnp.int32
-    )
-    # transfer shape: the client lane's static tables; 0 segs on the server
-    # role (its units 0/1 are control segments, like the scalar receiver)
-    vals["segs"] = jnp.where(server_mask, 0, st_segs)
-    vals["mss"] = jnp.where(server_mask, 0, st_mss)
-    vals["last_bytes"] = jnp.where(server_mask, 0, st_last)
+    role = jnp.concatenate([
+        jnp.full(s_flows, ltcp.SENDER, dtype=jnp.int32),
+        jnp.full(s_flows, ltcp.RECEIVER, dtype=jnp.int32),
+    ])
+    vals["role"] = role
+    vals["segs"] = flow_segs
+    vals["mss"] = flow_mss
+    vals["last_bytes"] = flow_last
     return FlowCols(**vals)
 
 
 def _to_rows(f: FlowCols) -> jnp.ndarray:
-    """FlowCols -> [N, F] matrix rows (column order of the layout)."""
+    """FlowCols -> [2S, F] matrix rows (column order of the layout)."""
     cols = [None] * N_COLS
     for name, col in _MATRIX_FIELDS:
         cols[col] = getattr(f, name)
@@ -777,20 +772,8 @@ def _to_rows(f: FlowCols) -> jnp.ndarray:
     return jnp.stack(cols, axis=1)
 
 
-def scatter_cols(
-    st: StreamState, f: FlowCols, flow, client_mask, server_mask,
-    one_to_one: bool,
-) -> StreamState:
-    """Write the slot's updated FlowCols back: client rows in place under
-    ``client_mask``; server rows in place (one-to-one) or row-scattered at
-    ``flow`` (unique indices: one event per lane per slot, one client lane
-    per flow)."""
-    n = flow.shape[0]
+def endpoint_split(f: FlowCols) -> StreamState:
+    """Inverse of endpoint_cols: [2S] FlowCols -> (cl, sv) matrices."""
     rows = _to_rows(f)
-    cl = jnp.where(client_mask[:, None], rows, st.cl)
-    if one_to_one:
-        sv = jnp.where(server_mask[:, None], rows, st.sv)
-    else:
-        sv_idx = jnp.where(server_mask, flow, n)  # n = dropped
-        sv = st.sv.at[sv_idx].set(rows, mode="drop")
-    return StreamState(cl=cl, sv=sv)
+    s_flows = rows.shape[0] // 2
+    return StreamState(cl=rows[:s_flows], sv=rows[s_flows:])

@@ -180,11 +180,11 @@ class TpuEngine:
         if log_capacity is None:
             log_capacity = 200_000
 
-        # one-to-one stream pairing: when every stream server is the peer
-        # of exactly one client, server flow rows live at the server's own
-        # lane and the per-slot row gather/scatter disappears (the common
-        # shape — the mixed-mesh bench and paired configs)
-        cl_of = np.arange(n, dtype=np.int32)
+        # one-to-one stream pairing (every stream server is the peer of
+        # exactly one client) only affects the POP rule now: flow state is
+        # COMPACTED per flow slot either way (rows 0..S-1 = clients,
+        # S..2S-1 = servers — lanes_stream.endpoint_cols), so the lane
+        # layout no longer depends on the pairing shape
         client_ids = np.nonzero(model == lanes.M_STREAM_CLIENT)[0]
         server_ids = set(np.nonzero(model == lanes.M_STREAM_SERVER)[0].tolist())
         peer_counts: dict[int, int] = {}
@@ -193,9 +193,6 @@ class TpuEngine:
         one_to_one = bool(client_ids.size) and all(
             peer_counts.get(sid, 0) == 1 for sid in server_ids
         ) and all(pid in server_ids for pid in peer_counts)
-        if one_to_one:
-            for cid in client_ids:
-                cl_of[int(p_peer[cid])] = int(cid)
 
         # wide stream co-pop is sound only when every possible lookahead
         # window ends before RTO_MIN (DELIVERY pops then provably insert
@@ -301,6 +298,42 @@ class TpuEngine:
         up_kfull, up_kfi = _kfull(up)
         dn_kfull, dn_kfi = _kfull(dn)
         i32 = jnp.int32
+
+        # COMPACTED stream-flow tables: [2S] endpoint rows (clients then
+        # servers, flow order = ascending client lane) with everything
+        # static per flow precomputed — peer, latency, loss threshold, and
+        # the endpoint lane's up-bucket parameters — so the stream tier
+        # touches no [N]- or [G, G]-shaped table at all.  [2]-placeholder
+        # shapes when no stream models are present.
+        self._s_flows = s_flows = int(client_ids.size)
+        if s_flows:
+            fcl = client_ids.astype(np.int32)
+            fsv = p_peer[fcl].astype(np.int32)
+            el_np = np.concatenate([fcl, fsv])
+            peer_np = np.concatenate([fsv, fcl])
+            lat_np = np.asarray(lat)
+            thr_np = np.asarray(thresh)
+            e_nodes = np.asarray(node_idx)[el_np]
+            p_nodes = np.asarray(node_idx)[peer_np]
+            flow_lat = lat_np[e_nodes, p_nodes].astype(np.int32)
+            flow_thr = thr_np[e_nodes, p_nodes]
+            flow_segs = np.concatenate(
+                [st_segs[fcl], np.zeros(s_flows, dtype=np.int32)]
+            )
+            flow_mss = np.concatenate(
+                [st_mss[fcl], np.zeros(s_flows, dtype=np.int32)]
+            )
+            flow_last = np.concatenate(
+                [st_last[fcl], np.zeros(s_flows, dtype=np.int32)]
+            )
+            flow_clid = np.concatenate([fcl, fcl])
+        else:
+            el_np = peer_np = np.zeros(2, dtype=np.int32)
+            flow_lat = np.zeros(2, dtype=np.int32)
+            flow_thr = np.zeros(2, dtype=np.int64)
+            flow_segs = flow_mss = flow_last = np.zeros(2, dtype=np.int32)
+            flow_clid = np.zeros(2, dtype=np.int32)
+
         self.tables = lanes.LaneTables(
             node_of=jnp.asarray(node_idx, dtype=i32),
             lat=jnp.asarray(lat, dtype=i32),
@@ -324,10 +357,21 @@ class TpuEngine:
             p_count=jnp.asarray(np.minimum(p_count, i32max), dtype=i32),
             p_stride=jnp.asarray(p_stride, dtype=i32),
             codel_div=jnp.asarray(np.array(codel_mod.CODEL_DIV, dtype=np.int32)),
-            st_segs=jnp.asarray(st_segs),
-            st_mss=jnp.asarray(st_mss),
-            st_last=jnp.asarray(st_last),
-            st_cl_of=jnp.asarray(cl_of),
+            flow_lanes=jnp.asarray(el_np),
+            flow_peers=jnp.asarray(peer_np),
+            flow_clid=jnp.asarray(flow_clid),
+            flow_lat=jnp.asarray(flow_lat, dtype=i32),
+            flow_thresh_u32=jnp.asarray(
+                (flow_thr & 0xFFFFFFFF).astype(np.uint32)
+            ),
+            flow_thresh_all=jnp.asarray(flow_thr >= (1 << 32)),
+            flow_segs=jnp.asarray(flow_segs, dtype=i32),
+            flow_mss=jnp.asarray(flow_mss, dtype=i32),
+            flow_last=jnp.asarray(flow_last, dtype=i32),
+            flow_up_rate=jnp.asarray(up[el_np, 0], dtype=i32),
+            flow_up_burst=jnp.asarray(up[el_np, 1], dtype=i32),
+            flow_up_kfull=jnp.asarray(up_kfull[el_np]),
+            flow_up_kfi=jnp.asarray(up_kfi[el_np]),
             lane_pcap=jnp.asarray(lane_pcap),
         )
         self._init_events = init_events
@@ -389,9 +433,11 @@ class TpuEngine:
 
         # no stream tier -> no stream matrices AND no payload columns: the
         # while-loop carry pays a per-buffer cost every iteration on the
-        # tunneled runtime, so dead zero arrays are real wall time
+        # tunneled runtime, so dead zero arrays are real wall time.
+        # Flow matrices are COMPACTED: [S, F] per endpoint side
         stream0 = (
-            lstr_mod.init_stream_state(n) if p.stream_present else ()
+            lstr_mod.init_stream_state(self._s_flows)
+            if p.stream_present else ()
         )
 
         up_burst = np.asarray(self.tables.up_burst)
@@ -637,17 +683,11 @@ class TpuEngine:
         add("lane_sends", int(np.asarray(s.n_sends).sum()))
 
         if self.params.stream_present:
+            # compacted flow matrices: every cl row is a client endpoint,
+            # every sv row its server endpoint
             cl_m = np.asarray(s.stream.cl)
             sv_m = np.asarray(s.stream.sv)
-            cl_mask = model == lanes.M_STREAM_CLIENT
-            # server flow rows live at the server lane in one-to-one mode,
-            # at the client lane otherwise
-            sv_mask = (
-                model == lanes.M_STREAM_SERVER
-                if self.params.stream_one_to_one
-                else cl_mask
-            )
-            done = (cl_m[:, lstr_mod.C_COMPLETED] != 0) & cl_mask
+            done = cl_m[:, lstr_mod.C_COMPLETED] != 0
             if done.any():
                 # tx/retransmit totals count at completion, like the CPU
                 # _track — including zero-valued keys (counter-set parity)
@@ -658,11 +698,11 @@ class TpuEngine:
                 counters["stream_retransmits"] = int(
                     cl_m[done, lstr_mod.C_RETRANS].sum()
                 )
-            add("stream_rx_bytes", int(sv_m[sv_mask, lstr_mod.C_RX_BYTES].sum()))
-            add("stream_rx_segs", int(sv_m[sv_mask, lstr_mod.C_RX_SEGS].sum()))
+            add("stream_rx_bytes", int(sv_m[:, lstr_mod.C_RX_BYTES].sum()))
+            add("stream_rx_segs", int(sv_m[:, lstr_mod.C_RX_SEGS].sum()))
             add(
                 "stream_flows_done",
-                int(((sv_m[:, lstr_mod.C_COMPLETED] != 0) & sv_mask).sum()),
+                int((sv_m[:, lstr_mod.C_COMPLETED] != 0).sum()),
             )
 
         return SimResult(

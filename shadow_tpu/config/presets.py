@@ -90,8 +90,13 @@ def mixed_flagship_config(
     """The MIXED TCP/UDP mesh at its north-star tuning (the bench's and
     the probe/HLO scripts' single source of truth): 1 stream pair per 100
     hosts streaming 2 MB across the datagram mesh."""
-    return flagship_mesh_config(
+    cfg = flagship_mesh_config(
         n_hosts, sim_seconds=sim_seconds, queue_capacity=48,
         pops_per_round=4, stream_pairs=max(n_hosts // 100, 1),
         stream_bytes=2_000_000, backend=backend,
     )
+    # one-to-one pairing puts stream arrivals on the split exchange, so
+    # the main cross block only carries the mesh's permutation spray
+    # (strict mode would raise if this ever overflowed)
+    cfg.experimental.tpu_cross_capacity = 8
+    return cfg

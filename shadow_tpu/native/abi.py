@@ -285,3 +285,22 @@ def vm_read(pid: int, addr: int, n: int) -> bytes:
         raise OSError(ctypes.get_errno(), "process_vm_readv failed")
     return buf.raw[:r]
 
+
+def vm_write(pid: int, addr: int, data: bytes) -> int:
+    """Write ``data`` into another process's memory in ONE kernel call —
+    the MemoryCopier's write side (memory_copier.rs): a multi-MB recv()
+    lands in the plugin's buffer without riding the 64 KiB frame one
+    chunk per exchange.  Returns the byte count written (the kernel only
+    partial-writes across iovecs; with one iovec it is all or error)."""
+    buf = ctypes.create_string_buffer(data, len(data))
+    local = _IOVec(ctypes.cast(buf, ctypes.c_void_p), len(data))
+    remote = _IOVec(ctypes.c_void_p(addr), len(data))
+    r = _libc.syscall(
+        ctypes.c_long(_SYS_process_vm_writev), ctypes.c_long(pid),
+        ctypes.byref(local), ctypes.c_ulong(1),
+        ctypes.byref(remote), ctypes.c_ulong(1), ctypes.c_ulong(0),
+    )
+    if r < 0:
+        raise OSError(ctypes.get_errno(), "process_vm_writev failed")
+    return int(r)
+

@@ -1699,15 +1699,31 @@ class ManagedApp:
 
     def _op_recvfrom(self, api: HostApi, req) -> bool:
         vfd = req.args[0]
-        # the channel can carry at most SHIM_PAYLOAD_MAX bytes per reply; a
-        # larger ret than payload would make the caller read garbage, so the
-        # stream consumes at most one payload per call (the caller loops)
-        max_len = min(int(req.args[1]), abi.SHIM_PAYLOAD_MAX)
+        # direct-memory mode (MemoryCopier write side): the shim passed a
+        # destination address in args[4] — the reply carries no payload,
+        # the bytes land in plugin memory via process_vm_writev.  Frame
+        # mode otherwise: the channel carries at most SHIM_PAYLOAD_MAX
+        # bytes per reply (the caller loops).
+        vm_dst = int(req.args[4])
+        if vm_dst:
+            max_len = min(int(req.args[1]), 256 * 1024)
+        else:
+            max_len = min(int(req.args[1]), abi.SHIM_PAYLOAD_MAX)
         nonblock = bool(req.args[2])
         peek = bool(req.args[3])
         sock = self.sockets.get(vfd)
         if sock is None:
             self._reply(api, "recvfrom", -EBADF)
+            return True
+        if vm_dst and (peek or sock.kind != "tcp" or sock.sim is None):
+            # the shim only uses direct mode for consuming stream reads;
+            # anything else here is a protocol error — refuse loudly so
+            # it falls back rather than corrupting plugin memory
+            if sock.kind == "listen" or (sock.kind == "tcp"
+                                         and sock.sim is None):
+                self._reply(api, "recvfrom", -ENOTCONN)
+            else:
+                self._reply(api, "recvfrom", -EOPNOTSUPP)
             return True
         if sock.kind in ("timer", "event"):
             return self._counter_read(api, sock, max_len, nonblock, vfd)
@@ -1726,22 +1742,47 @@ class ManagedApp:
         if sock.kind == "listen" or sock.sim is None:
             self._reply(api, "recvfrom", -ENOTCONN)
             return True
-        return self._stream_recv(api, vfd, max_len, nonblock, peek)
+        return self._stream_recv(api, vfd, max_len, nonblock, peek, vm_dst)
+
+    def _reply_stream_data(self, api: HostApi, sock, data: bytes,
+                           peek: bool, vm_dst: int) -> None:
+        """Deliver stream bytes: direct vm_write into plugin memory
+        (MemoryCopier write side — data must have been PEEKed, it is
+        consumed only once the write lands) or the frame payload."""
+        if vm_dst:
+            try:
+                abi.vm_write(self._cur.pid, vm_dst, data)
+                api.count("managed_vmcopy_bytes", len(data))
+            except OSError as e:
+                if e.errno in (EPERM, ENOSYS):
+                    # kernel forbids cross-process writes (ptrace scope):
+                    # the shim falls back to frame chunking; nothing was
+                    # consumed, so no bytes are lost
+                    self._reply(api, "recvfrom", -EOPNOTSUPP)
+                else:
+                    # a real fault in the APP's buffer: surface it like
+                    # the kernel would, without consuming
+                    self._reply(api, "recv", -(e.errno or EINVAL))
+                return
+            sock.sim.recv(len(data))  # consume exactly what landed
+        if not peek:
+            api.count("managed_tcp_rx_bytes", len(data))
+        peer_ip = _u32be_to_shim_ip(sock.sim.tcp.remote_ip)
+        self._reply(api, "recv", len(data),
+                    args=[0, peer_ip, sock.sim.tcp.remote_port],
+                    payload=b"" if vm_dst else data)
 
     def _stream_recv(self, api: HostApi, vfd: int, max_len: int,
-                     nonblock: bool, peek: bool = False) -> bool:
+                     nonblock: bool, peek: bool = False,
+                     vm_dst: int = 0) -> bool:
         sock = self.sockets[vfd]
         if max_len <= 0:  # POSIX: zero-length stream recv returns 0
             self._reply(api, "recv", 0)
             return True
-        data = sock.sim.peek(max_len) if peek else sock.sim.recv(max_len)
+        data = (sock.sim.peek(max_len) if (peek or vm_dst)
+                else sock.sim.recv(max_len))
         if data:
-            if not peek:
-                api.count("managed_tcp_rx_bytes", len(data))
-            peer_ip = _u32be_to_shim_ip(sock.sim.tcp.remote_ip)
-            self._reply(api, "recv", len(data),
-                        args=[0, peer_ip, sock.sim.tcp.remote_port],
-                        payload=data)
+            self._reply_stream_data(api, sock, data, peek, vm_dst)
             return True
         ps = sock.sim.poll()
         if ps & PollState.ERROR:
@@ -1753,7 +1794,7 @@ class ManagedApp:
         if nonblock:
             self._reply(api, "recv", -EAGAIN)
             return True
-        self._park(api, ("recv", vfd, max_len, peek), None)
+        self._park(api, ("recv", vfd, max_len, peek, vm_dst), None)
         return False
 
     def _reply_udp_recv(self, api: HostApi, vfd: int, max_len: int,
@@ -2161,17 +2202,13 @@ class ManagedApp:
             if sock is None or sock.sim is None:
                 return
             peek = b[3]
-            data = (sock.sim.peek(max(b[2], 0)) if peek
+            vm_dst = b[4] if len(b) > 4 else 0
+            data = (sock.sim.peek(max(b[2], 0)) if (peek or vm_dst)
                     else sock.sim.recv(max(b[2], 0)))
             ps = sock.sim.poll()
             if data:
                 self._blocked = None
-                if not peek:
-                    api.count("managed_tcp_rx_bytes", len(data))
-                peer_ip = _u32be_to_shim_ip(sock.sim.tcp.remote_ip)
-                self._reply(api, "recv", len(data),
-                            args=[0, peer_ip, sock.sim.tcp.remote_port],
-                            payload=data)
+                self._reply_stream_data(api, sock, data, peek, vm_dst)
                 self._service(api, proc)
             elif ps & PollState.ERROR:
                 self._blocked = None

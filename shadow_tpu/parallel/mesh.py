@@ -26,10 +26,13 @@ from ..backend import lanes
 
 HOST_AXIS = "hosts"
 
-# LaneState fields that are not per-lane arrays and stay replicated
+# LaneState fields that are not per-lane arrays and stay replicated.
+# The stream matrices are COMPACTED per flow ([S, F], flow order), not
+# per lane: S is a few hundred rows, so they replicate — XLA inserts the
+# collectives for the lane-indexed gathers/scatters at the tier boundary
 _REPLICATED_FIELDS = frozenset(
     ("log", "log_count", "log_lost", "rounds", "iters", "now_we_hi", "now_we_lo",
-     "min_used_lat")
+     "min_used_lat", "stream")
 )
 
 

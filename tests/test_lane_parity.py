@@ -385,11 +385,16 @@ def test_vector_law_keeps_ack_rto_arm_through_opened_pump():
     ):
         cl = cl.at[0, col].set(val)
     st = st._replace(cl=cl)
-    f = lstr.gather_cols(st, jnp.array([0]), jnp.array([False]), segs, mss,
-                         last, one_to_one=True)
+    z1 = jnp.zeros(1, dtype=jnp.int32)
+    f = lstr.endpoint_cols(
+        st,
+        jnp.concatenate([segs, z1]),
+        jnp.concatenate([mss, z1]),
+        jnp.concatenate([last, z1]),
+    )  # [2S]=2 rows: row 0 = the client endpoint, row 1 = its server
     now = 1_000_000_000
-    nh = jnp.array([p(now)[0]], dtype=jnp.int32)
-    nl = jnp.array([p(now)[1]], dtype=jnp.int32)
+    nh = jnp.full(2, p(now)[0], dtype=jnp.int32)
+    nl = jnp.full(2, p(now)[1], dtype=jnp.int32)
     # mirror the scalar law on the identical state
     fs = ltcp.FlowState(role=ltcp.SENDER, segs=50, mss=1448, last_bytes=1448,
                         state=ltcp.ESTAB, snd_una=5, snd_nxt=10, rcv_nxt=1,
@@ -398,11 +403,11 @@ def test_vector_law_keeps_ack_rto_arm_through_opened_pump():
                         rtt_ts=970_000_000, rto_deadline=1_900_000_000,
                         rto_evt=1_900_000_000)
     em_ref = ltcp.on_segment(fs, now, ltcp.F_ACK, 0, 6)
-    m = jnp.array([True])
+    m = jnp.array([True, False])
     f2, em = lstr.on_segment_vec(
-        f, nh, nl, m, jnp.array([ltcp.F_ACK]),
-        jnp.array([0], dtype=jnp.int32), jnp.array([6], dtype=jnp.int32),
-        jnp.array([ltcp.HDR_BYTES], dtype=jnp.int32),
+        f, nh, nl, m, jnp.full(2, ltcp.F_ACK, dtype=jnp.int32),
+        jnp.zeros(2, dtype=jnp.int32), jnp.full(2, 6, dtype=jnp.int32),
+        jnp.full(2, ltcp.HDR_BYTES, dtype=jnp.int32),
     )
     # the slot driver runs the transmission-opportunity epilogue after
     # every stimulus — mirror it (the scalar wrapper does the same)

@@ -221,11 +221,13 @@ def test_big_write_waitall_fionread_sleep(tmp_path):
     out = _read(tmp_path, "cli0")
     assert "bigclient done bytes=150000" in out
     assert "slept_ms=" in out
-    # the >64KiB write moved via process_vm_readv (the MemoryCopier path),
-    # not 64KiB frame chunks — unless this kernel forbids cross-process
-    # reads, in which case the frame fallback carried it (also correct)
+    # the >64KiB write moved via process_vm_readv AND the >64KiB WAITALL
+    # recv landed via process_vm_writev (the MemoryCopier's two sides) —
+    # never the 64KiB frame chunks — unless this kernel forbids
+    # cross-process access, in which case the frame fallback carried both
+    # (also correct).  >= 300k proves BOTH directions took the fast path
     if _vm_read_allowed():
-        assert result.counters.get("managed_vmcopy_bytes", 0) >= 150_000
+        assert result.counters.get("managed_vmcopy_bytes", 0) >= 300_000
     slept = int(out.split("slept_ms=")[1].split()[0])
     assert slept >= 50  # the sleep advanced simulated time
     assert "avail_gt0=1" in out
