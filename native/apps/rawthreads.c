@@ -1,0 +1,284 @@
+/* rawthreads: Go-runtime-style OS threads — raw clone(CLONE_VM|...) with
+ * the EXACT flag set of Go's runtime.newosproc (sys_linux_amd64.s), issued
+ * from this binary's own text via inline asm (not libc), on mmap'd stacks,
+ * with futex-based synchronization.  No Go toolchain exists in this image;
+ * this reproduces the kernel contract Go's runtime is built on (the shape
+ * the reference exercises with src/test/golang/): the child resumes at the
+ * post-syscall instruction with rax=0 on the caller-provided stack.
+ *
+ * modes:
+ *   basic N         N raw threads increment a shared counter under a
+ *                   futex mutex, nanosleep, then futex-signal done
+ *   cleartid        CLONE_CHILD_SETTID|CLEARTID: join by futex-waiting
+ *                   the ctid word to clear (glibc pthread_join's law)
+ *   net HOST PORT N N raw threads each run a TCP ping/pong round
+ */
+
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <errno.h>
+#include <linux/futex.h>
+#include <sched.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+/* Go's newosproc flags (runtime/os_linux.go cloneFlags) */
+#define GO_CLONE_FLAGS                                                      \
+    (CLONE_VM | CLONE_FS | CLONE_FILES | CLONE_SIGHAND | CLONE_SYSVSEM |    \
+     CLONE_THREAD)
+
+static long raw6(long nr, long a1, long a2, long a3, long a4, long a5,
+                 long a6) {
+    register long r10 __asm__("r10") = a4;
+    register long r8 __asm__("r8") = a5;
+    register long r9 __asm__("r9") = a6;
+    long ret;
+    __asm__ volatile("syscall"
+                     : "=a"(ret)
+                     : "a"(nr), "D"(a1), "S"(a2), "d"(a3), "r"(r10),
+                       "r"(r8), "r"(r9)
+                     : "rcx", "r11", "memory");
+    return ret;
+}
+
+static void fwait(volatile int *addr, int expected) {
+    raw6(SYS_futex, (long)addr, FUTEX_WAIT, expected, 0, 0, 0);
+}
+
+static void fwake(volatile int *addr, int n) {
+    raw6(SYS_futex, (long)addr, FUTEX_WAKE, n, 0, 0, 0);
+}
+
+/* minimal futex mutex (Go's runtime.lock shape) */
+static void flock(volatile int *m) {
+    while (__sync_val_compare_and_swap(m, 0, 1) != 0) fwait(m, 1);
+}
+
+static void funlock(volatile int *m) {
+    __sync_lock_release(m);
+    fwake(m, 1);
+}
+
+/* raw clone: child pops fn+arg from its fresh stack and runs; on return
+ * the thread dies by raw SYS_exit — exactly the Go asm's structure */
+__attribute__((noinline)) static long go_clone(unsigned long flags,
+                                               void *stack_top,
+                                               int *ptid, int *ctid,
+                                               void (*fn)(void *),
+                                               void *arg) {
+    void **sp = (void **)(((uintptr_t)stack_top) & ~15UL);
+    *--sp = arg;
+    *--sp = (void *)fn;
+    long ret;
+    register long r10 __asm__("r10") = (long)ctid;
+    __asm__ volatile(
+        "syscall\n\t"
+        "test %%rax, %%rax\n\t"
+        "jnz 1f\n\t"
+        /* child: fresh stack, rax=0 — run fn(arg) then exit raw */
+        "pop %%rax\n\t"
+        "pop %%rdi\n\t"
+        "call *%%rax\n\t"
+        "mov $60, %%eax\n\t" /* SYS_exit */
+        "xor %%edi, %%edi\n\t"
+        "syscall\n\t"
+        "1:"
+        : "=a"(ret)
+        : "a"(SYS_clone), "D"(flags), "S"(sp), "d"(ptid), "r"(r10)
+        : "rcx", "r11", "memory");
+    return ret;
+}
+
+static void *tstack(void) {
+    void *p = mmap(NULL, 256 * 1024, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+    if (p == MAP_FAILED) _exit(12);
+    return (char *)p + 256 * 1024;
+}
+
+static volatile int g_mutex;
+static volatile int g_counter;
+static volatile int g_done;
+static int g_iters;
+
+static void worker_basic(void *arg) {
+    long id = (long)arg;
+    for (int i = 0; i < g_iters; i++) {
+        flock(&g_mutex);
+        g_counter++;
+        funlock(&g_mutex);
+        if (i == g_iters / 2) {
+            struct timespec ts = {0, 2000000 + (long)id * 100000};
+            nanosleep(&ts, NULL);
+        }
+    }
+    flock(&g_mutex);
+    g_done++;
+    funlock(&g_mutex);
+    fwake(&g_done, 64);
+}
+
+static int run_basic(int n) {
+    g_iters = 25;
+    for (long i = 0; i < n; i++) {
+        long tid = go_clone(GO_CLONE_FLAGS, tstack(), NULL, NULL,
+                            worker_basic, (void *)i);
+        if (tid <= 0) {
+            printf("clone failed: %ld\n", tid);
+            return 1;
+        }
+    }
+    for (;;) {
+        int d = g_done;
+        if (d >= n) break;
+        fwait(&g_done, d);
+    }
+    printf("basic counter=%d done=%d\n", g_counter, g_done);
+    return 0;
+}
+
+static volatile int g_ctid;
+
+static void worker_cleartid(void *arg) {
+    (void)arg;
+    struct timespec ts = {0, 5000000};
+    nanosleep(&ts, NULL);
+    flock(&g_mutex);
+    g_counter += 41;
+    funlock(&g_mutex);
+}
+
+static int run_cleartid(void) {
+    int ptid = 0;
+    g_ctid = -1; /* never confuse "not yet set" with "cleared at exit" */
+    long tid = go_clone(GO_CLONE_FLAGS | CLONE_PARENT_SETTID |
+                            CLONE_CHILD_SETTID | CLONE_CHILD_CLEARTID,
+                        tstack(), &ptid, (int *)&g_ctid, worker_cleartid,
+                        NULL);
+    if (tid <= 0) {
+        printf("clone failed: %ld\n", tid);
+        return 1;
+    }
+    /* pthread_join's law: wait for the kernel(-emulated) clear+wake */
+    for (;;) {
+        int v = g_ctid;
+        if (v == 0) break;
+        fwait(&g_ctid, v);
+    }
+    printf("cleartid joined counter=%d ptid_set=%d tid_match=%d\n",
+           g_counter, ptid != 0, (long)ptid == tid);
+    return 0;
+}
+
+static struct {
+    char host[64];
+    int port;
+    int bytes;
+} g_net;
+
+static void worker_net(void *arg) {
+    long id = (long)arg;
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)g_net.port);
+    inet_pton(AF_INET, g_net.host, &sa.sin_addr);
+    int rc = connect(fd, (struct sockaddr *)&sa, sizeof(sa));
+    int got = 0;
+    if (rc == 0) {
+        char buf[512];
+        memset(buf, 'a' + (int)id, sizeof(buf));
+        for (int sent = 0; sent < 1024; ) {
+            int w = (int)send(fd, buf, sizeof(buf), 0);
+            if (w <= 0) break;
+            sent += w;
+            int r;
+            for (int back = 0; back < w; back += r) {
+                r = (int)recv(fd, buf, sizeof(buf), 0);
+                if (r <= 0) { r = 0; break; }
+                got += r;
+                if (r == 0) break;
+            }
+        }
+    }
+    close(fd);
+    flock(&g_mutex);
+    g_counter += got;
+    g_done++;
+    funlock(&g_mutex);
+    fwake(&g_done, 64);
+}
+
+static int run_net(const char *host, int port, int n) {
+    snprintf(g_net.host, sizeof(g_net.host), "%s", host);
+    g_net.port = port;
+    for (long i = 0; i < n; i++) {
+        long tid = go_clone(GO_CLONE_FLAGS, tstack(), NULL, NULL,
+                            worker_net, (void *)i);
+        if (tid <= 0) {
+            printf("clone failed: %ld\n", tid);
+            return 1;
+        }
+    }
+    for (;;) {
+        int d = g_done;
+        if (d >= n) break;
+        fwait(&g_done, d);
+    }
+    printf("net threads=%d echoed=%d\n", g_done, g_counter);
+    return 0;
+}
+
+static void worker_churn(void *arg) {
+    (void)arg;
+    flock(&g_mutex);
+    g_counter++;
+    funlock(&g_mutex);
+}
+
+static int run_churn(int n) {
+    /* create/retire one thread at a time, joining via CLEARTID: proves
+     * the shim reclaims table slots and backing stacks across MANY more
+     * lifetimes than its static thread table holds */
+    void *stack = tstack();
+    for (int i = 0; i < n; i++) {
+        g_ctid = -1;
+        long tid = go_clone(GO_CLONE_FLAGS | CLONE_CHILD_SETTID |
+                                CLONE_CHILD_CLEARTID,
+                            stack, NULL, (int *)&g_ctid, worker_churn,
+                            NULL);
+        if (tid <= 0) {
+            printf("churn clone %d failed: %ld\n", i, tid);
+            return 1;
+        }
+        for (;;) {
+            int v = g_ctid;
+            if (v == 0) break;
+            fwait(&g_ctid, v);
+        }
+    }
+    printf("churn counter=%d of %d\n", g_counter, n);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    if (argc >= 3 && !strcmp(argv[1], "basic"))
+        return run_basic(atoi(argv[2]));
+    if (argc >= 2 && !strcmp(argv[1], "cleartid")) return run_cleartid();
+    if (argc >= 3 && !strcmp(argv[1], "churn"))
+        return run_churn(atoi(argv[2]));
+    if (argc >= 5 && !strcmp(argv[1], "net"))
+        return run_net(argv[2], atoi(argv[3]), atoi(argv[4]));
+    fprintf(stderr,
+            "usage: rawthreads basic N | cleartid | churn N | net H P N\n");
+    return 2;
+}

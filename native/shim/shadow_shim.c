@@ -75,6 +75,7 @@
 #include "../include/shadow_shim_abi.h"
 
 #include <pthread.h>
+#include <setjmp.h>
 #include <semaphore.h>
 
 #define SHIM_MAX_FDS 4096
@@ -86,6 +87,12 @@ static shim_shmem *g_shm = NULL;
 static __thread shim_shmem *t_shm = NULL;
 static __thread int64_t t_vtid = 0; /* 0 = main thread */
 static __thread int t_exit_sent = 0;
+/* raw-clone adoption (Go-runtime-style threads): the boot block of an
+ * adopted thread (its ctid word and retirement jump buffer live there),
+ * and the interrupted context of the CURRENT dispatch frame (the handler
+ * CAN nest — SA_NODEFER — so dispatch saves and restores it) */
+static __thread void *t_boot = NULL;
+static __thread void *t_cur_uc = NULL;
 
 static shim_shmem *cur_shm(void) { return t_shm ? t_shm : g_shm; }
 static int g_ready = 0;
@@ -702,7 +709,12 @@ static void sigsys_handler(int sig, siginfo_t *si, void *uctx) {
     if (!g_shm || (insn_ip >= g_text_lo && insn_ip < g_text_hi)) {
         ret = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
     } else {
+        /* raw-clone adoption needs the full context; save/restore so a
+         * NESTED dispatch (SA_NODEFER) can't wipe the outer frame's */
+        void *prev_uc = t_cur_uc;
+        t_cur_uc = uc;
         ret = emu_owned_syscall(nr, a1, a2, a3, a4, a5, a6, &handled);
+        t_cur_uc = prev_uc;
         if (!handled) ret = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
     }
     gr[REG_RAX] = ret;
@@ -2937,6 +2949,36 @@ static void thread_send_exit(void *retval) {
     msg_publish(tx);
 }
 
+/* shared manager-handshake steps of pthread_create AND raw-clone
+ * adoption: reserve a channel (PRETHREAD), confirm/cancel it
+ * (THREAD_CREATED), and register the backing pthread for joins */
+static int64_t shim_prethread(char *path, uint32_t pathsz, int64_t *vtid) {
+    uint32_t len = pathsz - 1;
+    int64_t reply[6];
+    int64_t ret = shim_call(SHIM_OP_PRETHREAD, NULL, NULL, 0, path, &len,
+                            reply);
+    if (ret < 0) return ret;
+    path[len] = 0;
+    *vtid = reply[1];
+    return 0;
+}
+
+static void shim_thread_created(int64_t vtid, int failed) {
+    int64_t args[6] = {vtid, failed, 0, 0, 0, 0};
+    shim_call(SHIM_OP_THREAD_CREATED, args, NULL, 0, NULL, NULL, NULL);
+}
+
+static void thread_tab_register(pthread_t th, int64_t vtid) {
+    for (int i = 0; i < SHIM_MAX_THREADS; i++) {
+        if (!thread_tab[i].used) {
+            thread_tab[i].th = th;
+            thread_tab[i].vtid = vtid;
+            thread_tab[i].used = 1;
+            break;
+        }
+    }
+}
+
 typedef struct {
     void *(*start)(void *);
     void *arg;
@@ -2968,17 +3010,13 @@ int pthread_create(pthread_t *th, const pthread_attr_t *attr,
     if (!real_create) *(void **)&real_create = dlsym(RTLD_NEXT, "pthread_create");
     if (!g_ready) return real_create(th, attr, start, arg);
     char path[480];
-    uint32_t len = sizeof(path) - 1;
-    int64_t reply[6];
-    int64_t ret = shim_call(SHIM_OP_PRETHREAD, NULL, NULL, 0, path, &len, reply);
+    int64_t vtid;
+    int64_t ret = shim_prethread(path, sizeof(path), &vtid);
     if (ret < 0) return (int)-ret;
-    path[len] = 0;
-    int64_t vtid = reply[1];
     shim_thread_boot *boot = malloc(sizeof(*boot));
     if (!boot) {
         /* cancel so the manager frees the pending channel + file */
-        int64_t cargs[6] = {vtid, 1, 0, 0, 0, 0};
-        shim_call(SHIM_OP_THREAD_CREATED, cargs, NULL, 0, NULL, NULL, NULL);
+        shim_thread_created(vtid, 1);
         return ENOMEM;
     }
     boot->start = start;
@@ -2994,22 +3032,206 @@ int pthread_create(pthread_t *th, const pthread_attr_t *attr,
     if (g_sud_on) g_sud_selector = SYSCALL_DISPATCH_FILTER_ALLOW;
     int r = real_create(th, attr, shim_thread_tramp, boot);
     if (g_sud_on) g_sud_selector = SYSCALL_DISPATCH_FILTER_BLOCK;
-    int64_t args[6] = {vtid, r != 0, 0, 0, 0, 0};
-    shim_call(SHIM_OP_THREAD_CREATED, args, NULL, 0, NULL, NULL, NULL);
+    shim_thread_created(vtid, r != 0);
     if (r != 0) {
         munmap(boot->shm, sizeof(shim_shmem));
         free(boot);
         return r;
     }
-    for (int i = 0; i < SHIM_MAX_THREADS; i++) {
-        if (!thread_tab[i].used) {
-            thread_tab[i].th = *th;
-            thread_tab[i].vtid = vtid;
-            thread_tab[i].used = 1;
-            break;
-        }
-    }
+    thread_tab_register(*th, vtid);
     return 0;
+}
+
+/* ---- raw CLONE_VM thread adoption (the Go runtime's newosproc path) ----
+ *
+ * Language runtimes that do not use libc threads create OS threads with a
+ * raw clone(CLONE_VM|CLONE_THREAD|...) from their own text, expecting the
+ * kernel contract: the child resumes at the instruction after the syscall
+ * with rax = 0 on the caller-provided stack.  Re-executing that clone from
+ * the SIGSYS handler is unsound (the child would resume inside the
+ * handler frame on a foreign stack), and a directly-cloned child would
+ * share the parent's glibc TLS (no CLONE_SETTLS in Go's flag set), so the
+ * shim's own __thread state would be corrupted.
+ *
+ * Adoption instead backs the app's thread with a REAL pthread: the new
+ * OS thread gets proper glibc TLS (shim state keeps working forever), is
+ * registered with the manager through the ordinary PRETHREAD /
+ * THREAD_CREATED / THREAD_START handshake (so it takes simulation turns
+ * like any managed thread), and then a register-restore trampoline
+ * reproduces the kernel contract exactly: every GPR from the interrupted
+ * context, rflags, rax = 0, rsp = the app's child stack, jump to the
+ * post-syscall ip.  rcx/r11 are syscall-clobbered by the ABI, so they
+ * are free as scratch.  CLONE_PARENT_SETTID / CHILD_SETTID are emulated
+ * with the real OS tid; CHILD_CLEARTID clears and futex-wakes (through
+ * the EMULATED futex, where the joiner waits) at thread exit.
+ * CLONE_SETTLS is refused — a runtime that manages libc-level TLS itself
+ * must come through pthread_create.  (The reference runs Go through its
+ * own native_clone flow, managed_thread.rs:355; this is the shim-side
+ * equivalent.) */
+
+typedef struct {
+    shim_shmem *shm;
+    int64_t vtid;
+    unsigned long fl;
+    int *ctid;
+    volatile int tid; /* commbox: child publishes its OS tid */
+    int has_fp;
+    /* retirement: raw SYS_exit siglongjmps back into the trampoline's
+     * frame on the (untouched) pthread stack, so the trampoline RETURNS
+     * and glibc reclaims the detached backing thread normally — no
+     * unwinding through signal frames, no stack/TCB leak */
+    sigjmp_buf retire;
+    void *exit_val;
+    long long gregs[23];
+    /* the interrupted context's FPU/SSE environment (MXCSR, x87 control
+     * word, register file): the kernel clone contract copies it into the
+     * child, so the restore must too */
+    char fpstate[512] __attribute__((aligned(16)));
+} adopt_boot;
+
+__attribute__((noreturn, used)) void shim_adopted_jump(const long long *g,
+                                                       const void *fp);
+__asm__(
+    ".text\n"
+    ".type shim_adopted_jump, @function\n"
+    "shim_adopted_jump:\n"
+    "  test %rsi, %rsi\n"
+    "  jz 2f\n"
+    "  fxrstor64 (%rsi)\n"
+    "2:\n"
+    "  mov %rdi, %r11\n"
+    /* glibc mcontext greg order: r8 r9 r10 r11 r12 r13 r14 r15 rdi rsi
+     * rbp rbx rdx rax rcx rsp rip efl ... (8 bytes each) */
+    "  mov 0(%r11), %r8\n"
+    "  mov 8(%r11), %r9\n"
+    "  mov 16(%r11), %r10\n"
+    "  mov 32(%r11), %r12\n"
+    "  mov 40(%r11), %r13\n"
+    "  mov 48(%r11), %r14\n"
+    "  mov 56(%r11), %r15\n"
+    "  mov 72(%r11), %rsi\n"
+    "  mov 80(%r11), %rbp\n"
+    "  mov 88(%r11), %rbx\n"
+    "  mov 96(%r11), %rdx\n"
+    "  mov 120(%r11), %rsp\n"   /* the app's child stack */
+    "  pushq 128(%r11)\n"       /* post-syscall rip */
+    "  pushq 136(%r11)\n"       /* rflags */
+    "  mov 64(%r11), %rdi\n"
+    "  mov 112(%r11), %rcx\n"
+    "  xor %eax, %eax\n"        /* clone returns 0 in the child */
+    "  popfq\n"
+    "  ret\n"
+    ".size shim_adopted_jump, .-shim_adopted_jump\n");
+
+static long shim_futex_emu(long uaddr, long op, long val, long timeout,
+                           long uaddr2, long val3);
+
+static void *shim_adopted_tramp(void *p) {
+    /* copy the boot block into THIS frame: the dying thread must not
+     * take malloc locks after the farewell (another sim thread's
+     * contended malloc futex is EMULATED; a raw unlock would never wake
+     * it), so the PARENT owns and frees the heap block — publishing the
+     * tid through it is this thread's last touch of it */
+    adopt_boot boot = *(adopt_boot *)p;
+    if (g_sud_on) sud_arm();
+    if (g_tsc_on) tsc_arm();
+    t_shm = boot.shm;
+    t_vtid = boot.vtid;
+    t_boot = &boot;
+    int tid = (int)shim_raw_syscall6(SYS_gettid, 0, 0, 0, 0, 0, 0);
+    if ((boot.fl & CLONE_CHILD_SETTID) && boot.ctid) *boot.ctid = tid;
+    ((adopt_boot *)p)->tid = tid;
+    shim_raw_syscall6(SYS_futex, (long)&((adopt_boot *)p)->tid,
+                      FUTEX_WAKE, 1, 0, 0, 0);
+    p = NULL; /* parent frees it the moment it reads the tid */
+    /* parks here until the thread's start event fires in the simulation */
+    int64_t args[6] = {boot.vtid, 0, 0, 0, 0, 0};
+    shim_call(SHIM_OP_THREAD_START, args, NULL, 0, NULL, NULL, NULL);
+    if (sigsetjmp(boot.retire, 0) == 0)
+        shim_adopted_jump(boot.gregs,
+                          boot.has_fp ? boot.fpstate : NULL);
+    /* Raw SYS_exit longjmp'd back: we are on the PTHREAD stack now and
+     * will never touch the app's clone stack again — only NOW may the
+     * joiner learn the thread is gone.  Kernel ctid law: clear + wake
+     * (through the EMULATED futex, where the joiner waits — the channel
+     * is still live, the farewell comes after), then retire.  The
+     * trampoline returns so glibc reclaims the detached backing thread
+     * (stack, TCB) through its normal path.  Residual narrow race,
+     * documented: glibc's thread-teardown freeres may take a malloc
+     * arena lock with raw futexes after the farewell; an app thread
+     * sharing that arena contends through the emulated futex.  The
+     * churn stress (520 lifetimes) exercises this path. */
+    if ((boot.fl & CLONE_CHILD_CLEARTID) && boot.ctid) {
+        *boot.ctid = 0;
+        shim_futex_emu((long)boot.ctid, FUTEX_WAKE, 0x7FFFFFFF, 0, 0, 0);
+    }
+    thread_send_exit(boot.exit_val);
+    if (g_sud_on)
+        shim_raw_syscall6(SYS_prctl, PR_SET_SYSCALL_USER_DISPATCH,
+                          PR_SYS_DISPATCH_OFF, 0, 0, 0, 0);
+    return boot.exit_val;
+}
+
+static long shim_adopt_raw_thread(ucontext_t *uc, unsigned long fl,
+                                  long stack, long ptid, long ctid) {
+    if (!stack) return -EINVAL;
+    char path[480];
+    int64_t vtid;
+    int64_t ret = shim_prethread(path, sizeof(path), &vtid);
+    if (ret < 0) return ret;
+    adopt_boot *boot = malloc(sizeof(*boot));
+    shim_shmem *shm = boot ? shim_map(path) : NULL;
+    if (!shm) {
+        /* cancel so the manager frees the pending channel + file */
+        shim_thread_created(vtid, 1);
+        free(boot);
+        return -ENOMEM;
+    }
+    boot->shm = shm;
+    boot->vtid = vtid;
+    boot->fl = fl;
+    boot->ctid = (int *)ctid;
+    boot->tid = 0;
+    memcpy(boot->gregs, uc->uc_mcontext.gregs, sizeof(boot->gregs));
+    boot->gregs[REG_RSP] = stack;
+    boot->has_fp = uc->uc_mcontext.fpregs != NULL;
+    if (boot->has_fp)
+        memcpy(boot->fpstate, uc->uc_mcontext.fpregs,
+               sizeof(boot->fpstate));
+    static int (*real_create)(pthread_t *, const pthread_attr_t *,
+                              void *(*)(void *), void *);
+    if (!real_create)
+        *(void **)&real_create = dlsym(RTLD_NEXT, "pthread_create");
+    pthread_attr_t attr;
+    pthread_attr_init(&attr);
+    pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
+    /* the pthread stack only hosts the trampoline and signal frames —
+     * after the jump the thread lives on the app's stack */
+    pthread_attr_setstacksize(&attr, 256 * 1024);
+    pthread_t th;
+    /* the libc-internal clone comes from libc text: lift dispatch for
+     * the duration (turn-taking means no other sim thread runs) */
+    if (g_sud_on) g_sud_selector = SYSCALL_DISPATCH_FILTER_ALLOW;
+    int r = real_create(&th, &attr, shim_adopted_tramp, boot);
+    if (g_sud_on) g_sud_selector = SYSCALL_DISPATCH_FILTER_BLOCK;
+    pthread_attr_destroy(&attr);
+    shim_thread_created(vtid, r != 0);
+    if (r != 0) {
+        munmap(shm, sizeof(shim_shmem));
+        free(boot);
+        return -EAGAIN;
+    }
+    /* the tid handshake costs microseconds of wall time, never sim time;
+     * the child's tid publish is its LAST touch of the heap block, so
+     * this side frees it */
+    while (!boot->tid)
+        shim_raw_syscall6(SYS_futex, (long)&boot->tid, FUTEX_WAIT, 0, 0, 0,
+                          0);
+    int tid = boot->tid;
+    free(boot);
+    if ((fl & CLONE_PARENT_SETTID) && ptid) *(int *)ptid = tid;
+    thread_tab_register(th, vtid);
+    return tid;
 }
 
 int pthread_join(pthread_t th, void **retval) {
@@ -4081,10 +4303,19 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
                 if (r == 0 && g_sud_on) sud_arm();
                 return r;
             }
+            if ((fl & CLONE_VM) && (fl & CLONE_THREAD)) {
+                /* the Go runtime's newosproc shape: adopt the raw thread
+                 * into turn-taking via a pthread-backed context-restore
+                 * (see shim_adopt_raw_thread).  CLONE_SETTLS callers
+                 * manage libc TLS themselves — unsupported, refuse */
+                if ((fl & CLONE_SETTLS) || !t_cur_uc) return -ENOSYS;
+                return shim_adopt_raw_thread((ucontext_t *)t_cur_uc, fl,
+                                             a2, a3, a4);
+            }
             if (fl & CLONE_VM)
-                /* a raw thread would escape turn-taking entirely, and the
-                 * child of a re-executed CLONE_VM clone would resume on
-                 * the new stack inside our handler frame: refuse (use
+                /* CLONE_VM without CLONE_THREAD (vfork-like sharing):
+                 * the child of a re-executed clone would resume on the
+                 * new stack inside our handler frame: refuse (use
                  * pthreads or plain fork, both fully virtualized) */
                 return -ENOSYS;
             WRAPRET(fork()); /* fork-like raw clone */
@@ -4140,6 +4371,31 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
         case SYS_wait4:
             WRAPRET(wait4((pid_t)a1, (int *)a2, (int)a3,
                           (struct rusage *)a4));
+        case SYS_exit:
+            if (t_boot) {
+                /* ADOPTED thread retiring (Go-style runtimes don't use
+                 * pthread_exit): longjmp back into the trampoline frame
+                 * on the PTHREAD stack first — ctid clear, farewell,
+                 * and teardown all happen there, after the app's clone
+                 * stack can never be touched again (a joiner may reuse
+                 * or unmap it the moment it observes the clear).  The
+                 * table slot frees here while the turn is still held
+                 * (create/retire churn would exhaust SHIM_MAX_THREADS
+                 * otherwise); the abandoned signal frame is just stack
+                 * memory, and the handler-era sigmask stays — a dying
+                 * thread never notices. */
+                adopt_boot *boot = t_boot;
+                t_boot = NULL;
+                boot->exit_val = (void *)(uintptr_t)a1;
+                thread_table_remove(pthread_self());
+                siglongjmp(boot->retire, 1);
+            }
+            /* a pthread-created worker or the MAIN thread retiring by
+             * raw SYS_exit: farewell (vtid 0 = main retiring while
+             * workers run — the manager stops servicing its channel,
+             * like the pthread_exit wrapper), then the OS thread dies */
+            if (g_ready) thread_send_exit((void *)(uintptr_t)a1);
+            return shim_raw_syscall6(SYS_exit, a1, 0, 0, 0, 0, 0);
         case SYS_exit_group:
             g_exit_code = (int)a1;
             send_farewell();

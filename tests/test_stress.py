@@ -151,3 +151,20 @@ hosts:
         Simulation(cfg).run()
         outs.add((data / "hosts" / "client" / "curl.stdout").read_text())
     assert len(outs) == 1, f"{len(outs)} distinct outputs across repeats"
+
+
+def test_stress_raw_clone_threads(tmp_path):
+    """Go-style raw CLONE_VM threads under repetition: the adopted-thread
+    path (pthread-backed context restore) must be schedule-invariant."""
+    _repeat_identical(
+        f"""
+general: {{stop_time: 10s, seed: 23, data_directory: {tmp_path / 'd'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'rawthreads'}
+        args: [basic, '6']
+"""
+    )
