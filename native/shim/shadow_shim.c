@@ -1401,6 +1401,112 @@ ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
     return (ssize_t)buflen;
 }
 
+/* OpenSSL-level RNG override (the reference's preload-openssl/rng.c):
+ * TLS libraries seed from RDRAND and other in-process sources that the
+ * syscall interposition never sees, so HTTPS-speaking apps would leak
+ * nondeterminism through session keys, nonces, and hello randoms.
+ * Interposing the RAND_* API itself closes that hole for any app that
+ * links OpenSSL dynamically; apps without OpenSSL never bind these
+ * symbols.  Outside the simulation each call forwards to the real
+ * library (or to getrandom when none is loaded). */
+
+static void *rand_real(const char *name, void **cache) {
+    if (!*cache) *cache = dlsym(RTLD_NEXT, name);
+    return *cache;
+}
+
+static int shim_rand_fill(unsigned char *buf, int num, const char *real,
+                          void **cache) {
+    if (num < 0) return 0;
+    if (!g_shm) {
+        static __thread int in_fwd; /* dlsym'd real fn may recurse */
+        if (!in_fwd) {
+            int (*fn)(unsigned char *, int);
+            *(void **)&fn = rand_real(real, cache);
+            if (fn) {
+                in_fwd = 1;
+                int r = fn(buf, num);
+                in_fwd = 0;
+                return r;
+            }
+        }
+        /* no libcrypto loaded: raw getrandom, looping — the kernel only
+         * guarantees uninterrupted delivery up to 256 bytes */
+        int left = num;
+        while (left > 0) {
+            long r = shim_raw_syscall6(SYS_getrandom,
+                                       (long)(buf + (num - left)), left, 0,
+                                       0, 0, 0);
+            if (r == -EINTR) continue;
+            if (r <= 0) return 0;
+            left -= (int)r;
+        }
+        return 1;
+    }
+    fill_entropy(buf, (size_t)num);
+    return 1;
+}
+
+int RAND_bytes(unsigned char *buf, int num) {
+    static void *cache;
+    return shim_rand_fill(buf, num, "RAND_bytes", &cache);
+}
+
+int RAND_priv_bytes(unsigned char *buf, int num) {
+    static void *cache;
+    return shim_rand_fill(buf, num, "RAND_priv_bytes", &cache);
+}
+
+int RAND_pseudo_bytes(unsigned char *buf, int num) {
+    static void *cache;
+    return shim_rand_fill(buf, num, "RAND_pseudo_bytes", &cache);
+}
+
+int RAND_status(void) {
+    if (!g_shm) {
+        static void *cache;
+        int (*fn)(void);
+        *(void **)&fn = rand_real("RAND_status", &cache);
+        if (fn) return fn();
+    }
+    return 1;
+}
+
+int RAND_poll(void) {
+    if (!g_shm) {
+        static void *cache;
+        int (*fn)(void);
+        *(void **)&fn = rand_real("RAND_poll", &cache);
+        if (fn) return fn();
+    }
+    return 1;
+}
+
+void RAND_seed(const void *buf, int num) {
+    if (!g_shm) {
+        static void *cache;
+        void (*fn)(const void *, int);
+        *(void **)&fn = rand_real("RAND_seed", &cache);
+        if (fn) fn(buf, num);
+        return;
+    }
+    (void)buf;
+    (void)num; /* deterministic stream: external seeding is a no-op */
+}
+
+void RAND_add(const void *buf, int num, double randomness) {
+    if (!g_shm) {
+        static void *cache;
+        void (*fn)(const void *, int, double);
+        *(void **)&fn = rand_real("RAND_add", &cache);
+        if (fn) fn(buf, num, randomness);
+        return;
+    }
+    (void)buf;
+    (void)num;
+    (void)randomness;
+}
+
 /* ------------------------------------------------------------- sockets */
 
 static int addr_to_ip_port(const struct sockaddr *addr, socklen_t len,
