@@ -112,23 +112,23 @@ def main() -> None:
         )
         out["mixed_iters"] = int(mr.counters.get("lane_iters", 0))
 
-    # the OTHER side of the north-star ratio: the CPU thread-per-host path
-    # on the headline workload (shorter sim — the rate is steady-state,
-    # and the single-core Python loop is ~50x slower)
+    # the OTHER side of the north-star ratio: the PARALLEL CPU backend on
+    # the headline workload (shorter sim — the rate is steady-state).
+    # MpCpuEngine forks one worker per core, the honest analog of the
+    # reference's thread-per-core scheduler for pure-model hosts
     if CPU_SIM_SECONDS > 0:
-        from shadow_tpu.backend.cpu_engine import CpuEngine
+        from shadow_tpu.backend.cpu_mp import MpCpuEngine
 
+        workers = int(os.environ.get(
+            "SHADOW_TPU_BENCH_CPU_WORKERS", str(os.cpu_count() or 1)
+        ))
         cpu_cfg = _pure_cfg(CPU_SIM_SECONDS, backend="cpu")
         t0 = time.perf_counter()
-        CpuEngine(cpu_cfg).run()
+        MpCpuEngine(cpu_cfg, workers=workers).run()
         cpu_rate = CPU_SIM_SECONDS / (time.perf_counter() - t0)
         out["cpu_sim_s_per_wall_s"] = round(cpu_rate, 4)
         out["speedup_vs_cpu_backend"] = round(value / cpu_rate, 2)
-        # honesty: the CPU side is a SERIAL single-core Python event loop,
-        # not the reference's 16-thread work-stealing scheduler — the
-        # ratio above flatters the TPU accordingly (the reference's own
-        # measured speedup is the vs_baseline key)
-        out["cpu_parallelism"] = 1
+        out["cpu_parallelism"] = workers
     print(json.dumps(out))
 
 

@@ -3,10 +3,16 @@
  * deterministic — the RAND_* interposers route to the simulation's
  * splitmix64 entropy — and identical across runs of the same seed. */
 
+#include <stddef.h>
 #include <stdio.h>
 
 int RAND_bytes(unsigned char *buf, int num);
 int RAND_priv_bytes(unsigned char *buf, int num);
+/* the _ex API is what OpenSSL 3's own TLS code paths call */
+int RAND_bytes_ex(void *libctx, unsigned char *buf, size_t num,
+                  unsigned int strength);
+int RAND_priv_bytes_ex(void *libctx, unsigned char *buf, size_t num,
+                       unsigned int strength);
 int RAND_status(void);
 
 static void hex(const char *tag, const unsigned char *b, int n) {
@@ -17,7 +23,7 @@ static void hex(const char *tag, const unsigned char *b, int n) {
 
 int main(void) {
     setvbuf(stdout, NULL, _IONBF, 0);
-    unsigned char a[32], b[16];
+    unsigned char a[32], b[16], c[32], d[16];
     if (RAND_bytes(a, sizeof(a)) != 1) {
         printf("RAND_bytes failed\n");
         return 1;
@@ -26,8 +32,18 @@ int main(void) {
         printf("RAND_priv_bytes failed\n");
         return 1;
     }
+    if (RAND_bytes_ex(NULL, c, sizeof(c), 256) != 1) {
+        printf("RAND_bytes_ex failed\n");
+        return 1;
+    }
+    if (RAND_priv_bytes_ex(NULL, d, sizeof(d), 256) != 1) {
+        printf("RAND_priv_bytes_ex failed\n");
+        return 1;
+    }
     hex("rand", a, sizeof(a));
     hex("priv", b, sizeof(b));
+    hex("rand_ex", c, sizeof(c));
+    hex("priv_ex", d, sizeof(d));
     printf("status=%d\n", RAND_status());
     return 0;
 }

@@ -1415,6 +1415,21 @@ static void *rand_real(const char *name, void **cache) {
     return *cache;
 }
 
+/* raw getrandom, looping — the kernel only guarantees uninterrupted
+ * delivery up to 256 bytes */
+static int rand_raw_getrandom(unsigned char *buf, size_t num) {
+    size_t left = num;
+    while (left > 0) {
+        long r = shim_raw_syscall6(SYS_getrandom,
+                                   (long)(buf + (num - left)), (long)left,
+                                   0, 0, 0, 0);
+        if (r == -EINTR) continue;
+        if (r <= 0) return 0;
+        left -= (size_t)r;
+    }
+    return 1;
+}
+
 static int shim_rand_fill(unsigned char *buf, int num, const char *real,
                           void **cache) {
     if (num < 0) return 0;
@@ -1430,18 +1445,7 @@ static int shim_rand_fill(unsigned char *buf, int num, const char *real,
                 return r;
             }
         }
-        /* no libcrypto loaded: raw getrandom, looping — the kernel only
-         * guarantees uninterrupted delivery up to 256 bytes */
-        int left = num;
-        while (left > 0) {
-            long r = shim_raw_syscall6(SYS_getrandom,
-                                       (long)(buf + (num - left)), left, 0,
-                                       0, 0, 0);
-            if (r == -EINTR) continue;
-            if (r <= 0) return 0;
-            left -= (int)r;
-        }
-        return 1;
+        return rand_raw_getrandom(buf, (size_t)num);
     }
     fill_entropy(buf, (size_t)num);
     return 1;
@@ -1460,6 +1464,36 @@ int RAND_priv_bytes(unsigned char *buf, int num) {
 int RAND_pseudo_bytes(unsigned char *buf, int num) {
     static void *cache;
     return shim_rand_fill(buf, num, "RAND_pseudo_bytes", &cache);
+}
+
+/* OpenSSL 3's internal TLS path (hello randoms, key generation) calls
+ * the _ex API with an explicit library context, NOT the public
+ * RAND_bytes symbol — interpose it too or the hole stays open */
+static int shim_rand_fill_ex(void *libctx, unsigned char *buf, size_t num,
+                             unsigned int strength, const char *real,
+                             void **cache) {
+    if (g_shm) {
+        fill_entropy(buf, num);
+        return 1;
+    }
+    int (*fn)(void *, unsigned char *, size_t, unsigned int);
+    *(void **)&fn = rand_real(real, cache);
+    if (fn) return fn(libctx, buf, num, strength);
+    return rand_raw_getrandom(buf, num);
+}
+
+int RAND_bytes_ex(void *libctx, unsigned char *buf, size_t num,
+                  unsigned int strength) {
+    static void *cache;
+    return shim_rand_fill_ex(libctx, buf, num, strength, "RAND_bytes_ex",
+                             &cache);
+}
+
+int RAND_priv_bytes_ex(void *libctx, unsigned char *buf, size_t num,
+                       unsigned int strength) {
+    static void *cache;
+    return shim_rand_fill_ex(libctx, buf, num, strength,
+                             "RAND_priv_bytes_ex", &cache);
 }
 
 int RAND_status(void) {

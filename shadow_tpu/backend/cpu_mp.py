@@ -1,0 +1,225 @@
+"""Multiprocessing CPU backend: fork-based host-parallel round execution.
+
+The reference's CPU path is genuinely parallel (thread-per-core with work
+stealing, thread_per_core.rs:17-50).  Python threads cannot parallelize
+pure-model hosts (GIL), so this backend forks real worker PROCESSES, each
+holding a complete deterministic world replica (same seeds, IPs, routing)
+and EXECUTING only its host partition each round:
+
+- cross-partition packets fall out naturally: ``send_packet`` already
+  appends to the destination's inbox, and a non-owned destination's inbox
+  is never drained locally — the worker sweeps those inboxes at the
+  barrier and ships the events to the owner through its pipe;
+- the parent runs the Controller role: folds the workers' reported
+  next-event times (including in-flight cross-partition packets), computes
+  each window, and broadcasts it;
+- determinism is insertion-order-free by construction: event queues order
+  by the total (time, kind, src, seq) key, log comparisons use the sorted
+  ``log_tuples`` contract, and counters merge by key — so any worker
+  count produces identical results (asserted by tests against the serial
+  engine).
+
+Gates: pure-model hosts only (managed OS processes need the fd/channel
+machinery of the owning process — they keep the threaded scheduler, which
+genuinely parallelizes them because futex waits release the GIL), and no
+pcap (every replica would open the same capture files).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import time as wall_time
+
+from ..config.options import ConfigOptions
+from ..core import time as stime
+from ..core.event import Event, EventKind
+from .cpu_engine import CpuEngine, SimResult
+
+
+def _partition(n_hosts: int, workers: int) -> list[list[int]]:
+    """Round-robin by host id — the reference's per-thread queue fill."""
+    return [list(range(w, n_hosts, workers)) for w in range(workers)]
+
+
+def _worker_main(engine: CpuEngine, owned: list[int], conn) -> None:
+    # fork start method: the engine object is INHERITED copy-on-write
+    # from the parent's single build — never re-built, never pickled
+    owned_hosts = [engine.hosts[i] for i in owned]
+    owned_set = set(owned)
+    try:
+        while True:
+            msg = conn.recv()
+            if msg[0] == "round":
+                _, window_end, incoming = msg
+                engine.window_end = window_end
+                for dst, t, src, seq, data in incoming:
+                    engine.hosts[dst].queue.push(
+                        Event(t, EventKind.PACKET, src_host=src, seq=seq,
+                              data=data)
+                    )
+                for h in owned_hosts:
+                    h.execute(window_end)
+                # ship cross-partition sends: the local replicas of
+                # non-owned destinations collected them in their inboxes
+                outbound = []
+                for hid, h in enumerate(engine.hosts):
+                    if hid not in owned_set and h.inbox:
+                        outbound.extend(
+                            (hid, ev.time, ev.src_host, ev.seq, ev.data)
+                            for ev in h.inbox
+                        )
+                        h.inbox.clear()
+                # own-partition barrier merge (inbox drain, log/latency
+                # fold) — only owned hosts ever have content
+                engine._barrier_merge()
+                next_t = min(
+                    (h.queue.next_time() for h in owned_hosts),
+                    default=stime.NEVER,
+                )
+                mul = engine._min_used_lat
+                conn.send((next_t, outbound, mul))
+            elif msg[0] == "finish":
+                engine.finalize()
+                counters: dict[str, int] = {}
+                for h in owned_hosts:
+                    for k, v in h.counters.items():
+                        counters[k] = counters.get(k, 0) + v
+                conn.send((
+                    engine.event_log,
+                    counters,
+                    {i: dict(engine.hosts[i].counters) for i in owned},
+                    list(getattr(engine, "process_errors", [])),
+                ))
+                return
+            else:  # pragma: no cover - protocol error
+                return
+    finally:
+        conn.close()
+
+
+class MpCpuEngine:
+    """Fork-based parallel twin of CpuEngine for pure-model workloads."""
+
+    def __init__(self, cfg: ConfigOptions, workers: int = 0) -> None:
+        cfg.validate()
+        from ..models.base import _REGISTRY
+
+        for hopt in cfg.hosts:
+            if hopt.pcap_enabled:
+                raise ValueError(
+                    "MpCpuEngine does not support pcap capture (every "
+                    "worker replica would open the capture files); use "
+                    "CpuEngine"
+                )
+            for p in hopt.processes:
+                # create_model's dispatch rule without instantiating
+                # thousands of throwaway models: a non-registered path is
+                # the native-shim (managed process) tier
+                if p.path not in _REGISTRY:
+                    raise ValueError(
+                        "MpCpuEngine runs pure-model hosts only; managed "
+                        "OS processes use CpuEngine's threaded scheduler "
+                        "(which genuinely parallelizes them)"
+                    )
+        self.cfg = cfg
+        self.workers = workers if workers > 0 else (os.cpu_count() or 1)
+        self.workers = max(1, min(self.workers, len(cfg.hosts)))
+
+    def run(self) -> SimResult:
+        if self.workers == 1:
+            # degenerate case (single-core box): forking one worker only
+            # adds pipe overhead — run in-process, same results
+            return CpuEngine(self.cfg).run()
+        # the parent's replica serves the Controller role: initial
+        # next-event times, runahead, stop time (no host ever executes
+        # here)
+        ctl = CpuEngine(self.cfg)
+        stop = ctl.stop_time
+        n = len(ctl.hosts)
+        parts = _partition(n, self.workers)
+        owner_of = [hid % self.workers for hid in range(n)]
+
+        ctx = mp.get_context("fork")
+        conns, procs = [], []
+        for w, owned in enumerate(parts):
+            parent_conn, child_conn = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker_main, args=(ctl, owned, child_conn),
+                daemon=True,
+            )
+            p.start()
+            child_conn.close()
+            conns.append(parent_conn)
+            procs.append(p)
+
+        t0 = wall_time.perf_counter()
+        try:
+            next_times = [
+                min((ctl.hosts[i].queue.next_time() for i in owned),
+                    default=stime.NEVER)
+                for owned in parts
+            ]
+            pending: list[list] = [[] for _ in range(self.workers)]
+            min_used_lat = None
+            rounds = 0
+            while True:
+                start = min(next_times)
+                if start >= stop or start == stime.NEVER:
+                    break
+                if ctl.dynamic_runahead and min_used_lat is not None:
+                    ra = max(min_used_lat, ctl._runahead_floor, 1)
+                else:
+                    ra = ctl.runahead
+                window_end = min(start + ra, stop)
+                for w, conn in enumerate(conns):
+                    conn.send(("round", window_end, pending[w]))
+                    pending[w] = []
+                for w, conn in enumerate(conns):
+                    next_t, outbound, mul = conn.recv()
+                    next_times[w] = next_t
+                    if mul is not None and (
+                        min_used_lat is None or mul < min_used_lat
+                    ):
+                        min_used_lat = mul
+                    for pkt in outbound:
+                        pending[owner_of[pkt[0]]].append(pkt)
+                # in-flight cross-partition packets lower the owners'
+                # next-event times before the next window is computed
+                for w in range(self.workers):
+                    for pkt in pending[w]:
+                        if pkt[1] < next_times[w]:
+                            next_times[w] = pkt[1]
+                rounds += 1
+
+            event_log: list = []
+            counters: dict[str, int] = {}
+            per_host: list[dict] = [{} for _ in range(n)]
+            process_errors: list[str] = []
+            for conn in conns:
+                conn.send(("finish",))
+            for conn in conns:
+                log, cnt, per, errs = conn.recv()
+                event_log.extend(log)
+                for k, v in cnt.items():
+                    counters[k] = counters.get(k, 0) + v
+                for hid, c in per.items():
+                    per_host[hid] = c
+                process_errors.extend(errs)
+        finally:
+            for conn in conns:
+                conn.close()
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+        wall = wall_time.perf_counter() - t0
+        return SimResult(
+            sim_time_ns=stop,
+            wall_seconds=wall,
+            rounds=rounds,
+            event_log=event_log,
+            counters=counters,
+            per_host_counters=per_host,
+            process_errors=process_errors,
+        )

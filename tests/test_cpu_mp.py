@@ -1,0 +1,85 @@
+"""The fork-based parallel CPU backend produces results identical to the
+serial engine for every pure-model workload — the parallelism-invariance
+law the reference's determinism suite enforces across worker counts."""
+
+import pytest
+
+from shadow_tpu.backend.cpu_engine import CpuEngine
+from shadow_tpu.backend.cpu_mp import MpCpuEngine
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.config.presets import flagship_mesh_config
+
+PHOLD = """
+general: {stop_time: 500ms, seed: 7}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "2 ms" ]
+        edge [ source 0 target 1 latency "5 ms" ]
+        edge [ source 1 target 1 latency "2 ms" ]
+      ]
+hosts:
+  a: {network_node_id: 0, processes: [{path: phold, args: [--messages, "3"]}]}
+  b: {network_node_id: 1, processes: [{path: phold, args: [--messages, "3"]}]}
+  c: {network_node_id: 1, processes: [{path: phold, args: [--messages, "2"]}]}
+  d: {network_node_id: 0, processes: [{path: phold, args: [--messages, "2"]}]}
+"""
+
+
+@pytest.mark.parametrize("workers", [2, 3])
+def test_phold_parallel_matches_serial(workers):
+    serial = CpuEngine(ConfigOptions.from_yaml(PHOLD)).run()
+    par = MpCpuEngine(ConfigOptions.from_yaml(PHOLD), workers=workers).run()
+    assert len(serial.event_log) > 50
+    assert par.log_tuples() == serial.log_tuples()
+    assert par.counters == serial.counters
+    assert par.rounds == serial.rounds
+
+
+def test_mesh_parallel_matches_serial():
+    cfg = flagship_mesh_config(24, sim_seconds=2, backend="cpu")
+    serial = CpuEngine(cfg).run()
+    cfg2 = flagship_mesh_config(24, sim_seconds=2, backend="cpu")
+    par = MpCpuEngine(cfg2, workers=4).run()
+    assert par.log_tuples() == serial.log_tuples()
+    assert par.counters == serial.counters
+
+
+def test_mixed_stream_parallel_matches_serial():
+    cfg = flagship_mesh_config(20, sim_seconds=2, queue_capacity=48,
+                               stream_pairs=2, stream_bytes=150_000,
+                               backend="cpu")
+    serial = CpuEngine(cfg).run()
+    cfg2 = flagship_mesh_config(20, sim_seconds=2, queue_capacity=48,
+                                stream_pairs=2, stream_bytes=150_000,
+                                backend="cpu")
+    par = MpCpuEngine(cfg2, workers=3).run()
+    assert par.log_tuples() == serial.log_tuples()
+    shared = {k: v for k, v in par.counters.items()
+              if k in serial.counters}
+    assert shared == serial.counters
+    assert par.counters.get("stream_flows_done") == 2
+
+
+def test_managed_processes_rejected(tmp_path):
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    subprocess.run(["make", "-C", str(repo / "native")], check=True,
+                   capture_output=True)
+    cfg = ConfigOptions.from_yaml(f"""
+general: {{stop_time: 1s, seed: 7, data_directory: {tmp_path}}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  solo:
+    network_node_id: 0
+    processes: [{{path: {repo / 'native' / 'build' / 'spinner'}}}]
+""")
+    with pytest.raises(ValueError, match="pure-model"):
+        MpCpuEngine(cfg, workers=2)
