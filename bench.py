@@ -123,12 +123,13 @@ def main() -> None:
             "SHADOW_TPU_BENCH_CPU_WORKERS", str(os.cpu_count() or 1)
         ))
         cpu_cfg = _pure_cfg(CPU_SIM_SECONDS, backend="cpu")
+        cpu_eng = MpCpuEngine(cpu_cfg, workers=workers)
         t0 = time.perf_counter()
-        MpCpuEngine(cpu_cfg, workers=workers).run()
+        cpu_eng.run()
         cpu_rate = CPU_SIM_SECONDS / (time.perf_counter() - t0)
         out["cpu_sim_s_per_wall_s"] = round(cpu_rate, 4)
         out["speedup_vs_cpu_backend"] = round(value / cpu_rate, 2)
-        out["cpu_parallelism"] = workers
+        out["cpu_parallelism"] = cpu_eng.workers  # effective, post-clamp
     print(json.dumps(out))
 
 

@@ -1,0 +1,183 @@
+/* relay: a poll-based TCP forwarding proxy — the minimal shape of a Tor
+ * relay (accept, dial upstream, pump bytes both ways, many concurrent
+ * circuits in one process).  Used by the Tor-shaped scale scenario:
+ * chains of these carry real HTTP clients' traffic across the simulated
+ * network (the reference's tor-minimal stand-in).
+ *
+ *   relay LISTEN_PORT UPSTREAM_HOST UPSTREAM_PORT [MAX_CIRCUITS]
+ *
+ * Exits 0 after MAX_CIRCUITS circuits have fully closed (default: run
+ * until the simulation stops it). */
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define MAXC 64
+#define BUF 16384
+
+typedef struct {
+    int down;     /* client-facing fd (-1 = slot free) */
+    int up;       /* upstream-facing fd */
+    int down_eof; /* half-close bookkeeping */
+    int up_eof;
+    long fwd, rev;
+} circuit;
+
+static circuit circ[MAXC];
+static long done_circuits, total_fwd, total_rev;
+
+static int dial(const char *host, int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &sa.sin_addr) != 1) {
+        /* simulated-DNS hostname (the shim answers getaddrinfo) */
+        struct addrinfo hints, *res = NULL;
+        memset(&hints, 0, sizeof(hints));
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        if (getaddrinfo(host, NULL, &hints, &res) != 0 || !res) {
+            close(fd);
+            return -1;
+        }
+        sa.sin_addr = ((struct sockaddr_in *)res->ai_addr)->sin_addr;
+        freeaddrinfo(res);
+    }
+    if (connect(fd, (struct sockaddr *)&sa, sizeof(sa)) != 0) {
+        close(fd);
+        return -1;
+    }
+    return fd;
+}
+
+static void circuit_close(circuit *c) {
+    if (c->down >= 0) close(c->down);
+    if (c->up >= 0) close(c->up);
+    total_fwd += c->fwd;
+    total_rev += c->rev;
+    c->down = c->up = -1;
+    done_circuits++;
+}
+
+/* one direction: read from src, write all to dst; returns 0 on EOF */
+static int pump(int src, int dst, long *count) {
+    char buf[BUF];
+    ssize_t n = read(src, buf, sizeof(buf));
+    if (n <= 0) return 0;
+    ssize_t off = 0;
+    while (off < n) {
+        ssize_t w = write(dst, buf + off, (size_t)(n - off));
+        if (w <= 0) return 0;
+        off += w;
+    }
+    *count += n;
+    return 1;
+}
+
+int main(int argc, char **argv) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    if (argc < 4) {
+        fprintf(stderr, "usage: relay PORT UP_HOST UP_PORT [MAX]\n");
+        return 2;
+    }
+    int port = atoi(argv[1]);
+    const char *up_host = argv[2];
+    int up_port = atoi(argv[3]);
+    long max_circuits = argc > 4 ? atol(argv[4]) : -1;
+    for (int i = 0; i < MAXC; i++) circ[i].down = circ[i].up = -1;
+
+    int ls = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    sa.sin_addr.s_addr = INADDR_ANY;
+    int one = 1;
+    setsockopt(ls, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (bind(ls, (struct sockaddr *)&sa, sizeof(sa)) != 0 ||
+        listen(ls, 32) != 0) {
+        perror("listen");
+        return 1;
+    }
+
+    while (max_circuits < 0 || done_circuits < max_circuits) {
+        struct pollfd pfd[1 + 2 * MAXC];
+        int map[1 + 2 * MAXC]; /* pfd index -> circuit*2 + dir */
+        int np = 0;
+        pfd[np].fd = ls;
+        pfd[np].events = POLLIN;
+        map[np++] = -1;
+        for (int i = 0; i < MAXC; i++) {
+            if (circ[i].down < 0) continue;
+            if (!circ[i].down_eof) {
+                pfd[np].fd = circ[i].down;
+                pfd[np].events = POLLIN;
+                map[np++] = i * 2;
+            }
+            if (!circ[i].up_eof) {
+                pfd[np].fd = circ[i].up;
+                pfd[np].events = POLLIN;
+                map[np++] = i * 2 + 1;
+            }
+        }
+        if (poll(pfd, (nfds_t)np, -1) < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        for (int p = 0; p < np; p++) {
+            if (!(pfd[p].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+            if (map[p] == -1) {
+                int down = accept(ls, NULL, NULL);
+                if (down < 0) continue;
+                int slot = -1;
+                for (int i = 0; i < MAXC; i++)
+                    if (circ[i].down < 0) {
+                        slot = i;
+                        break;
+                    }
+                if (slot < 0) {
+                    close(down);
+                    continue;
+                }
+                int up = dial(up_host, up_port);
+                if (up < 0) {
+                    close(down);
+                    continue;
+                }
+                circ[slot].down = down;
+                circ[slot].up = up;
+                circ[slot].down_eof = circ[slot].up_eof = 0;
+                circ[slot].fwd = circ[slot].rev = 0;
+                continue;
+            }
+            circuit *c = &circ[map[p] / 2];
+            if (c->down < 0) continue; /* closed earlier this sweep */
+            if (map[p] % 2 == 0) {
+                if (!pump(c->down, c->up, &c->fwd)) {
+                    c->down_eof = 1;
+                    shutdown(c->up, SHUT_WR);
+                }
+            } else {
+                if (!pump(c->up, c->down, &c->rev)) {
+                    c->up_eof = 1;
+                    shutdown(c->down, SHUT_WR);
+                }
+            }
+            if (c->down_eof && c->up_eof) circuit_close(c);
+        }
+    }
+    printf("relay done circuits=%ld fwd=%ld rev=%ld\n", done_circuits,
+           total_fwd, total_rev);
+    return 0;
+}

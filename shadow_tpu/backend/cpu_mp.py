@@ -167,11 +167,10 @@ class MpCpuEngine:
                 start = min(next_times)
                 if start >= stop or start == stime.NEVER:
                     break
-                if ctl.dynamic_runahead and min_used_lat is not None:
-                    ra = max(min_used_lat, ctl._runahead_floor, 1)
-                else:
-                    ra = ctl.runahead
-                window_end = min(start + ra, stop)
+                # one source of truth for the window law: feed the folded
+                # latency into the serial engine's own formula
+                ctl._min_used_lat = min_used_lat
+                window_end = min(start + ctl.current_runahead(), stop)
                 for w, conn in enumerate(conns):
                     conn.send(("round", window_end, pending[w]))
                     pending[w] = []

@@ -230,6 +230,7 @@ class ManagedApp:
         self.finished = False
         self.exit_code: Optional[int] = None
         self._stdout_file = None
+        self._stderr_file = None
         self._strace_file = None
         self._strace_mode = "off"
         self._api = None  # host handle, set at on_start (needed for teardown)
@@ -425,12 +426,16 @@ class ManagedApp:
             env["SHADOW_TPU_PREEMPT_NS"] = str(PREEMPT_QUANTUM_NS)
         if self._exp is not None and not self._exp.use_vdso_patching:
             env["SHADOW_TPU_VDSO"] = "0"
+        # separate stderr file (the reference's per-process data-dir
+        # layout): shim warnings and app diagnostics must never corrupt
+        # the app's stdout stream
         self._stdout_file = open(host_dir / f"{stem}.stdout", "wb")
+        self._stderr_file = open(host_dir / f"{stem}.stderr", "wb")
         self.proc = subprocess.Popen(
             self.argv,
             env=env,
             stdout=self._stdout_file,
-            stderr=subprocess.STDOUT,
+            stderr=self._stderr_file,
             stdin=subprocess.DEVNULL,
         )
         self.procs.append(_Proc(chan, popen=self.proc, label="root"))
@@ -2357,6 +2362,9 @@ class ManagedApp:
         if self._stdout_file:
             self._stdout_file.close()
             self._stdout_file = None
+        if self._stderr_file:
+            self._stderr_file.close()
+            self._stderr_file = None
         if self._strace_file:
             self._strace_file.close()
             self._strace_file = None

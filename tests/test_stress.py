@@ -168,3 +168,16 @@ hosts:
         args: [basic, '6']
 """
     )
+
+
+def test_stress_tor_shaped_chains(tmp_path):
+    """The Tor-shaped scale scenario (62 hosts, 22 managed processes in
+    relay chains + background mesh) under repetition — the closest
+    in-repo analog of the reference's tor-minimal determinism gate."""
+    import shutil
+
+    if shutil.which("curl") is None:
+        pytest.skip("curl not installed")
+    from test_tor_shaped import tor_shaped_yaml
+
+    _repeat_identical(tor_shaped_yaml(tmp_path, "d"))

@@ -1,0 +1,159 @@
+"""The Tor-shaped scale scenario (BASELINE config #5's stand-in, matching
+src/test/tor/minimal/tor-minimal.yaml in spirit — tor itself is not
+installable here): chains of real relay processes carry real HTTP
+clients' traffic across a multi-node simulated network, alongside
+model-host background traffic.
+
+62 hosts, 22 concurrent MANAGED OS processes: one CPython http.server
+origin, nine poll-based C relays in three 3-hop chains (guard -> middle
+-> exit -> origin), twelve unmodified curl clients fetching through the
+chains with staggered starts, and forty tgen-mesh model hosts keeping
+every window busy.  This stresses the scheduler under real concurrency,
+per-process channels at scale, getaddrinfo chains, and wait/exit
+bookkeeping — deterministically.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.sim import Simulation
+
+REPO = Path(__file__).resolve().parents[1]
+BUILD = REPO / "native" / "build"
+CURL = shutil.which("curl")
+PY = "/usr/bin/python3"
+
+N_CHAINS = 3
+CLIENTS_PER_CHAIN = 4
+N_PEERS = 40
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_build():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+    )
+    assert (BUILD / "relay").exists()
+
+
+def tor_shaped_yaml(base: Path, tag: str) -> str:
+    """Build the scenario config (shared with the stress gate)."""
+    import os
+
+    docroot = base / tag / "www"
+    docroot.mkdir(parents=True, exist_ok=True)
+    (docroot / "a.txt").write_text("onion says hello through the chain\n")
+    os.utime(docroot / "a.txt", (946684800, 946684800))
+    data = base / tag / "data"
+
+    hosts = [f"""
+  www:
+    network_node_id: 0
+    processes:
+      - path: {PY}
+        args: [-m, http.server, "8080", --bind, 0.0.0.0, --directory, {docroot}]
+        expected_final_state: running
+"""]
+    for c in range(N_CHAINS):
+        hosts.append(f"""
+  exit{c}:
+    network_node_id: 1
+    processes:
+      - path: {BUILD / 'relay'}
+        args: ["9000", www, "8080"]
+        start_time: 500ms
+        expected_final_state: running
+  middle{c}:
+    network_node_id: 2
+    processes:
+      - path: {BUILD / 'relay'}
+        args: ["9000", exit{c}, "9000"]
+        start_time: 700ms
+        expected_final_state: running
+  guard{c}:
+    network_node_id: 2
+    processes:
+      - path: {BUILD / 'relay'}
+        args: ["9000", middle{c}, "9000"]
+        start_time: 900ms
+        expected_final_state: running
+""")
+        for k in range(CLIENTS_PER_CHAIN):
+            hosts.append(f"""
+  client{c}x{k}:
+    network_node_id: 3
+    processes:
+      - path: {CURL}
+        args: [-s, --max-time, "40", http://guard{c}:9000/a.txt]
+        start_time: {2000 + 500 * k + 137 * c}ms
+""")
+    hosts.append(f"""
+  peer:
+    count: {N_PEERS}
+    network_node_id: 1
+    processes:
+      - path: tgen-mesh
+        args: [--interval, 50ms, --size, "600"]
+        start_time: 0 s
+""")
+    return f"""
+general: {{stop_time: 30s, seed: 42, data_directory: {data}, heartbeat_interval: null}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        node [ id 2 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        node [ id 3 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+        edge [ source 1 target 1 latency "2 ms" ]
+        edge [ source 2 target 2 latency "3 ms" ]
+        edge [ source 3 target 3 latency "2 ms" ]
+        edge [ source 0 target 1 latency "8 ms" ]
+        edge [ source 1 target 2 latency "15 ms" ]
+        edge [ source 2 target 3 latency "10 ms" ]
+      ]
+hosts:
+{''.join(hosts)}
+"""
+
+
+def _run(tmp_path: Path, tag: str):
+    cfg = ConfigOptions.from_yaml(tor_shaped_yaml(tmp_path, tag))
+    result = Simulation(cfg).run()
+    return result, tmp_path / tag / "data"
+
+
+@pytest.mark.skipif(CURL is None, reason="curl not installed")
+def test_tor_shaped_chains(tmp_path):
+    result, data = _run(tmp_path, "a")
+    for c in range(N_CHAINS):
+        for k in range(CLIENTS_PER_CHAIN):
+            out = (data / "hosts" / f"client{c}x{k}" /
+                   "curl.stdout").read_text()
+            assert out == "onion says hello through the chain\n", (
+                f"client{c}x{k}: {out!r}"
+            )
+    assert not result.process_errors
+    assert result.counters["managed_procs"] >= 22
+    # background mesh kept flowing the whole time
+    assert result.counters.get("tgen_recv_bytes", 0) > 100_000
+
+
+@pytest.mark.skipif(CURL is None, reason="curl not installed")
+def test_tor_shaped_deterministic(tmp_path):
+    r1, d1 = _run(tmp_path, "r1")
+    r2, d2 = _run(tmp_path, "r2")
+    assert r1.log_tuples() == r2.log_tuples()
+    assert r1.counters == r2.counters
+    for c in range(N_CHAINS):
+        for k in range(CLIENTS_PER_CHAIN):
+            f = Path("hosts") / f"client{c}x{k}" / "curl.stdout"
+            assert (d1 / f).read_text() == (d2 / f).read_text()
