@@ -81,6 +81,11 @@ NEVER = stime.NEVER
 PASSIVE_MODELS = frozenset({M_NONE, M_TGEN_MESH, M_TGEN_CLIENT, M_TGEN_SERVER})
 STREAM_MODELS = frozenset({M_STREAM_CLIENT, M_STREAM_SERVER})
 
+# LOCAL size marker: a non-driving process's start event on a
+# multi-process lane host — anchors the window like any start, drives
+# nothing (the driver's start is -1)
+SZ_ANCHOR = -5
+
 # ---- event key representation ---------------------------------------------
 # TPU has no native int64 (every i64 op lowers to X64Split/Combine custom
 # calls that cannot fuse, fragmenting the while body into hundreds of tiny
@@ -272,6 +277,9 @@ class LaneParams:
     # any lane captures pcap (static): sends emit PCAP_TX records into the
     # device log at departure time
     pcap_any: bool = False
+    # any STREAM endpoint lane captures (static): gates the compacted
+    # pcap channels so non-capturing stream sims pay nothing for them
+    stream_pcap: bool = False
     # window-advance+pop steps per fused while-loop trip (amortizes the
     # ~350 us per-iteration host round-trip of the tunneled runtime).
     # Multiplies XLA compile time with the body size — worth it for small
@@ -322,6 +330,7 @@ class LaneTables(NamedTuple):
     dn_kfull: jnp.ndarray
     dn_kfi: jnp.ndarray
     model: jnp.ndarray  # [N] int32 model id
+    recv_mult: jnp.ndarray  # [N] int32: counting apps per lane
     p_size: jnp.ndarray  # [N] int32 datagram size
     p_int_hi: jnp.ndarray  # [N] int32 pair: timer interval ns
     p_int_lo: jnp.ndarray
@@ -347,6 +356,7 @@ class LaneTables(NamedTuple):
     flow_up_burst: jnp.ndarray  # [2S] int32
     flow_up_kfull: jnp.ndarray  # [2S] int32
     flow_up_kfi: jnp.ndarray  # [2S] int32
+    flow_pcap: jnp.ndarray  # [2S] bool: the endpoint lane captures pcap
     lane_pcap: jnp.ndarray  # [N] bool: host captures pcap
 
 
@@ -663,6 +673,16 @@ class _SlotEmit(NamedTuple):
     brec_time: Any
     brec_seq: Any
     brec_size: Any
+    # stream outbound pcap captures at bucket DEPARTURE, pre-loss ([2S]
+    # slot-0 / [PUMP_BURST, S] burst; () unless pcap+stream)
+    spc_valid: Any
+    spc_time: Any
+    spc_seq: Any
+    spc_size: Any
+    bpc_valid: Any
+    bpc_time: Any
+    bpc_seq: Any
+    bpc_size: Any
     # outbound pcap channel (int64; () unless pcap_any)
     pc_valid: Any
     pc_time: Any
@@ -732,10 +752,13 @@ def _process_slot(
     passive = false_n
     for _m in sorted(PASSIVE_MODELS & mp):
         passive = passive | (model == _m)
+    # every counting app on the host adds the size (the CPU oracle
+    # dispatches each delivery to every app): recv_mult is the per-lane
+    # app count — 1 on single-process lanes, 0 on empty ones
     inline_del = deliver & passive
     s = s._replace(
         recv_bytes=s.recv_bytes
-        + jnp.where(inline_del & (model != M_NONE), size, 0)
+        + jnp.where(inline_del, size * tb.recv_mult, 0)
     )
     all_passive = mp <= PASSIVE_MODELS
     ins_valid = false_n if all_passive else (deliver & ~passive)
@@ -766,7 +789,9 @@ def _process_slot(
     # model's first timer without sending.
     is_loc = active & (kind == LOCAL)
     is_start = is_loc & (size == -1)
-    is_timer = is_loc & ~is_start
+    # negative sizes are markers (start -1, stream pump/rto -2/-3,
+    # multi-process start anchors -5), never timer ticks
+    is_timer = is_loc & (size >= 0)
     loc_send_phold = (is_timer & (model == M_PHOLD)) if M_PHOLD in mp else false_n
     mesh_tick = (
         (is_timer & (model == M_TGEN_MESH) & (n > 1))
@@ -1080,7 +1105,7 @@ def _process_slot(
             bphi, bplo = lstr.pack_pay(bflags, bunit, back)
             outs = (
                 bm & ~blost, barr_hi, barr_lo, bseq, bsize, bphi, bplo,
-                blost,
+                blost, bdep_hi, bdep_lo,
             )
             return (tok, nrh, nrl, ldh, ldl, nloss, mul,
                     sent_before + bm), outs
@@ -1141,7 +1166,23 @@ def _process_slot(
         )
 
         (bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
-         blost_all) = bouts  # [B, S] each
+         blost_all, bdep_hi_all, bdep_lo_all) = bouts  # [B, S] each
+        if p.stream_pcap and p.log_capacity:
+            # outbound captures at bucket departure, PRE-loss (the CPU
+            # path's capture point); stream payloads synthesize from
+            # sizes alone on both backends, so (time, seq, size) + the
+            # static flow tables reproduce the files byte-identically
+            spc_valid = st_send & tb.flow_pcap
+            spc_time = t_join(se_dep_hi, se_dep_lo)
+            spc_seq = se_seq.astype(i64)
+            spc_size = se_size.astype(i64)
+            bpc_valid = (bo_valid | blost_all) & tb.flow_pcap[cl_sl][None, :]
+            bpc_time = t_join(bdep_hi_all, bdep_lo_all)
+            bpc_seq = bo_auxl.astype(i64)
+            bpc_size = bo_size.astype(i64)
+        else:
+            spc_valid = spc_time = spc_seq = spc_size = ()
+            bpc_valid = bpc_time = bpc_seq = bpc_size = ()
         if p.log_capacity:
             et64 = t_join(ethi, etlo)
             srec_valid = se_lost
@@ -1164,6 +1205,8 @@ def _process_slot(
         bo_valid = bo_thi = bo_tlo = bo_auxl = bo_size = bo_phi = bo_plo = ()
         srec_valid = srec_time = srec_seq = srec_size = ()
         brec_valid = brec_time = brec_seq = brec_size = ()
+        spc_valid = spc_time = spc_seq = spc_size = ()
+        bpc_valid = bpc_time = bpc_seq = bpc_size = ()
 
     # ---- local arm channels ---------------------------------------------
     has_timer = (
@@ -1212,6 +1255,8 @@ def _process_slot(
         bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
         srec_valid, srec_time, srec_seq, srec_size,
         brec_valid, brec_time, brec_seq, brec_size,
+        spc_valid, spc_time, spc_seq, spc_size,
+        bpc_valid, bpc_time, bpc_seq, bpc_size,
         pc_valid, pc_time, pc_dst, pc_seq, pc_size,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
     )
@@ -1845,15 +1890,25 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                         b64 = jnp.zeros(bshape, dtype=jnp.int64)
                         srec = (eb, e64, e64, e64)
                         brec = (bo_b, b64, b64, b64)
+                        if p.stream_pcap:
+                            spc = (eb, e64, e64, e64)
+                            bpc = (bo_b, b64, b64, b64)
+                        else:
+                            spc = ((),) * 4
+                            bpc = ((),) * 4
                     else:
                         srec = ((), (), (), ())
                         brec = ((), (), (), ())
+                        spc = ((),) * 4
+                        bpc = ((),) * 4
                 else:
                     se = ((),) * 7
                     sa = ((),) * 4
                     bo = ((),) * 7
                     srec = ((),) * 4
                     brec = ((),) * 4
+                    spc = ((),) * 4
+                    bpc = ((),) * 4
                 if p.pcap_any:
                     pc = (nb, z64, z64, z64, z64)
                 else:
@@ -1862,7 +1917,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                     nb, z32, z32, z32, z32, z32, z32, z32,
                     nb, z32, z32, z32, z32, z32, z32,
                     nb, z32, z32, z32, z32, z32, z32, z32, z32,
-                    *se, *sa, *bo, *srec, *brec,
+                    *se, *sa, *bo, *srec, *brec, *spc, *bpc,
                     *pc,
                     nb, z64, z64, z64, z64, z64, z64,
                 )
@@ -1934,6 +1989,35 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 "size": emits.pc_size.reshape(-1),
                 "outcome": jnp.full((kk * p.n_lanes,), PCAP_TX,
                                     dtype=jnp.int64),
+            })
+        if p.stream_present and p.stream_pcap and p.log_capacity:
+            # stream outbound pcap captures (PCAP_TX at departure)
+            kk, s2 = emits.spc_valid.shape
+            s_flows = s2 // 2
+            el64 = tb.flow_lanes.astype(jnp.int64)
+            pe64 = tb.flow_peers.astype(jnp.int64)
+            s = _append_log(p, s, {
+                "valid": emits.spc_valid.reshape(-1),
+                "time": emits.spc_time.reshape(-1),
+                "src": jnp.broadcast_to(el64[None, :], (kk, s2)).reshape(-1),
+                "dst": jnp.broadcast_to(pe64[None, :], (kk, s2)).reshape(-1),
+                "seq": emits.spc_seq.reshape(-1),
+                "size": emits.spc_size.reshape(-1),
+                "outcome": jnp.full((kk * s2,), PCAP_TX, dtype=jnp.int64),
+            })
+            kk, bb, _ss = emits.bpc_valid.shape
+            shape_b = (kk, bb, s_flows)
+            s = _append_log(p, s, {
+                "valid": emits.bpc_valid.reshape(-1),
+                "time": emits.bpc_time.reshape(-1),
+                "src": jnp.broadcast_to(
+                    el64[:s_flows][None, None, :], shape_b).reshape(-1),
+                "dst": jnp.broadcast_to(
+                    pe64[:s_flows][None, None, :], shape_b).reshape(-1),
+                "seq": emits.bpc_seq.reshape(-1),
+                "size": emits.bpc_size.reshape(-1),
+                "outcome": jnp.full(
+                    (kk * bb * s_flows,), PCAP_TX, dtype=jnp.int64),
             })
         if p.stream_present and p.log_capacity:
             # stream loss records (DROP_LOSS at the send instant): slot-0

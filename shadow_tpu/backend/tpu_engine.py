@@ -85,20 +85,75 @@ class TpuEngine:
         init_events: list[tuple[int, int, int, int, int, int]] = []  # lane,t,kind,src,seq,size
         local_seq0 = np.ones(n, dtype=np.int64)
 
+        recv_mult = np.zeros(n, dtype=np.int32)
+
+        def assign_tgen(hid: int, a) -> None:
+            """One source of truth for tgen model/table assignment —
+            shared by the single-process and multi-process (driver)
+            paths."""
+            if isinstance(a, TgenMesh):
+                model[hid] = lanes.M_TGEN_MESH
+                p_size[hid] = a.size
+                p_interval[hid] = a.interval
+                p_stride[hid] = a.stride
+            elif isinstance(a, TgenClient):
+                model[hid] = lanes.M_TGEN_CLIENT
+                p_size[hid] = a.size
+                p_interval[hid] = a.interval
+                p_peer[hid] = self._resolve(a.server, n)
+            else:
+                model[hid] = lanes.M_TGEN_SERVER
+
         for hid, hopt in enumerate(cfg.hosts):
-            if len(hopt.processes) > 1:
-                raise LaneCompatError(
-                    f"host {hopt.hostname!r} has {len(hopt.processes)} processes; "
-                    "the lane backend supports at most one per host"
-                )
             # pcap: sends emit PCAP_TX records into the device log, and
             # collect() reconstructs per-host capture files byte-identical
             # to the CPU backend's (synthetic payloads either way)
             if not hopt.processes:
                 model[hid] = lanes.M_NONE
                 continue
-            proc = hopt.processes[0]
-            app = create_model(proc.path, list(proc.args))
+            apps = [
+                (p, create_model(p.path, list(p.args)))
+                for p in hopt.processes
+            ]
+            if len(apps) > 1:
+                # MULTI-PROCESS hosts: supported for tgen mesh/client/
+                # server combinations with at most one timer-driving
+                # process — the lane's model id is the driver's, other
+                # processes contribute start anchors and delivery
+                # counting (recv_mult).  The CPU oracle dispatches every
+                # delivery to every app, so k counting apps multiply the
+                # recv accounting by k on both backends.
+                trio = (TgenMesh, TgenClient, TgenServer)
+                if not all(isinstance(a, trio) for _p, a in apps):
+                    raise LaneCompatError(
+                        f"host {hopt.hostname!r}: multi-process lane "
+                        "hosts support tgen mesh/client/server "
+                        "combinations only; use the cpu backend"
+                    )
+                drivers = [
+                    (p, a) for p, a in apps
+                    if isinstance(a, (TgenMesh, TgenClient))
+                ]
+                if len(drivers) > 1:
+                    raise LaneCompatError(
+                        f"host {hopt.hostname!r}: at most one "
+                        "timer-driving process per lane host; use the "
+                        "cpu backend"
+                    )
+                recv_mult[hid] = len(apps)
+                driver = drivers[0] if drivers else apps[0]
+                seq = 0
+                for p, a in apps:
+                    init_events.append((
+                        hid, p.start_time, lanes.LOCAL, hid, seq,
+                        -1 if a is driver[1] else lanes.SZ_ANCHOR,
+                    ))
+                    seq += 1
+                local_seq0[hid] = seq
+                assign_tgen(hid, driver[1])
+                continue
+            recv_mult[hid] = 1
+            proc, app = apps[0]
             t0 = proc.start_time
             if isinstance(app, Phold):
                 model[hid] = lanes.M_PHOLD
@@ -106,20 +161,8 @@ class TpuEngine:
                 for i in range(app.messages):
                     init_events.append((hid, t0, lanes.LOCAL, hid, i, 0))
                 local_seq0[hid] = max(app.messages, 1)
-            elif isinstance(app, TgenMesh):
-                model[hid] = lanes.M_TGEN_MESH
-                p_size[hid] = app.size
-                p_interval[hid] = app.interval
-                p_stride[hid] = app.stride
-                init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
-            elif isinstance(app, TgenClient):
-                model[hid] = lanes.M_TGEN_CLIENT
-                p_size[hid] = app.size
-                p_interval[hid] = app.interval
-                p_peer[hid] = self._resolve(app.server, n)
-                init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
-            elif isinstance(app, TgenServer):
-                model[hid] = lanes.M_TGEN_SERVER
+            elif isinstance(app, (TgenMesh, TgenClient, TgenServer)):
+                assign_tgen(hid, app)
                 init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
             elif isinstance(app, StreamClient):
                 model[hid] = lanes.M_STREAM_CLIENT
@@ -211,14 +254,9 @@ class TpuEngine:
                 "log; log_capacity=0 disables it — use the cpu backend or "
                 "enable logging"
             )
-        if pcap_any and any(
-            int(m) in (lanes.M_STREAM_CLIENT, lanes.M_STREAM_SERVER)
-            for m in model
-        ):
-            raise LaneCompatError(
-                "pcap with the stream tier is not lane-compiled yet; use "
-                "the cpu backend"
-            )
+        # pcap + stream works since round 4: stream sends emit PCAP_TX
+        # records through their compacted channels at departure, and both
+        # backends synthesize stream bodies from sizes alone
 
         self.params = lanes.LaneParams(
             n_lanes=n,
@@ -238,6 +276,13 @@ class TpuEngine:
             stream_clients=tuple(int(c) for c in client_ids),
             stream_wide_pop=stream_wide_pop,
             pcap_any=pcap_any,
+            stream_pcap=bool(
+                client_ids.size
+                and lane_pcap[
+                    np.concatenate([client_ids,
+                                    p_peer[client_ids]]).astype(np.int64)
+                ].any()
+            ),
             cross_capacity=cfg.experimental.tpu_cross_capacity,
         )
 
@@ -350,6 +395,7 @@ class TpuEngine:
             dn_kfull=jnp.asarray(dn_kfull),
             dn_kfi=jnp.asarray(dn_kfi),
             model=jnp.asarray(model),
+            recv_mult=jnp.asarray(recv_mult),
             p_size=jnp.asarray(p_size),
             p_int_hi=jnp.asarray(p_interval >> 31, dtype=i32),
             p_int_lo=jnp.asarray(p_interval & lanes.MASK31, dtype=i32),
@@ -372,6 +418,7 @@ class TpuEngine:
             flow_up_burst=jnp.asarray(up[el_np, 1], dtype=i32),
             flow_up_kfull=jnp.asarray(up_kfull[el_np]),
             flow_up_kfi=jnp.asarray(up_kfi[el_np]),
+            flow_pcap=jnp.asarray(lane_pcap[el_np]),
             lane_pcap=jnp.asarray(lane_pcap),
         )
         self._init_events = init_events

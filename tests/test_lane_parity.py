@@ -201,13 +201,64 @@ def test_bootstrap_parity():
 
 
 def test_lane_compat_gate():
-    with pytest.raises(LaneCompatError, match="at most one"):
+    # multi-process is lane-compiled only for tgen-trio combinations
+    # with a single timer driver; everything else names the cpu backend
+    with pytest.raises(LaneCompatError, match="tgen mesh/client/server"):
         TpuEngine(
             ConfigOptions.from_yaml(
                 "general: {stop_time: 1s}\n"
                 "hosts: {a: {processes: [{path: phold}, {path: phold}]}}"
             )
         )
+    with pytest.raises(LaneCompatError, match="at most one timer-driving"):
+        TpuEngine(
+            ConfigOptions.from_yaml(
+                "general: {stop_time: 1s}\n"
+                "hosts:\n"
+                "  a:\n"
+                "    processes:\n"
+                "      - {path: tgen-mesh, args: [--interval, 10ms]}\n"
+                "      - {path: tgen-mesh, args: [--interval, 20ms]}\n"
+            )
+        )
+
+
+MULTIPROC = """
+general: {stop_time: 2s, seed: 13}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  duplex0:
+    network_node_id: 0
+    processes:
+      - {path: tgen-client, args: [--server, duplex1, --interval, 40ms, --size, "700"]}
+      - {path: tgen-server}
+  duplex1:
+    network_node_id: 0
+    processes:
+      - {path: tgen-server}
+      - {path: tgen-client, args: [--server, duplex0, --interval, 55ms, --size, "500"]}
+  sinks:
+    network_node_id: 0
+    processes:
+      - {path: tgen-server}
+      - {path: tgen-server}
+  mesh0:
+    network_node_id: 0
+    processes:
+      - {path: tgen-mesh, args: [--interval, 30ms, --size, "300"]}
+      - {path: tgen-server}
+"""
+
+
+def test_multi_process_host_parity():
+    """Multi-process lane hosts (tgen-trio combos, one driver max):
+    logs bit-identical and counters equal — including the per-app
+    delivery multiplication the CPU oracle performs."""
+    cpu, tpu = both_logs(MULTIPROC, mode="device")
+    assert len(cpu.event_log) > 50
+    assert cpu.log_tuples() == tpu.log_tuples()
+    assert cpu.counters.get("tgen_recv_bytes") == \
+        tpu.counters.get("tgen_recv_bytes")
 
 
 def test_phold_hops_counter_parity():

@@ -205,3 +205,50 @@ def test_pcap_spill_chunks_byte_identical(tmp_path):
     spilled = write(tmp_path / "spill.pcap", 2048)  # many tiny chunks
     assert len(plain) > 1000
     assert plain == spilled
+
+
+def test_stream_tier_pcap_matches_cpu(tmp_path):
+    """pcap with stream (lane-TCP) flows: outbound captures ride the
+    compacted stream channels at bucket-departure time; both backends
+    synthesize stream bodies from sizes alone, so the files are
+    byte-identical."""
+    from shadow_tpu.backend.cpu_engine import CpuEngine
+    from shadow_tpu.backend.tpu_engine import TpuEngine
+
+    def yaml(sub):
+        return f"""
+general: {{stop_time: 4s, seed: 9, data_directory: {tmp_path / sub}}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0  host_bandwidth_up "40 Mbit"  host_bandwidth_down "40 Mbit" ]
+        edge [ source 0  target 0  latency "6 ms" ]
+      ]
+experimental: {{tpu_lane_queue_capacity: 48}}
+hosts:
+  capc:
+    network_node_id: 0
+    pcap_enabled: true
+    processes: [{{path: stream-client, args: [--server, caps, --size, "200000"]}}]
+  caps:
+    network_node_id: 0
+    pcap_enabled: true
+    processes: [{{path: stream-server}}]
+  other:
+    network_node_id: 0
+    processes: [{{path: tgen-mesh, args: [--interval, 9ms, --size, "400"]}}]
+"""
+
+    cpu = CpuEngine(ConfigOptions.from_yaml(yaml("cpu")))
+    rc = cpu.run()
+    tpu = TpuEngine(ConfigOptions.from_yaml(yaml("tpu")))
+    rt = tpu.run(mode="device")
+    assert rt.log_tuples() == rc.log_tuples()
+    assert rt.counters.get("stream_flows_done") == 1
+    for host in ("capc", "caps"):
+        a = (tmp_path / "cpu" / "hosts" / host / "eth0.pcap").read_bytes()
+        b = (tmp_path / "tpu" / "hosts" / host / "eth0.pcap").read_bytes()
+        assert len(a) > 1000
+        assert a == b, f"{host} pcap differs between backends"
