@@ -559,12 +559,21 @@ class TpuEngine:
         be served from the tunneled runtime's cross-process execution
         cache, which keys on (program, input buffers)."""
         state = self.initial_state()
+        self._iters_salt = 0
         if cache_salt:
             state = state._replace(
                 q_auxl=state.q_auxl.at[0, -1].set(
                     int(cache_salt) & 0x7FFFFFFF
                 )
             )
+            # belt and braces: ALSO bias the iters bookkeeping counter by
+            # the salt (subtracted at collect) — it is loop-carried
+            # through every iteration, so no cached execution with a
+            # different salt can serve this run even if the runtime's
+            # cache key misses the inert queue-slot delta (observed once:
+            # a 5-sim-s mixed run "completed" in 2 ms)
+            self._iters_salt = int(cache_salt) & 0xFFFFF
+            state = state._replace(iters=jnp.int32(self._iters_salt))
         if mode == "device":
             # cache the program: repeat runs (bench best-of-N) must not
             # retrace/recompile
@@ -722,7 +731,7 @@ class TpuEngine:
         add("tgen_recv_bytes", int(recv_bytes[tgen_mask].sum()))
         hops = np.asarray(s.n_hops)
         add("phold_hops", int(hops[model == lanes.M_PHOLD].sum()))
-        add("lane_iters", int(s.iters))
+        add("lane_iters", int(s.iters) - getattr(self, "_iters_salt", 0))
         add("lane_delivered", int(delivered.sum()))
         add("lane_drop_loss", int(np.asarray(s.n_loss).sum()))
         add("lane_drop_codel", int(np.asarray(s.n_codel).sum()))
