@@ -1,0 +1,41 @@
+/* inotifier: exercises the inotify stub surface (the reference fork's
+ * minimal inotify stubs): init1, add/rm watch, nonblocking read (EAGAIN),
+ * and a timed poll that must elapse in SIMULATED time with no events. */
+
+#include <errno.h>
+#include <poll.h>
+#include <stdio.h>
+#include <sys/inotify.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000;
+}
+
+int main(void) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    int fd = inotify_init1(IN_NONBLOCK);
+    if (fd < 0) {
+        printf("init failed errno=%d\n", errno);
+        return 1;
+    }
+    int wd1 = inotify_add_watch(fd, ".", IN_CREATE | IN_MODIFY);
+    int wd2 = inotify_add_watch(fd, "/tmp", IN_DELETE);
+    char buf[256];
+    ssize_t r = read(fd, buf, sizeof(buf));
+    int again = (r < 0 && errno == EAGAIN);
+    long long t0 = now_ms();
+    struct pollfd p = {fd, POLLIN, 0};
+    int pr = poll(&p, 1, 150); /* must sleep 150 SIMULATED ms */
+    long long waited = now_ms() - t0;
+    int rm_ok = inotify_rm_watch(fd, wd1) == 0;
+    int rm_bad = inotify_rm_watch(fd, wd1) < 0; /* second remove fails */
+    close(fd);
+    printf("inotify wd1=%d wd2=%d eagain=%d poll=%d waited_ok=%d "
+           "rm_ok=%d rm_bad=%d\n",
+           wd1, wd2, again, pr, waited >= 150, rm_ok, rm_bad);
+    return 0;
+}

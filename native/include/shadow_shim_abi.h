@@ -132,6 +132,13 @@ enum {
     SHIM_OP_ALARM = 45, /* args[0]=deadline ns rel (0 = cancel)
                            args[1]=interval ns (setitimer re-arm);
                            reply args[1]=previous remaining ns */
+    /* inotify as manager-side stub fds (the reference fork's minimal
+     * inotify stubs, handler/inotify.rs): watches succeed, events never
+     * fire */
+    SHIM_OP_INOTIFY_CREATE = 46, /* args[0]=reserved fd */
+    SHIM_OP_INOTIFY_ADD = 47,    /* args[0]=fd args[1]=mask payload=path;
+                                  * ret = watch descriptor */
+    SHIM_OP_INOTIFY_RM = 48,     /* args[0]=fd args[1]=wd */
 };
 
 /* poll event bits (mirror Linux poll.h values) */

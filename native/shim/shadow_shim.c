@@ -2582,6 +2582,55 @@ static void ns_to_ts(int64_t ns, struct timespec *ts) {
     ts->tv_nsec = ns % 1000000000ll;
 }
 
+/* ---- inotify: manager-side stub fds (the reference fork's minimal
+ * inotify stubs, handler/inotify.rs).  Real inotify would watch the REAL
+ * filesystem asynchronously — nondeterministic under the simulation — so
+ * watches succeed and are tracked, but no event ever fires: reads block
+ * in simulated time (EAGAIN when nonblocking), poll reports no
+ * readiness.  Apps that merely register watches keep working. */
+
+#include <sys/inotify.h>
+
+int inotify_init1(int flags) {
+    if (!g_ready)
+        return (int)raw_ret(
+            shim_raw_syscall6(SYS_inotify_init1, flags, 0, 0, 0, 0, 0));
+    int fd = reserve_fd();
+    if (fd < 0) return -1;
+    int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+    int64_t ret =
+        shim_call(SHIM_OP_INOTIFY_CREATE, args, NULL, 0, NULL, NULL, NULL);
+    if (ret < 0) {
+        real_close(fd);
+        errno = (int)-ret;
+        return -1;
+    }
+    vfd_register(fd, (flags & IN_NONBLOCK) != 0, 0);
+    return fd;
+}
+
+int inotify_init(void) { return inotify_init1(0); }
+
+int inotify_add_watch(int fd, const char *pathname, uint32_t mask) {
+    if (!is_vfd(fd))
+        return (int)raw_ret(shim_raw_syscall6(
+            SYS_inotify_add_watch, fd, (long)pathname, mask, 0, 0, 0));
+    int64_t args[6] = {fd, (int64_t)mask, 0, 0, 0, 0};
+    int64_t ret = shim_call(SHIM_OP_INOTIFY_ADD, args, pathname,
+                            (uint32_t)strlen(pathname), NULL, NULL, NULL);
+    return (int)ret_errno(ret);
+}
+
+int inotify_rm_watch(int fd, int wd) {
+    if (!is_vfd(fd))
+        return (int)raw_ret(shim_raw_syscall6(SYS_inotify_rm_watch, fd, wd,
+                                              0, 0, 0, 0));
+    int64_t args[6] = {fd, wd, 0, 0, 0, 0};
+    int64_t ret =
+        shim_call(SHIM_OP_INOTIFY_RM, args, NULL, 0, NULL, NULL, NULL);
+    return (int)ret_errno(ret);
+}
+
 int timerfd_create(int clockid, int flags) {
     if (!g_ready) return (int)raw_timerfd_create(clockid, flags);
     (void)clockid; /* every clock is the one simulated clock */
@@ -4405,6 +4454,17 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
             wait_mask_leave(&w);
             return r;
         }
+
+        /* ---- inotify stubs ---- */
+        case SYS_inotify_init:
+            WRAPRET(inotify_init());
+        case SYS_inotify_init1:
+            WRAPRET(inotify_init1((int)a1));
+        case SYS_inotify_add_watch:
+            WRAPRET(inotify_add_watch((int)a1, (const char *)a2,
+                                      (uint32_t)a3));
+        case SYS_inotify_rm_watch:
+            WRAPRET(inotify_rm_watch((int)a1, (int)a2));
 
         /* ---- virtual timerfd/eventfd ---- */
         case SYS_timerfd_create:

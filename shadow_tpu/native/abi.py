@@ -60,6 +60,9 @@ OP_FUTEX_REQUEUE = 42
 OP_PREEMPT = 43
 OP_KILL = 44
 OP_ALARM = 45
+OP_INOTIFY_CREATE = 46
+OP_INOTIFY_ADD = 47
+OP_INOTIFY_RM = 48
 
 OP_NAMES = {
     1: "start", 2: "exit", 3: "nanosleep", 4: "socket", 5: "bind",
@@ -73,6 +76,7 @@ OP_NAMES = {
     35: "dup", 36: "timerfd-create", 37: "timerfd-settime",
     38: "timerfd-gettime", 39: "eventfd-create", 40: "futex-wait",
     41: "futex-wake", 42: "futex-requeue", 43: "preempt", 44: "kill", 45: "alarm",
+    46: "inotify-create", 47: "inotify-add", 48: "inotify-rm",
 }
 
 # poll bits (mirror Linux poll.h, shared with shim_pollfd)

@@ -104,6 +104,11 @@ def require_dynamic_elf(path: str) -> None:
 EVENTFD_MAX = 0xFFFFFFFFFFFFFFFE  # Linux: counter saturates at 2^64 - 2
 
 
+# fd kinds that are NOT sockets: socket ops on them answer ENOTSOCK,
+# reads/writes take their own kind-specific paths
+NONSOCK_KINDS = ("timer", "event", "inotify")
+
+
 class _VSocket:
     """One virtual fd of a managed process (fd number chosen by the
     shim — a reserved real kernel fd, so it can't collide in the plugin).
@@ -111,12 +116,13 @@ class _VSocket:
 
     __slots__ = ("vfd", "kind", "port", "default_dst", "queue", "sim",
                  "listener", "accept_q", "recv_shut", "refs",
-                 "count", "t_next", "t_interval", "t_gen", "e_sem")
+                 "count", "t_next", "t_interval", "t_gen", "e_sem",
+                 "watches", "next_wd")
 
     def __init__(self, vfd: int, kind: str) -> None:
         self.refs = 1  # fork shares the socket across processes
         self.vfd = vfd
-        self.kind = kind  # "udp" | "tcp" | "listen" | "timer" | "event"
+        self.kind = kind  # "udp" | "tcp" | "listen" | "timer" | "event" | "inotify"
         self.port: Optional[int] = None
         self.default_dst: Optional[tuple[int, int]] = None  # (ip_be, port)
         self.queue: list[tuple[int, int, bytes]] = []  # udp: (src_ip_be, src_port, data)
@@ -130,6 +136,10 @@ class _VSocket:
         self.t_interval = 0  # re-arm period, 0 = one-shot
         self.t_gen = 0  # settime/close generation: cancels stale fires
         self.e_sem = False  # EFD_SEMAPHORE mode
+        # inotify: wd -> (path, mask); the fork's minimal-stub semantics
+        # (watches succeed, events never fire — handler/inotify.rs)
+        self.watches: dict[int, tuple[str, int]] = {}
+        self.next_wd = 1
 
 
 class _Proc:
@@ -714,6 +724,19 @@ class ManagedApp:
                 self._op_kill(api, req)
             elif op == abi.OP_ALARM:
                 self._op_alarm(api, req)
+            elif op == abi.OP_INOTIFY_CREATE:
+                # the fork's minimal inotify stubs (handler/inotify.rs):
+                # a virtual fd whose watches succeed but never fire —
+                # real inotify would observe the REAL filesystem
+                # asynchronously, which is nondeterministic under the sim
+                self.sockets[int(req.args[0])] = _VSocket(
+                    int(req.args[0]), "inotify")
+                api.count("managed_inotify_fds")
+                self._reply(api, "inotify-create", 0)
+            elif op == abi.OP_INOTIFY_ADD:
+                self._op_inotify_add(api, req)
+            elif op == abi.OP_INOTIFY_RM:
+                self._op_inotify_rm(api, req)
             elif op == abi.OP_PREEMPT:
                 # forced yield from the CPU-time itimer: charge the consumed
                 # quantum as simulated time, reply when it has passed
@@ -1347,6 +1370,35 @@ class ManagedApp:
             else:
                 self._resume_granted(api, entity, b[0], -EINTR)
 
+    def _op_inotify_add(self, api: HostApi, req) -> None:
+        """inotify_add_watch on the stub fd: the watch is tracked and a
+        descriptor handed back, but no event will ever fire (the fork's
+        minimal-stub law — apps that register watches keep working, apps
+        that REQUIRE events see an eternally-quiet fd)."""
+        vfd = int(req.args[0])
+        sock = self.sockets.get(vfd)
+        if sock is None or sock.kind != "inotify":
+            self._reply(api, "inotify-add", -EBADF)
+            return
+        path = self.chan.req_payload().decode("utf-8", "surrogateescape")
+        mask = int(req.args[1])
+        wd = sock.next_wd
+        sock.next_wd += 1
+        sock.watches[wd] = (path, mask)
+        api.count("managed_inotify_watches")
+        self._reply(api, "inotify-add", wd)
+
+    def _op_inotify_rm(self, api: HostApi, req) -> None:
+        vfd, wd = int(req.args[0]), int(req.args[1])
+        sock = self.sockets.get(vfd)
+        if sock is None or sock.kind != "inotify":
+            self._reply(api, "inotify-rm", -EBADF)
+            return
+        if sock.watches.pop(wd, None) is None:
+            self._reply(api, "inotify-rm", -EINVAL)
+            return
+        self._reply(api, "inotify-rm", 0)
+
     def _op_alarm(self, api: HostApi, req) -> None:
         """alarm()/setitimer(ITIMER_REAL) on the SIMULATED clock: SIGALRM
         is delivered at the simulated deadline (and re-armed for interval
@@ -1486,7 +1538,7 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "bind", -EBADF)
             return
-        if sock.kind in ("timer", "event"):
+        if sock.kind in NONSOCK_KINDS:
             self._reply(api, "bind", -ENOTSOCK)
             return
         if sock.kind == "udp":
@@ -1508,7 +1560,7 @@ class ManagedApp:
     def _op_listen(self, api: HostApi, req) -> None:
         vfd, backlog = req.args[0], int(req.args[1])
         sock = self.sockets.get(vfd)
-        if sock is None or sock.kind in ("udp", "timer", "event"):
+        if sock is None or sock.kind in ("udp",) + NONSOCK_KINDS:
             self._reply(api, "listen",
                         -EBADF if sock is None else
                         -EINVAL if sock.kind == "udp" else -ENOTSOCK)
@@ -1534,7 +1586,7 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "connect", -EBADF)
             return True
-        if sock.kind in ("timer", "event"):
+        if sock.kind in NONSOCK_KINDS:
             self._reply(api, "connect", -ENOTSOCK)
             return True
         ip_be = int(req.args[1]) & 0xFFFFFFFF
@@ -1634,8 +1686,8 @@ class ManagedApp:
             data = self.chan.req_payload()
         if sock.kind == "event":
             return self._event_write(api, sock, data, bool(req.args[3]), vfd)
-        if sock.kind == "timer":
-            self._reply(api, "write", -EINVAL)  # timerfds are read-only
+        if sock.kind in ("timer", "inotify"):
+            self._reply(api, "write", -EINVAL)  # read-only fd kinds
             return True
         if sock.kind == "udp":
             self._udp_send(api, sock, req, data)
@@ -1732,6 +1784,14 @@ class ManagedApp:
             return True
         if sock.kind in ("timer", "event"):
             return self._counter_read(api, sock, max_len, nonblock, vfd)
+        if sock.kind == "inotify":
+            # stub law: no event ever arrives — nonblocking reads say so,
+            # blocking reads park for the rest of the simulation
+            if nonblock:
+                self._reply(api, "recvfrom", -EAGAIN)
+                return True
+            self._park(api, ("recvfrom", vfd, max_len, peek), None)
+            return False
         if sock.kind == "udp":
             if sock.queue:
                 self._reply_udp_recv(api, vfd, max_len, peek)
@@ -1821,7 +1881,7 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "shutdown", -EBADF)
             return
-        if sock.kind in ("timer", "event"):
+        if sock.kind in NONSOCK_KINDS:
             self._reply(api, "shutdown", -ENOTSOCK)
             return
         if sock.kind == "udp":
@@ -1858,7 +1918,7 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "getsockname", -EBADF)
             return
-        if sock.kind in ("timer", "event"):
+        if sock.kind in NONSOCK_KINDS:
             self._reply(api, "getsockname", -ENOTSOCK)
             return
         ip_be = _ip_to_be(api.ip_of(api.host_id))
@@ -1872,7 +1932,7 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "getpeername", -EBADF)
             return
-        if sock.kind in ("timer", "event"):
+        if sock.kind in NONSOCK_KINDS:
             self._reply(api, "getpeername", -ENOTSOCK)
             return
         if sock.kind == "tcp" and sock.sim is not None:
@@ -1890,7 +1950,7 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "sockerr", -EBADF)
             return
-        if sock.kind in ("timer", "event"):
+        if sock.kind in NONSOCK_KINDS:
             self._reply(api, "sockerr", -ENOTSOCK)
             return
         err = 0
@@ -1910,6 +1970,8 @@ class ManagedApp:
         elif sock.kind in ("timer", "event"):
             self._reply(api, "fionread", -EINVAL)  # Linux rejects FIONREAD here
             return
+        # inotify falls through: FIONREAD is valid there and reports the
+        # pending event bytes — always 0 under the stub law
         else:
             n = 0
         self._reply(api, "fionread", 0, args=[0, n])
@@ -2057,7 +2119,7 @@ class ManagedApp:
         self._reply(api, "close", 0)
 
     def _teardown_vsocket(self, api, sock: _VSocket) -> None:
-        if sock.kind in ("timer", "event"):
+        if sock.kind in NONSOCK_KINDS:
             sock.t_gen += 1  # cancels any scheduled fire
             return
         if sock.kind == "udp":
@@ -2188,7 +2250,7 @@ class ManagedApp:
             sock = self.sockets.get(vfd)
             if sock is None:
                 return
-            if sock.kind in ("timer", "event"):
+            if sock.kind in NONSOCK_KINDS:
                 if sock.count > 0:
                     self._blocked = None
                     self._reply_counter(api, sock)

@@ -66,3 +66,24 @@ def test_tcp_msg_peek(tmp_path):
     result, out = _run(tmp_path, "tcp", '[server, "9000", "1"]', "tcpecho")
     assert "tcp-peek: peeked=4 peek consumed=6 peekme" in out
     assert not result.process_errors
+
+
+def test_inotify_stub_surface(tmp_path):
+    """inotify is virtualized as stub fds (the reference fork's minimal
+    inotify stubs): watches succeed with distinct descriptors, reads see
+    EAGAIN / block in simulated time, polls elapse on the simulated
+    clock with no events, removes validate."""
+    cfg = ConfigOptions.from_yaml(f"""
+general: {{stop_time: 5s, seed: 7, data_directory: {tmp_path / 'd'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  solo:
+    network_node_id: 0
+    processes: [{{path: {BUILD / 'inotifier'}}}]
+""")
+    result = Simulation(cfg).run()
+    out = (tmp_path / "d" / "hosts" / "solo" /
+           "inotifier.stdout").read_text()
+    assert ("inotify wd1=1 wd2=2 eagain=1 poll=0 waited_ok=1 "
+            "rm_ok=1 rm_bad=1") in out
+    assert not result.process_errors
