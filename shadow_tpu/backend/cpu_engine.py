@@ -249,6 +249,9 @@ class CpuEngine:
 
         from .setup import build_world
 
+        # kept whole for engines that layer on top (backend/hybrid.py
+        # hands it to its TpuEngine so topology/routing build once)
+        self.world = build_world(cfg)
         (
             self.graph,
             self.ips,
@@ -257,7 +260,7 @@ class CpuEngine:
             bw_up_arr,
             bw_dn_arr,
             self.runahead,
-        ) = build_world(cfg)
+        ) = self.world
         self.node_index = self.routing.host_node_index
         # dynamic runahead (runahead.rs:44-118): the window may widen to the
         # smallest latency actually used so far (>= the static minimum);
@@ -334,9 +337,15 @@ class CpuEngine:
 
     # -- packet path (SEMANTICS.md lifecycle) ------------------------------
 
-    def send_packet(
-        self, src_host: Host, dst: int, size_bytes: int, payload: object = None
-    ) -> int:
+    def _packet_source_half(
+        self, src_host: Host, dst: int, size_bytes: int, payload: object
+    ) -> tuple[int, Optional[int]]:
+        """The source half of the packet lifecycle (steps 1-4: seq, up
+        bucket, outbound pcap, dynamic-runahead record, Bernoulli loss,
+        arrival-time bump).  Returns ``(seq, arrival_time)`` — arrival is
+        ``None`` when the packet was lost.  Shared verbatim by the CPU
+        push sink below and the hybrid backend's device-injection sink
+        (backend/hybrid.py), so the law cannot drift between them."""
         t = src_host.now
         seq = src_host.send_seq
         src_host.send_seq += 1
@@ -362,18 +371,26 @@ class CpuEngine:
             u = int(rng_mod.rand_u32(self.seed, s | rng_mod.LOSS_STREAM, seq))
             if u < thresh:
                 src_host.log_buf.append(LogRecord(t, s, d, seq, size_bytes, DROP_LOSS))
-                return seq
+                return seq, None
 
-        arr = max(t_dep + lat_ns, self.window_end)
+        return seq, max(t_dep + lat_ns, self.window_end)
+
+    def send_packet(
+        self, src_host: Host, dst: int, size_bytes: int, payload: object = None
+    ) -> int:
+        seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload)
+        if arr is None:
+            return seq
         ev = Event(
-            arr, EventKind.PACKET, src_host=s, seq=seq, data=(size_bytes, payload)
+            arr, EventKind.PACKET, src_host=src_host.host_id, seq=seq,
+            data=(size_bytes, payload),
         )
-        dst = self.hosts[d]
-        if dst is src_host:
-            dst.queue.push(ev)  # self-traffic never crosses threads
+        dst_host = self.hosts[dst]
+        if dst_host is src_host:
+            dst_host.queue.push(ev)  # self-traffic never crosses threads
         else:
-            with dst.inbox_lock:
-                dst.inbox.append(ev)
+            with dst_host.inbox_lock:
+                dst_host.inbox.append(ev)
         return seq
 
     def inbound(self, dst_host: Host, ev: Event) -> None:

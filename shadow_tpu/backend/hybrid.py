@@ -1,0 +1,368 @@
+"""Hybrid backend: managed (real-binary) hosts riding the TPU data plane.
+
+This is BASELINE.json's literal design — "keep syscall emulation on host
+CPU, offload the per-round packet-scheduling hot path" — applied to this
+framework's engines: hosts whose processes are real managed binaries (or
+any host-only app) execute on the host CPU exactly as in
+:class:`~shadow_tpu.backend.cpu_engine.CpuEngine`, while the network data
+plane — per-lane arrival queues, latency/loss lookup, token buckets,
+CoDel, and every lane-model host — runs on the device
+(:mod:`~shadow_tpu.backend.lanes`).  The seam mirrors the reference's
+``Worker::send_packet`` offload target (worker.rs:330-404):
+
+- a managed host's **send** runs the source half of the packet lifecycle
+  host-side (up bucket, pcap, loss draw — identical law to
+  ``CpuEngine.send_packet``) and stages the PACKET arrival event for
+  device injection (``lanes._inject_merge``), with the payload bytes
+  parked host-side keyed by ``(src, seq)``;
+- the device advances windows over ALL lanes; deliveries destined to
+  external lanes exit through the egress buffer at their exact
+  ``t_deliver`` (down bucket + CoDel applied on device — the dst half of
+  the lifecycle) and are queued host-side as DELIVERY events carrying the
+  parked payload;
+- the window law stays global and bit-identical to the scalar oracle:
+  the device folds the host side's next event time into every window
+  start (``lanes._build_hybrid_run``), free-runs windows the host has no
+  events in, and returns after completing any window the host
+  participates in — one device call per host sync instead of per round.
+
+Event logs diff bit-identical against ``CpuEngine`` on the same config
+(tests/test_hybrid.py), which is the determinism contract the reference's
+determinism suite checks (src/test/determinism/).
+"""
+
+from __future__ import annotations
+
+import time as wall_time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..config.options import ConfigOptions
+from ..core import time as stime
+from ..core.event import Event, EventKind
+from ..core.event_queue import EventQueue
+from . import lanes
+from .cpu_engine import DELIVERED, CpuEngine, Delivery, Host, SimResult
+
+NEVER = stime.NEVER
+
+
+def config_has_managed(cfg: ConfigOptions) -> bool:
+    """True when any process path is not a registered built-in model —
+    i.e. a real binary that must execute host-side under the shim."""
+    from ..models.base import _REGISTRY
+
+    return any(
+        p.path not in _REGISTRY for h in cfg.hosts for p in h.processes
+    )
+
+
+class HybridEngine(CpuEngine):
+    """CpuEngine for the external (managed) hosts; TPU lanes for the rest.
+
+    Construction reuses ``CpuEngine.__init__`` wholesale (hosts, apps,
+    pcap, hosts file, routing — one source of truth), then strips the
+    lane-covered hosts' host-side state and builds the device engine with
+    those hosts marked external."""
+
+    def __init__(
+        self, cfg: ConfigOptions, log_capacity: Optional[int] = None
+    ) -> None:
+        super().__init__(cfg)
+        from ..native.process import ManagedApp
+        from .tpu_engine import LaneCompatError, TpuEngine
+
+        ext = np.array(
+            [any(isinstance(a, ManagedApp) for a in h.apps) for h in self.hosts],
+            dtype=bool,
+        )
+        if not ext.any():
+            raise LaneCompatError(
+                "no managed hosts in config; use the plain tpu backend"
+            )
+        self.external_mask = ext
+        self.external_hosts: list[Host] = [
+            h for h, e in zip(self.hosts, ext) if e
+        ]
+        for h, e in zip(self.hosts, ext):
+            if e:
+                h.staged = []  # sends awaiting device injection
+            else:
+                # lane-covered: the device runs this host; drop its
+                # host-side apps, start events, and pcap writer (the
+                # device log reconstructs lane pcaps at collect)
+                h.apps = []
+                h.queue = EventQueue()
+                h.pcap = None
+        self.device = TpuEngine(
+            cfg, log_capacity=log_capacity, external=ext, world=self.world
+        )
+        # parked payloads for in-flight packets, keyed (src_host, seq) —
+        # popped when the device egresses the delivery
+        self._parked: dict = {}
+        self._staged_merged: list = []
+        self._dev_min_used: Optional[int] = None
+        self.host_rounds = 0
+
+    # -- host-side packet source half (the law IS CpuEngine's) -------------
+
+    def send_packet(self, src_host, dst, size_bytes, payload=None):
+        """The shared source half (``CpuEngine._packet_source_half``: up
+        bucket, outbound pcap, dynamic-runahead record, Bernoulli loss)
+        with a device-injection sink: the surviving packet is STAGED for
+        the device instead of pushed into a host queue — the dst half
+        (down bucket, CoDel, delivery) runs on the device for every lane,
+        external ones included."""
+        seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload)
+        if arr is None:
+            return seq
+        s = src_host.host_id
+        if payload is not None:
+            self._parked[(s, seq)] = payload
+        src_host.staged.append((arr, s, seq, size_bytes, dst))
+        return seq
+
+    def inbound(self, dst_host, ev):  # pragma: no cover - defensive
+        raise AssertionError(
+            "hybrid host queues never hold PACKET events (the device owns "
+            "the dst half of the lifecycle)"
+        )
+
+    # -- barrier (external hosts only; lane hosts have no host state) ------
+
+    def next_event_time(self) -> int:
+        return min(
+            (h.queue.next_time() for h in self.external_hosts), default=NEVER
+        )
+
+    def _barrier_merge(self) -> None:
+        staged = self._staged_merged
+        for h in self.external_hosts:
+            if h.staged:
+                staged.extend(h.staged)
+                h.staged = []
+            if h.log_buf:
+                self.event_log.extend(h.log_buf)
+                h.log_buf.clear()
+            if h.min_used_lat is not None:
+                if self._min_used_lat is None or h.min_used_lat < self._min_used_lat:
+                    self._min_used_lat = h.min_used_lat
+                h.min_used_lat = None
+
+    def current_runahead(self) -> int:
+        """The global dynamic-runahead law: min over BOTH sides' smallest
+        used latency (the device scalar is read back after every device
+        turn; between turns it cannot change)."""
+        if not self.dynamic_runahead:
+            return self.runahead
+        vals = [
+            v for v in (self._min_used_lat, self._dev_min_used)
+            if v is not None
+        ]
+        if not vals:
+            return self.runahead
+        return max(min(vals), self._runahead_floor, 1)
+
+    # -- egress application -------------------------------------------------
+
+    def _apply_egress(self, rows) -> None:
+        """Queue device-egressed deliveries as host-side DELIVERY events
+        at their exact t_deliver (down bucket + CoDel already applied on
+        device; the DELIVERED/DROP_CODEL log records live in the device
+        log).  Mirrors the oracle's passive-delivery elision: an external
+        host whose apps are all passive consumes the delivery inline."""
+        for t, src, dst, seq, size, outcome in rows:
+            t, src, dst, seq, size = int(t), int(src), int(dst), int(seq), int(size)
+            h = self.hosts[dst]
+            payload = self._parked.pop((src, seq), None)
+            if int(outcome) != DELIVERED:
+                continue  # device-side drop: payload released, no event
+            if h.pcap is not None:  # inbound capture at delivery
+                h.pcap.capture(
+                    stime.sim_to_emu(t), self.ips.by_host[src],
+                    self.ips.by_host[dst], size, payload,
+                    key=(0, src, dst, seq),
+                )
+            if payload is None and h.passive_delivery:
+                h.now = t
+                for app in h.apps:
+                    h._current_app = app
+                    app.on_delivery(h, t, src, seq, size, payload=None)
+                continue
+            h.queue.push(
+                Event(
+                    t, EventKind.DELIVERY, src_host=src, seq=seq,
+                    data=Delivery(src, seq, size, payload),
+                )
+            )
+
+    # -- device turn --------------------------------------------------------
+
+    def _inj_block(self, staged, b: int):
+        """Pack staged sends into the fixed-size injection block."""
+        import jax.numpy as jnp
+
+        valid = np.zeros(b, dtype=bool)
+        dst = np.zeros(b, dtype=np.int32)
+        thi = np.full(b, lanes.NEVER32, dtype=np.int32)
+        tlo = np.full(b, lanes.NEVER32, dtype=np.int32)
+        auxh = np.zeros(b, dtype=np.int32)
+        auxl = np.zeros(b, dtype=np.int32)
+        size = np.zeros(b, dtype=np.int32)
+        for i, (arr, src, seq, sz, d) in enumerate(staged):
+            valid[i] = True
+            dst[i] = d
+            thi[i] = arr >> 31
+            tlo[i] = arr & lanes.MASK31
+            auxh[i] = (lanes.PACKET << lanes.AUX_KIND_SHIFT) | (
+                src << lanes.AUX_SRC_SHIFT
+            )
+            auxl[i] = seq
+            size[i] = sz
+        return {
+            "valid": jnp.asarray(valid), "dst": jnp.asarray(dst),
+            "thi": jnp.asarray(thi), "tlo": jnp.asarray(tlo),
+            "auxh": jnp.asarray(auxh), "auxl": jnp.asarray(auxl),
+            "size": jnp.asarray(size),
+        }
+
+    def _read_egress(self, state) -> list:
+        count = int(state.egress_count)
+        if int(state.egress_lost):
+            raise RuntimeError(
+                "hybrid egress buffer overflowed despite the headroom "
+                "guard (device invariant violation)"
+            )
+        if count == 0:
+            return []
+        # pad the slice length to a power of two: distinct slice sizes
+        # compile distinct device programs, so this caps churn at log2(E)
+        cap = self.device.params.egress_capacity
+        span = 1
+        while span < count:
+            span <<= 1
+        span = min(span, cap)
+        return np.asarray(state.egress[:span])[:count].tolist()
+
+    def _device_turn(self, state, hybrid_fn, inject_fn, host_next):
+        """Inject staged sends, run the device free-run loop, and apply
+        egress — retrying while the device paused mid-window to drain a
+        low egress buffer."""
+        p = self.device.params
+        b = p.inject_batch
+        staged = self._staged_merged
+        self._staged_merged = []
+        while len(staged) > b:
+            state = inject_fn(state, self._inj_block(staged[:b], b))
+            staged = staged[b:]
+        inj = self._inj_block(staged, b)
+        ext_used = (
+            lanes.NEVER32 if self._min_used_lat is None else self._min_used_lat
+        )
+        while True:
+            eh, el = (
+                (lanes.NEVER32, lanes.NEVER32)
+                if host_next >= NEVER
+                else (host_next >> 31, host_next & lanes.MASK31)
+            )
+            state, lane_min = hybrid_fn(state, eh, el, ext_used, inj)
+            state = jax.block_until_ready(state)
+            lane_min = int(lane_min)
+            we_hi, we_lo, dev_used = jax.device_get(
+                (state.now_we_hi, state.now_we_lo, state.min_used_lat)
+            )
+            dev_we = (int(we_hi) << 31) | int(we_lo)
+            self._dev_min_used = (
+                None if int(dev_used) >= lanes.NEVER32 else int(dev_used)
+            )
+            self._apply_egress(self._read_egress(state))
+            if lane_min >= dev_we:
+                return state, lane_min, dev_we
+            # mid-window pause (egress headroom): drain and resume
+            inj = self._inj_block([], b)
+            host_next = self.next_event_time()
+
+    # -- the hybrid round loop ----------------------------------------------
+
+    def run(self, on_window=None) -> SimResult:
+        from ..engine.scheduler import HostScheduler
+
+        exp = self.cfg.experimental
+        scheduler = HostScheduler(
+            self.external_hosts,
+            parallelism=self.cfg.general.parallelism,
+            policy=exp.scheduler,
+            pin_cpus=exp.use_cpu_pinning,
+        )
+        try:
+            return self._run_hybrid(scheduler, on_window)
+        finally:
+            scheduler.shutdown()
+
+    def _run_hybrid(self, scheduler, on_window) -> SimResult:
+        t0 = wall_time.perf_counter()
+        try:
+            return self._hybrid_loop(scheduler, on_window, t0)
+        except BaseException:
+            self.finalize()
+            raise
+
+    def _hybrid_loop(self, scheduler, on_window, t0) -> SimResult:
+        dev = self.device
+        state = dev.initial_state()
+        hybrid_fn = lanes.make_hybrid_fn(dev.params, dev.tables)
+        inject_fn = lanes.make_inject_fn(dev.params, dev.tables)
+        dev_next = min(
+            (t for (_lane, t, *_rest) in dev._init_events), default=NEVER
+        )
+        while True:
+            host_next = self.next_event_time()
+            staged_min = min(
+                (e[0] for e in self._staged_merged), default=NEVER
+            )
+            dev_eff = min(dev_next, staged_min)
+            start = min(host_next, dev_eff)
+            if start >= self.stop_time or start == NEVER:
+                break
+            end = min(start + self.current_runahead(), self.stop_time)
+            if self._staged_merged or dev_eff < end:
+                # device turn: complete every window up to (and including)
+                # the first one the host participates in
+                state, dev_next, dev_we = self._device_turn(
+                    state, hybrid_fn, inject_fn, host_next
+                )
+                next_host = self.next_event_time()
+                if next_host < dev_we:
+                    # host part of the device-completed window
+                    self.window_end = dev_we
+                    scheduler.run_round(dev_we)
+                    self._barrier_merge()
+                    if on_window is not None:
+                        on_window(start, dev_we, self.next_event_time())
+                continue
+            # host-only window (device idle beyond it, nothing staged)
+            self.window_end = end
+            scheduler.run_round(end)
+            self._barrier_merge()
+            self.host_rounds += 1
+            if on_window is not None:
+                on_window(start, end, self.next_event_time())
+        self.finalize()
+        wall = wall_time.perf_counter() - t0
+
+        dev_result = self.device.collect(state, wall)
+        counters: dict[str, int] = dict(dev_result.counters)
+        for h in self.hosts:
+            for k, v in h.counters.items():
+                counters[k] = counters.get(k, 0) + v
+        return SimResult(
+            sim_time_ns=self.stop_time,
+            wall_seconds=wall,
+            rounds=dev_result.rounds + self.host_rounds,
+            event_log=dev_result.event_log + self.event_log,
+            counters=counters,
+            per_host_counters=[dict(h.counters) for h in self.hosts],
+            process_errors=list(getattr(self, "process_errors", [])),
+        )

@@ -231,6 +231,16 @@ class LaneState(NamedTuple):
     now_we_lo: jnp.ndarray
     min_used_lat: jnp.ndarray  # int32 scalar: smallest latency sent over
                                # so far (NEVER32 = none; dynamic runahead)
+    # hybrid-backend egress: deliveries to EXTERNAL (host-executed) lanes
+    # leave the device through this buffer instead of becoming DELIVERY
+    # events — [E, 6] int64 rows (t_deliver, src, dst, seq, size, 0) plus
+    # count/lost and the min pending delivery time as an int32 pair (the
+    # free-run guard).  () on non-hybrid runs.
+    egress: Any = ()
+    egress_count: Any = ()
+    egress_lost: Any = ()
+    egress_min_hi: Any = ()
+    egress_min_lo: Any = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -285,6 +295,16 @@ class LaneParams:
     # Multiplies XLA compile time with the body size — worth it for small
     # slot bodies (the passive models), costly for phold/stream
     unroll: int = 1
+    # hybrid backend (backend/hybrid.py): some lanes are EXTERNAL — their
+    # apps (real managed binaries, or any host-only model) execute on the
+    # host CPU while their network dn-side (down bucket, CoDel, arrival
+    # queue) stays on device.  Deliveries to external lanes leave through
+    # the egress buffer; host sends enter through the injection merge.
+    external_any: bool = False
+    egress_capacity: int = 0  # E (rows in the egress buffer)
+    ext_per_iter: int = 0  # worst-case egress appends per iteration
+    inject_batch: int = 0  # B (rows per injection block)
+    inject_cross: int = 0  # per-lane injection fan-in per call (0 = C)
 
     @property
     def stream_present(self) -> bool:
@@ -358,6 +378,9 @@ class LaneTables(NamedTuple):
     flow_up_kfi: jnp.ndarray  # [2S] int32
     flow_pcap: jnp.ndarray  # [2S] bool: the endpoint lane captures pcap
     lane_pcap: jnp.ndarray  # [N] bool: host captures pcap
+    # hybrid backend: [N] bool — lane is EXTERNAL (host-executed host);
+    # () on non-hybrid runs
+    lane_external: Any = ()
 
 
 # --------------------------------------------------------------------------
@@ -747,11 +770,25 @@ def _process_slot(
     )
 
     # passive lanes consume the delivery inline (counters only); active
-    # lanes get a DELIVERY self-insert keyed by the packet's (src, seq)
+    # lanes get a DELIVERY self-insert keyed by the packet's (src, seq).
+    # EXTERNAL lanes (hybrid backend) consume neither: their delivery
+    # leaves the device through the egress buffer — the host side queues
+    # it as a DELIVERY event (or applies the same passive elision the
+    # oracle would) at the identical t_deliver.
     model = tb.model
     passive = false_n
     for _m in sorted(PASSIVE_MODELS & mp):
         passive = passive | (model == _m)
+    if p.external_any:
+        ext_lane = tb.lane_external
+        # CoDel-dropped packets egress too (outcome column) so the host
+        # can unpark their payloads — only DELIVERED rows become host
+        # events (and only they feed the free-run guard's egress_min)
+        s = _append_egress(
+            p, s, is_pkt & ext_lane, deliver, td_hi, td_lo, src, lanes,
+            seq, size,
+        )
+        passive = passive & ~ext_lane
     # every counting app on the host adds the size (the CPU oracle
     # dispatches each delivery to every app): recv_mult is the per-lane
     # app count — 1 on single-process lanes, 0 on empty ones
@@ -762,6 +799,8 @@ def _process_slot(
     )
     all_passive = mp <= PASSIVE_MODELS
     ins_valid = false_n if all_passive else (deliver & ~passive)
+    if p.external_any and not all_passive:
+        ins_valid = ins_valid & ~ext_lane
     ins_thi, ins_tlo = td_hi, td_lo
     ins_auxh = pack_aux_hi(jnp.full(n, DELIVERY, dtype=i32), src)
     ins_auxl = seq
@@ -1753,6 +1792,47 @@ def _append_log(p: LaneParams, s: LaneState, recs) -> LaneState:
     )
 
 
+def _append_egress(p: LaneParams, s: LaneState, valid, delivered,
+                   td_hi, td_lo, src, dst, seq, size) -> LaneState:
+    """Append packet outcomes at EXTERNAL lanes to the egress buffer
+    (hybrid backend): int64 rows (t_deliver, src, dst, seq, size,
+    outcome).  DELIVERED rows become host-side DELIVERY events and feed
+    the running min pending delivery time (the device free-run guard —
+    the loop must not advance a window past an unserviced host delivery);
+    DROP_CODEL rows only release the host's parked payload."""
+    offs = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    pos = s.egress_count + offs
+    ok = valid & (pos < p.egress_capacity)
+    idx = jnp.where(ok, pos, p.egress_capacity)
+    i64 = jnp.int64
+    row = jnp.stack(
+        [
+            t_join(td_hi, td_lo),
+            src.astype(i64),
+            dst.astype(i64),
+            seq.astype(i64),
+            size.astype(i64),
+            jnp.where(delivered, DELIVERED, DROP_CODEL).astype(i64),
+        ],
+        axis=1,
+    )
+    egress = s.egress.at[idx].set(row, mode="drop")
+    n_valid = valid.sum(dtype=jnp.int32)
+    n_kept = ok.sum(dtype=jnp.int32)
+    live = valid & delivered
+    mh, ml = pair_min_lanes(
+        jnp.where(live, td_hi, NEVER32), jnp.where(live, td_lo, NEVER32)
+    )
+    is_lt = pair_lt(mh, ml, s.egress_min_hi, s.egress_min_lo)
+    return s._replace(
+        egress=egress,
+        egress_count=s.egress_count + n_valid,
+        egress_lost=s.egress_lost + (n_valid - n_kept),
+        egress_min_hi=jnp.where(is_lt, mh, s.egress_min_hi),
+        egress_min_lo=jnp.where(is_lt, ml, s.egress_min_lo),
+    )
+
+
 def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
     """Build the raw one-ITERATION advance (pop ≤K, process, merge) against
     the window already in ``state.now_we_hi/lo``.  The step driver wraps
@@ -2127,6 +2207,9 @@ _I32_N_FIELDS = (
 )
 _SCALAR_FIELDS = ("log_count", "log_lost", "rounds", "iters", "now_we_hi", "now_we_lo",
                   "min_used_lat")
+# hybrid-backend scalar extension (present only when egress is live)
+_EG_SCALARS = ("egress_count", "egress_lost", "egress_min_hi",
+               "egress_min_lo")
 
 
 def pack_state(s: LaneState):
@@ -2139,23 +2222,27 @@ def pack_state(s: LaneState):
         [getattr(s, f) for f in _I32_N_FIELDS]
         + [s.cd_dropping.astype(jnp.int32)]
     )
+    has_eg = not isinstance(s.egress, tuple)
+    sc_fields = _SCALAR_FIELDS + (_EG_SCALARS if has_eg else ())
     sc = jnp.stack(
-        [jnp.asarray(getattr(s, f), dtype=jnp.int32) for f in _SCALAR_FIELDS]
+        [jnp.asarray(getattr(s, f), dtype=jnp.int32) for f in sc_fields]
     )
-    return (q, c32, sc, s.log, s.stream)
+    return (q, c32, sc, s.log, s.stream, s.egress)
 
 
 def unpack_state(carry) -> LaneState:
-    q, c32, sc, log, stream = carry
+    q, c32, sc, log, stream, egress = carry
     has_pay = q.shape[0] == 7
+    has_eg = sc.shape[0] > len(_SCALAR_FIELDS)
     kw = {f: c32[i] for i, f in enumerate(_I32_N_FIELDS)}
-    kw.update({f: sc[i] for i, f in enumerate(_SCALAR_FIELDS)})
+    sc_fields = _SCALAR_FIELDS + (_EG_SCALARS if has_eg else ())
+    kw.update({f: sc[i] for i, f in enumerate(sc_fields)})
     return LaneState(
         q_thi=q[0], q_tlo=q[1], q_auxh=q[2], q_auxl=q[3], q_size=q[4],
         q_phi=q[5] if has_pay else (), q_plo=q[6] if has_pay else (),
         stream=stream,
         cd_dropping=c32[len(_I32_N_FIELDS)].astype(bool),
-        log=log, **kw,
+        log=log, egress=egress, **kw,
     )
 
 
@@ -2221,3 +2308,170 @@ def make_run_fn(p: LaneParams, tb: LaneTables):
     """Jitted full-simulation run — the bench hot path (one device call per
     simulation)."""
     return jax.jit(_build_full_run(p, tb))
+
+
+# --------------------------------------------------------------------------
+# hybrid backend device entry points (backend/hybrid.py drives these)
+# --------------------------------------------------------------------------
+
+
+def _inject_merge(p: LaneParams, tb: LaneTables, s: LaneState, inj):
+    """Merge a host-staged injection block into the lane queues.
+
+    ``inj`` is a dict of [B] arrays (valid, dst, thi, tlo, auxh, auxl,
+    size): PACKET arrival events computed host-side (external hosts' up
+    bucket + loss + latency already applied — cpu_engine.send_packet's
+    law).  Runs ONCE per device call (outside the while loop), so a plain
+    ``searchsorted`` for the segment bounds is fine here — the histogram
+    matmul only matters inside the hot body.  Overflow past the per-lane
+    fan-in or queue capacity is counted in ``n_queue`` (strict mode raises
+    host-side, same as cross overflow)."""
+    n, c = p.n_lanes, p.capacity
+    valid = inj["valid"]
+    dst = jnp.where(valid, inj["dst"], jnp.int32(n))
+    thi = jnp.where(valid, inj["thi"], NEVER32)
+    tlo = jnp.where(valid, inj["tlo"], NEVER32)
+    dst_s, thi_s, tlo_s, auxh_s, auxl_s, size_s = lax.sort(
+        (dst, thi, tlo, inj["auxh"], inj["auxl"], inj["size"]),
+        dimension=0, num_keys=1, is_stable=False,
+    )
+    bounds = jnp.searchsorted(
+        dst_s, jnp.arange(n + 1, dtype=dst_s.dtype), side="left"
+    ).astype(jnp.int32)
+    start, cnt = bounds[:n], bounds[1:] - bounds[:n]
+    cxi = min(p.inject_cross or c, c)
+    r = jnp.arange(cxi, dtype=jnp.int32)[None, :]
+    in_seg = r < cnt[:, None]
+    g = _window_gather([thi_s, tlo_s, auxh_s, auxl_s, size_s], start, cxi)
+    cross_thi = jnp.where(in_seg, g[0], NEVER32)
+    cross_tlo = jnp.where(in_seg, g[1], NEVER32)
+    cross_auxh = jnp.where(in_seg, g[2], 0)
+    cross_auxl = jnp.where(in_seg, g[3], 0)
+    cross_size = jnp.where(in_seg, g[4], 0)
+    lost_pre = jnp.maximum(cnt - cxi, 0)
+
+    mthi = jnp.concatenate([s.q_thi, cross_thi], axis=1)
+    mtlo = jnp.concatenate([s.q_tlo, cross_tlo], axis=1)
+    mh = jnp.concatenate([s.q_auxh, cross_auxh], axis=1)
+    ml = jnp.concatenate([s.q_auxl, cross_auxl], axis=1)
+    ms = jnp.concatenate([s.q_size, cross_size], axis=1)
+    if p.stream_present:
+        zpad = jnp.zeros((n, cxi), dtype=jnp.int32)
+        mphi = jnp.concatenate([s.q_phi, zpad], axis=1)
+        mplo = jnp.concatenate([s.q_plo, zpad], axis=1)
+        mthi, mtlo, mh, ml, ms, mphi, mplo = lax.sort(
+            (mthi, mtlo, mh, ml, ms, mphi, mplo), dimension=1, num_keys=4,
+            is_stable=False,
+        )
+        s = s._replace(q_phi=mphi[:, :c], q_plo=mplo[:, :c])
+    else:
+        mthi, mtlo, mh, ml, ms = lax.sort(
+            (mthi, mtlo, mh, ml, ms), dimension=1, num_keys=4,
+            is_stable=False,
+        )
+    tail = (mthi[:, c:] != NEVER32).sum(axis=1, dtype=jnp.int32)
+    return s._replace(
+        q_thi=mthi[:, :c], q_tlo=mtlo[:, :c], q_auxh=mh[:, :c],
+        q_auxl=ml[:, :c], q_size=ms[:, :c],
+        n_queue=s.n_queue + tail + lost_pre,
+    )
+
+
+def _build_hybrid_run(p: LaneParams, tb: LaneTables):
+    """Device half of the hybrid backend: merge the injection block, then
+    free-run the fused window loop under the EXTERNAL bound.
+
+    The window law becomes ``start = min(lane_min, ext_bound)`` where
+    ``ext_bound = min(ext_min, egress_min)`` — ``ext_min`` is the host
+    side's next managed event and ``egress_min`` the earliest delivery
+    already egressed this call (a pending host event the host hasn't seen
+    yet).  The loop completes the current window whenever the host
+    participates in it (``ext_bound < now_we``) and then RETURNS — the
+    host services its part of that same window, stages its sends, and
+    calls again — but free-runs across windows the host has no events in
+    (the conservative-PDES contract: identical window sequence to the
+    scalar oracle, one device call per host sync instead of per round).
+    Also returns early when the egress buffer runs low on headroom."""
+    iter_fn = _build_iter(p, tb, pure_dataflow=True)
+    stop_hi, stop_lo = p.stop_time >> 31, p.stop_time & MASK31
+    room_floor = p.egress_capacity - p.ext_per_iter
+
+    def ext_bound(st, ext_hi, ext_lo):
+        lt = pair_lt(ext_hi, ext_lo, st.egress_min_hi, st.egress_min_lo)
+        return (
+            jnp.where(lt, ext_hi, st.egress_min_hi),
+            jnp.where(lt, ext_lo, st.egress_min_lo),
+        )
+
+    def hybrid_run(s: LaneState, ext_hi, ext_lo, ext_used, inj):
+        ext_hi = jnp.asarray(ext_hi, dtype=jnp.int32)
+        ext_lo = jnp.asarray(ext_lo, dtype=jnp.int32)
+        if p.dynamic_runahead:
+            s = s._replace(
+                min_used_lat=jnp.minimum(
+                    s.min_used_lat, jnp.asarray(ext_used, dtype=jnp.int32)
+                )
+            )
+        # previous call's egress was consumed by the host
+        s = s._replace(
+            egress_count=jnp.int32(0), egress_lost=jnp.int32(0),
+            egress_min_hi=jnp.int32(NEVER32),
+            egress_min_lo=jnp.int32(NEVER32),
+        )
+        s = _inject_merge(p, tb, s, inj)
+
+        def cond(carry):
+            st = unpack_state(carry)
+            mh, ml = pair_min_lanes(st.q_thi[:, 0], st.q_tlo[:, 0])
+            in_window = pair_lt(mh, ml, st.now_we_hi, st.now_we_lo)
+            bh, bl = ext_bound(st, ext_hi, ext_lo)
+            host_in_cur = pair_lt(bh, bl, st.now_we_hi, st.now_we_lo)
+            nsh, nsl = pair_sel(pair_lt(mh, ml, bh, bl), mh, ml, bh, bl)
+            fresh_ok = (~host_in_cur) & pair_lt(nsh, nsl, stop_hi, stop_lo)
+            room = st.egress_count < room_floor
+            return room & (in_window | fresh_ok)
+
+        def body(carry):
+            st = unpack_state(carry)
+            mn_hi, mn_lo = pair_min_lanes(st.q_thi[:, 0], st.q_tlo[:, 0])
+            bh, bl = ext_bound(st, ext_hi, ext_lo)
+            # the GLOBAL min: host-side events participate in the window law
+            mn_hi, mn_lo = pair_sel(
+                pair_lt(mn_hi, mn_lo, bh, bl), mn_hi, mn_lo, bh, bl
+            )
+            live = pair_lt(mn_hi, mn_lo, stop_hi, stop_lo)
+            fresh = pair_ge(mn_hi, mn_lo, st.now_we_hi, st.now_we_lo) & live
+            c_hi, c_lo = pair_sel(live, mn_hi, mn_lo, stop_hi, stop_lo)
+            c_hi, c_lo = pair_add32(c_hi, c_lo, _effective_runahead(p, st))
+            c_hi, c_lo = pair_sel(
+                pair_lt(c_hi, c_lo, stop_hi, stop_lo),
+                c_hi, c_lo, stop_hi, stop_lo,
+            )
+            st = st._replace(
+                now_we_hi=jnp.where(fresh, c_hi, st.now_we_hi),
+                now_we_lo=jnp.where(fresh, c_lo, st.now_we_lo),
+                rounds=st.rounds + fresh.astype(st.rounds.dtype),
+            )
+            return pack_state(iter_fn(st))
+
+        s = unpack_state(lax.while_loop(cond, body, pack_state(s)))
+        lane_min = t_join(*pair_min_lanes(s.q_thi[:, 0], s.q_tlo[:, 0]))
+        return s, lane_min
+
+    return hybrid_run
+
+
+def make_hybrid_fn(p: LaneParams, tb: LaneTables):
+    """Jitted hybrid device call: (state, ext_min_hi, ext_min_lo,
+    ext_used_lat, inject_block) -> (state, lane_min)."""
+    return jax.jit(_build_hybrid_run(p, tb))
+
+
+def make_inject_fn(p: LaneParams, tb: LaneTables):
+    """Jitted standalone injection merge (used when the host stages more
+    than one batch worth of sends between device turns)."""
+
+    def inject(s: LaneState, inj):
+        return _inject_merge(p, tb, s, inj)
+
+    return jax.jit(inject)

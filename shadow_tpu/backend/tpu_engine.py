@@ -53,11 +53,28 @@ class TpuEngine:
         cfg: ConfigOptions,
         log_capacity: Optional[int] = None,
         strict_capacity: bool = True,
+        external=None,
+        inject_batch: int = 512,
+        world=None,
     ) -> None:
+        """``external``: optional [N] bool mask — marked hosts are
+        EXTERNAL (hybrid backend, backend/hybrid.py): their apps run on
+        the host CPU; the device keeps only their network dn-side (down
+        bucket, CoDel, arrival queue) and exchanges traffic through the
+        injection/egress machinery instead of model slots.
+
+        ``world``: optional prebuilt ``backend.setup.build_world`` tuple —
+        the hybrid engine passes its own so topology/routing are built
+        once per run, not once per engine."""
         cfg.validate()
         self.cfg = cfg
         self.strict_capacity = strict_capacity
         n = len(cfg.hosts)
+        ext_mask = (
+            np.zeros(n, dtype=bool) if external is None
+            else np.asarray(external, dtype=bool)
+        )
+        self._external = ext_mask
 
         # topology (single-sourced with CpuEngine via backend.setup)
         from .setup import build_world
@@ -70,7 +87,7 @@ class TpuEngine:
             bw_up,
             bw_dn,
             runahead,
-        ) = build_world(cfg)
+        ) = world if world is not None else build_world(cfg)
 
         # --- per-lane model tables and initial events ---------------------
         model = np.zeros(n, dtype=np.int32)
@@ -108,6 +125,11 @@ class TpuEngine:
             # pcap: sends emit PCAP_TX records into the device log, and
             # collect() reconstructs per-host capture files byte-identical
             # to the CPU backend's (synthetic payloads either way)
+            if ext_mask[hid]:
+                # hybrid: the host side executes this host's apps; the
+                # lane only runs its packet-arrival machinery
+                model[hid] = lanes.M_NONE
+                continue
             if not hopt.processes:
                 model[hid] = lanes.M_NONE
                 continue
@@ -247,6 +269,9 @@ class TpuEngine:
         stream_wide_pop = max_window < ltcp_mod.RTO_MIN
 
         lane_pcap = np.array([h.pcap_enabled for h in cfg.hosts], dtype=bool)
+        # external lanes' pcap is written host-side (the host knows the
+        # payload bytes); the device captures lane-model hosts only
+        lane_pcap = lane_pcap & ~ext_mask
         pcap_any = bool(lane_pcap.any())
         if pcap_any and log_capacity == 0:
             raise LaneCompatError(
@@ -284,6 +309,20 @@ class TpuEngine:
                 ].any()
             ),
             cross_capacity=cfg.experimental.tpu_cross_capacity,
+            external_any=bool(ext_mask.any()),
+            # worst case: every external lane pops a full slot row of
+            # packets in one iteration; the egress buffer keeps at least
+            # that much headroom so one iteration can never overflow it
+            ext_per_iter=(
+                int(ext_mask.sum()) * cfg.experimental.tpu_events_per_round
+            ),
+            egress_capacity=(
+                max(1024, 4 * int(ext_mask.sum())
+                    * cfg.experimental.tpu_events_per_round)
+                if ext_mask.any() else 0
+            ),
+            inject_batch=inject_batch if ext_mask.any() else 0,
+            inject_cross=capacity if ext_mask.any() else 0,
         )
 
         up = np.array([bucket_params(int(b)) for b in bw_up], dtype=np.int64)
@@ -420,6 +459,9 @@ class TpuEngine:
             flow_up_kfi=jnp.asarray(up_kfi[el_np]),
             flow_pcap=jnp.asarray(lane_pcap[el_np]),
             lane_pcap=jnp.asarray(lane_pcap),
+            lane_external=(
+                jnp.asarray(ext_mask) if ext_mask.any() else ()
+            ),
         )
         self._init_events = init_events
         self._local_seq0 = local_seq0
@@ -539,6 +581,14 @@ class TpuEngine:
             now_we_hi=jnp.int32(0),
             now_we_lo=jnp.int32(0),
             min_used_lat=jnp.int32(lanes.NEVER32),
+            egress=(
+                jnp.zeros((p.egress_capacity, 6), dtype=jnp.int64)
+                if p.external_any else ()
+            ),
+            egress_count=jnp.int32(0) if p.external_any else (),
+            egress_lost=jnp.int32(0) if p.external_any else (),
+            egress_min_hi=jnp.int32(lanes.NEVER32) if p.external_any else (),
+            egress_min_lo=jnp.int32(lanes.NEVER32) if p.external_any else (),
         )
 
     # -- running -----------------------------------------------------------
@@ -653,7 +703,10 @@ class TpuEngine:
         else:
             in_sorted = in_keys = np.zeros((0,), dtype=np.int64)
         for hid, hopt in enumerate(self.cfg.hosts):
-            if not hopt.pcap_enabled:
+            if not hopt.pcap_enabled or self._external[hid]:
+                # external (hybrid) hosts' pcap files are written by the
+                # HOST side, which knows the payload bytes — rewriting
+                # them here would clobber the richer capture
                 continue
             # both backends write records sorted by (time, direction,
             # src, dst, seq) — PcapWriter buffers and sorts at close, so

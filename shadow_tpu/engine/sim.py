@@ -150,7 +150,32 @@ class Simulation:
             raise
 
     def _run_tpu(self) -> SimResult:
+        from ..backend.hybrid import HybridEngine, config_has_managed
         from ..backend.tpu_engine import TpuEngine
+
+        if config_has_managed(self.cfg):
+            # the HYBRID backend: managed hosts' syscall plane on the host
+            # CPU, the packet data plane (theirs included) on the device.
+            # Run-control and perf-logging need the per-round step seam,
+            # which the device free-run deliberately elides — both are
+            # disabled here (use the cpu backend for console debugging).
+            if self.run_control is not None:
+                log.warning(
+                    "run-control is not supported on the hybrid tpu "
+                    "backend; running without it"
+                )
+                self.run_control = None
+            if self.cfg.experimental.perf_logging:
+                log.warning(
+                    "perf-logging is not supported on the hybrid tpu "
+                    "backend; running without it"
+                )
+            engine = self.engine = HybridEngine(self.cfg)
+            t0 = time.perf_counter()
+            on_window = self._make_on_window(
+                engine.describe_next_window, engine.current_runahead, t0
+            )
+            return engine.run(on_window=on_window)
 
         engine = self.engine = TpuEngine(self.cfg)
         mesh_shape = self.cfg.experimental.tpu_mesh_shape
