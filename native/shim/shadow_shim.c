@@ -1029,9 +1029,15 @@ static void preempt_arm(void) {
                       0, 0, 0);
 }
 
+static int (*g_real_pthread_create)(pthread_t *, const pthread_attr_t *,
+                                    void *(*)(void *), void *);
+
 __attribute__((constructor)) static void shim_init(void) {
     const char *path = getenv("SHADOW_TPU_SHM");
     resolve_reals();
+    /* raw-clone adoption runs from the SIGSYS handler, where dlsym could
+     * allocate: resolve pthread_create now */
+    *(void **)&g_real_pthread_create = dlsym(RTLD_NEXT, "pthread_create");
     if (!path) return; /* not under the simulator: become a no-op */
     shim_attach(path);
     g_ready = 1;
@@ -2595,6 +2601,10 @@ int inotify_init1(int flags) {
     if (!g_ready)
         return (int)raw_ret(
             shim_raw_syscall6(SYS_inotify_init1, flags, 0, 0, 0, 0, 0));
+    if (flags & ~(IN_NONBLOCK | IN_CLOEXEC)) { /* kernel contract */
+        errno = EINVAL;
+        return -1;
+    }
     int fd = reserve_fd();
     if (fd < 0) return -1;
     int64_t args[6] = {fd, 0, 0, 0, 0, 0};
@@ -2606,6 +2616,8 @@ int inotify_init1(int flags) {
         return -1;
     }
     vfd_register(fd, (flags & IN_NONBLOCK) != 0, 0);
+    if (flags & IN_CLOEXEC) /* honored on the backing fd: exec closes it */
+        shim_raw_syscall6(SYS_fcntl, fd, F_SETFD, FD_CLOEXEC, 0, 0, 0);
     return fd;
 }
 
@@ -3361,6 +3373,14 @@ static void *shim_adopted_tramp(void *p) {
     return boot.exit_val;
 }
 
+/* One adoption in flight at most — turn-taking parks every other sim
+ * thread while the SIGSYS handler runs, and the parent side waits for the
+ * child's tid publish (its LAST touch of the block) before returning —
+ * so a single static boot block replaces malloc: the handler may run
+ * inside a runtime's own allocation path (musl internals issue raw
+ * clone), where taking the malloc lock would self-deadlock. */
+static adopt_boot g_adopt_boot;
+
 static long shim_adopt_raw_thread(ucontext_t *uc, unsigned long fl,
                                   long stack, long ptid, long ctid) {
     if (!stack) return -EINVAL;
@@ -3368,12 +3388,11 @@ static long shim_adopt_raw_thread(ucontext_t *uc, unsigned long fl,
     int64_t vtid;
     int64_t ret = shim_prethread(path, sizeof(path), &vtid);
     if (ret < 0) return ret;
-    adopt_boot *boot = malloc(sizeof(*boot));
-    shim_shmem *shm = boot ? shim_map(path) : NULL;
+    adopt_boot *boot = &g_adopt_boot;
+    shim_shmem *shm = shim_map(path);
     if (!shm) {
         /* cancel so the manager frees the pending channel + file */
         shim_thread_created(vtid, 1);
-        free(boot);
         return -ENOMEM;
     }
     boot->shm = shm;
@@ -3387,10 +3406,15 @@ static long shim_adopt_raw_thread(ucontext_t *uc, unsigned long fl,
     if (boot->has_fp)
         memcpy(boot->fpstate, uc->uc_mcontext.fpregs,
                sizeof(boot->fpstate));
-    static int (*real_create)(pthread_t *, const pthread_attr_t *,
-                              void *(*)(void *), void *);
-    if (!real_create)
-        *(void **)&real_create = dlsym(RTLD_NEXT, "pthread_create");
+    /* g_real_pthread_create is pre-resolved in shim_init: dlsym from a
+     * signal handler could itself allocate */
+    int (*real_create)(pthread_t *, const pthread_attr_t *,
+                       void *(*)(void *), void *) = g_real_pthread_create;
+    if (!real_create) {
+        shim_thread_created(vtid, 1);
+        munmap(shm, sizeof(shim_shmem));
+        return -ENOSYS;
+    }
     pthread_attr_t attr;
     pthread_attr_init(&attr);
     pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
@@ -3407,17 +3431,15 @@ static long shim_adopt_raw_thread(ucontext_t *uc, unsigned long fl,
     shim_thread_created(vtid, r != 0);
     if (r != 0) {
         munmap(shm, sizeof(shim_shmem));
-        free(boot);
         return -EAGAIN;
     }
     /* the tid handshake costs microseconds of wall time, never sim time;
-     * the child's tid publish is its LAST touch of the heap block, so
-     * this side frees it */
+     * the child's tid publish is its LAST touch of the static boot block,
+     * so the block is free for the next adoption once this returns */
     while (!boot->tid)
         shim_raw_syscall6(SYS_futex, (long)&boot->tid, FUTEX_WAIT, 0, 0, 0,
                           0);
     int tid = boot->tid;
-    free(boot);
     if ((fl & CLONE_PARENT_SETTID) && ptid) *(int *)ptid = tid;
     thread_tab_register(th, vtid);
     return tid;
@@ -4504,6 +4526,10 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
                 return r;
             }
             if ((fl & CLONE_VM) && (fl & CLONE_THREAD)) {
+                /* kernel contract first: CLONE_THREAD requires
+                 * CLONE_SIGHAND (which itself requires CLONE_VM) — a
+                 * real kernel answers EINVAL, so must the emulation */
+                if (!(fl & CLONE_SIGHAND)) return -EINVAL;
                 /* the Go runtime's newosproc shape: adopt the raw thread
                  * into turn-taking via a pthread-backed context-restore
                  * (see shim_adopt_raw_thread).  CLONE_SETTLS callers

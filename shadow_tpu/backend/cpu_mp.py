@@ -1,10 +1,13 @@
-"""Multiprocessing CPU backend: fork-based host-parallel round execution.
+"""Multiprocessing CPU backend: process-parallel round execution.
 
 The reference's CPU path is genuinely parallel (thread-per-core with work
 stealing, thread_per_core.rs:17-50).  Python threads cannot parallelize
-pure-model hosts (GIL), so this backend forks real worker PROCESSES, each
-holding a complete deterministic world replica (same seeds, IPs, routing)
-and EXECUTING only its host partition each round:
+pure-model hosts (GIL), so this backend SPAWNS real worker processes, each
+REBUILDING a complete deterministic world replica from the config (same
+seeds, IPs, routing — construction is deterministic, so every replica is
+identical; spawn rather than fork because the parent has usually
+initialized JAX by then, and forking a runtime-threaded process is a
+documented deadlock) and EXECUTING only its host partition each round:
 
 - cross-partition packets fall out naturally: ``send_packet`` already
   appends to the destination's inbox, and a non-owned destination's inbox
@@ -42,9 +45,13 @@ def _partition(n_hosts: int, workers: int) -> list[list[int]]:
     return [list(range(w, n_hosts, workers)) for w in range(workers)]
 
 
-def _worker_main(engine: CpuEngine, owned: list[int], conn) -> None:
-    # fork start method: the engine object is INHERITED copy-on-write
-    # from the parent's single build — never re-built, never pickled
+def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
+    # spawn start method: each worker REBUILDS its world replica from the
+    # config — deterministic construction makes every replica identical,
+    # and no JAX-threaded parent is ever forked (forking a process whose
+    # runtime threads may hold locks is a documented deadlock, and the
+    # parent has usually initialized a device backend by now)
+    engine = CpuEngine(cfg)
     owned_hosts = [engine.hosts[i] for i in owned]
     owned_set = set(owned)
     try:
@@ -140,18 +147,28 @@ class MpCpuEngine:
         parts = _partition(n, self.workers)
         owner_of = [hid % self.workers for hid in range(n)]
 
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context("spawn")
         conns, procs = [], []
-        for w, owned in enumerate(parts):
-            parent_conn, child_conn = ctx.Pipe()
-            p = ctx.Process(
-                target=_worker_main, args=(ctl, owned, child_conn),
-                daemon=True,
-            )
-            p.start()
-            child_conn.close()
-            conns.append(parent_conn)
-            procs.append(p)
+        # children import shadow_tpu (which imports jax) at spawn: pin
+        # them to the CPU platform so no worker dials a device tunnel
+        saved_platform = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for w, owned in enumerate(parts):
+                parent_conn, child_conn = ctx.Pipe()
+                p = ctx.Process(
+                    target=_worker_main, args=(self.cfg, owned, child_conn),
+                    daemon=True,
+                )
+                p.start()
+                child_conn.close()
+                conns.append(parent_conn)
+                procs.append(p)
+        finally:
+            if saved_platform is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = saved_platform
 
         t0 = wall_time.perf_counter()
         try:

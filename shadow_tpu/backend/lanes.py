@@ -295,6 +295,16 @@ class LaneParams:
     # Multiplies XLA compile time with the body size — worth it for small
     # slot bodies (the passive models), costly for phold/stream
     unroll: int = 1
+    # TIERED stream backend (one-to-one configs): stream endpoints keep a
+    # dedicated [2S, C2] queue block + compact network state
+    # (lanes_stream.TierState under ``state.stream``), the [N] tier runs
+    # the pure-mesh body with no payload columns, and deliveries at
+    # stream endpoints are ELIDED (TCP law applied inline at t_deliver)
+    # whenever t_deliver lands inside the current window — exact for
+    # one-to-one flows, and window-law-exact via the fallback insert.
+    stream_tiered: bool = False
+    stream_pops: int = 8  # K_s: tier pop columns per iteration
+    stream_capacity: int = 64  # C2: tier queue width
     # hybrid backend (backend/hybrid.py): some lanes are EXTERNAL — their
     # apps (real managed binaries, or any host-only model) execute on the
     # host CPU while their network dn-side (down bucket, CoDel, arrival
@@ -309,6 +319,12 @@ class LaneParams:
     @property
     def stream_present(self) -> bool:
         return bool(set(self.models_present) & STREAM_MODELS)
+
+    @property
+    def lanes_have_payload(self) -> bool:
+        """The [N] queues carry payload columns only when stream events
+        ride them — the tiered backend moves those to the [2S] block."""
+        return self.stream_present and not self.stream_tiered
 
     @property
     def all_passive(self) -> bool:
@@ -381,6 +397,15 @@ class LaneTables(NamedTuple):
     # hybrid backend: [N] bool — lane is EXTERNAL (host-executed host);
     # () on non-hybrid runs
     lane_external: Any = ()
+    # tiered backend: the endpoint lane's DOWN bucket (arrivals at stream
+    # endpoints are processed by the [2S] tier) — () otherwise
+    flow_dn_rate: Any = ()
+    flow_dn_burst: Any = ()
+    flow_dn_kfull: Any = ()
+    flow_dn_kfi: Any = ()
+    # [N] bool: lane is a stream endpoint (tiered: its [N] queue row is
+    # dead and cross traffic to it diverts into the tier block)
+    lane_stream: Any = ()
 
 
 # --------------------------------------------------------------------------
@@ -505,13 +530,15 @@ def bucket_charge_chained_vec(
 CD_UNSET = -(1 << 31) + 1
 
 
-def codel_offer_vec(state, td_hi, td_lo, sojourn, active, codel_div):
-    """Masked PAIR form of CoDel.offer; returns (state', drop_mask).
+def codel_offer_arrays(
+    fat_hi, fat_lo, dn_hi, dn_lo, dcount, dropping,
+    td_hi, td_lo, sojourn, active, codel_div,
+):
+    """Masked PAIR form of CoDel.offer on explicit state arrays; returns
+    ``(fat_hi', fat_lo', dnext_hi', dnext_lo', dcount', dropping', drop)``.
     ``sojourn`` is an int32 clamped difference — exact for every compare
-    in the law (values past the clamp are far above TARGET either way)."""
-    fat_hi, fat_lo = state.cd_fat_hi, state.cd_fat_lo
-    dn_hi, dn_lo = state.cd_dnext_hi, state.cd_dnext_lo
-    dcount, dropping = state.cd_drop_count, state.cd_dropping
+    in the law (values past the clamp are far above TARGET either way).
+    Shape-generic: the [N] lane tier and the [2S] stream tier share it."""
     unset = fat_hi == CD_UNSET
     below = sojourn < codel_mod.TARGET_NS
     ent_hi, ent_lo = pair_add32(td_hi, td_lo, codel_mod.INTERVAL_NS)
@@ -557,14 +584,24 @@ def codel_offer_vec(state, td_hi, td_lo, sojourn, active, codel_div):
     )
     dn_out_hi = jnp.where(enter, dne_hi, dnd_hi)
     dn_out_lo = jnp.where(enter, dne_lo, dnd_lo)
+    return (fat_out_hi, fat_out_lo, dn_out_hi, dn_out_lo, dcount_out,
+            dropping_out, drop)
 
+
+def codel_offer_vec(state, td_hi, td_lo, sojourn, active, codel_div):
+    """LaneState wrapper of :func:`codel_offer_arrays`."""
+    fat_hi, fat_lo, dn_hi, dn_lo, dcount, dropping, drop = codel_offer_arrays(
+        state.cd_fat_hi, state.cd_fat_lo, state.cd_dnext_hi,
+        state.cd_dnext_lo, state.cd_drop_count, state.cd_dropping,
+        td_hi, td_lo, sojourn, active, codel_div,
+    )
     state = state._replace(
-        cd_fat_hi=fat_out_hi,
-        cd_fat_lo=fat_out_lo,
-        cd_dnext_hi=dn_out_hi,
-        cd_dnext_lo=dn_out_lo,
-        cd_drop_count=dcount_out,
-        cd_dropping=dropping_out,
+        cd_fat_hi=fat_hi,
+        cd_fat_lo=fat_lo,
+        cd_dnext_hi=dn_hi,
+        cd_dnext_lo=dn_lo,
+        cd_drop_count=dcount,
+        cd_dropping=dropping,
     )
     return state, drop
 
@@ -1345,7 +1382,7 @@ def _window_gather(arrs, start, c):
 
 
 def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
-                  emits: _SlotEmit):
+                  emits: _SlotEmit, divert: bool = False):
     """Append all generated events by **merge**, not scatter (TPU scatters
     serialize; sorts and gathers vectorize):
 
@@ -1581,6 +1618,25 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
     # before the merge even sees it; count those drops too
     lost_pre = jnp.maximum(cnt - cx, 0)
 
+    # tiered stream backend: entries destined to stream-endpoint lanes
+    # divert into the [2S] tier merge (their [N] queue rows are dead) —
+    # a [2S]-row gather of the cross block, then NEVER-mask those lanes
+    # out of the [N] merge below
+    tier_cross = None
+    if divert:
+        el = tb.flow_lanes
+        tier_cross = {
+            "valid": in_seg[el],
+            "thi": cross_thi[el],
+            "tlo": cross_tlo[el],
+            "auxh": cross_auxh[el],
+            "auxl": cross_auxl[el],
+            "size": cross_size[el],
+        }
+        keep = ~tb.lane_stream[:, None]
+        cross_thi = jnp.where(keep, cross_thi, NEVER32)
+        cross_tlo = jnp.where(keep, cross_tlo, NEVER32)
+
     # -- merge [N, C + self + Cx], keep first C ---------------------------
     # queue state is ALREADY the int32 4-word key: no conversions at all
     mthi = jnp.concatenate([s.q_thi, self_thi, cross_thi], axis=1)
@@ -1640,7 +1696,7 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
                 k: jnp.concatenate([over_rec[k], over_b[k]])
                 for k in over_rec
             }
-    return s, over_rec
+    return (s, over_rec, tier_cross) if divert else (s, over_rec)
 
 
 def _merge_stream_rows(p: LaneParams, tb: LaneTables, s: LaneState,
@@ -1833,6 +1889,550 @@ def _append_egress(p: LaneParams, s: LaneState, valid, delivered,
     )
 
 
+def _queue_min(p: LaneParams, s: LaneState):
+    """Scalar pair: the earliest event over ALL queues ([N] lanes, plus
+    the [2S] tier block when the tiered stream backend is live)."""
+    mh, ml = pair_min_lanes(s.q_thi[:, 0], s.q_tlo[:, 0])
+    if p.stream_tiered:
+        th, tl = pair_min_lanes(
+            s.stream.q[lstr.TQ_THI, :, 0], s.stream.q[lstr.TQ_TLO, :, 0]
+        )
+        sel = pair_lt(th, tl, mh, ml)
+        mh = jnp.where(sel, th, mh)
+        ml = jnp.where(sel, tl, ml)
+    return mh, ml
+
+
+def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
+                      we_hi, we_lo, tier_cross) -> LaneState:
+    """One iteration of the TIERED stream backend: pop ≤K_s events per
+    endpoint row from the [2S, C2] tier queue, process them (dn bucket +
+    CoDel + the TCP law, all on compact [2S] state), and merge the
+    emissions — control sends and bursts land at the STATIC peer row,
+    RTO arms and delivery fallbacks at the own row, and ``tier_cross``
+    carries the mesh spray the [N] exchange diverted to stream lanes.
+
+    Delivery elision: a delivered packet whose t_deliver lands INSIDE the
+    current window applies the law inline at t_deliver instead of
+    self-inserting a DELIVERY event.  Exact for one-to-one flows: the
+    popped prefix holds no LOCALs (the prefix rule stops at them), every
+    flow-relevant delivery at a row shares one src (its single peer), dn
+    departures are FIFO (inline order = the oracle's delivery order),
+    and the law's send/arm emissions touch state disjoint from later
+    pops' dn charges.  t_deliver >= window_end falls back to a real
+    DELIVERY insert, which keeps the WINDOW-LAW sequence bit-identical
+    too (a pending delivery bounds the next window on both backends)."""
+    ts = s.stream
+    q, v = ts.q, ts.v
+    k = p.stream_pops
+    s2 = q.shape[1]
+    s_flows = s2 // 2
+    c2 = p.stream_capacity
+    i32 = jnp.int32
+    i64 = jnp.int64
+    el = tb.flow_lanes
+    is_cl_e = jnp.arange(s2, dtype=i32) < s_flows
+    false_e = jnp.zeros(s2, dtype=bool)
+    false_c = jnp.zeros(s_flows, dtype=bool)
+    cl_sl = slice(0, s_flows)
+
+    # ---- pop prefix ------------------------------------------------------
+    thi_b = q[lstr.TQ_THI, :, :k]
+    tlo_b = q[lstr.TQ_TLO, :, :k]
+    kind_cols = q[lstr.TQ_AUXH, :, :k] >> AUX_KIND_SHIFT
+    first_col = (jnp.arange(k) == 0)[None, :]
+    if p.stream_wide_pop:
+        # any non-LOCAL within-window prefix (see the elision note above;
+        # the engine guarantees every window ends before RTO_MIN)
+        prefix = jnp.cumprod(kind_cols != LOCAL, axis=1).astype(bool)
+    else:
+        same_t = (thi_b == thi_b[:, :1]) & (tlo_b == tlo_b[:, :1])
+        pkt_prefix = jnp.cumprod(kind_cols == PACKET, axis=1).astype(bool)
+        prefix = same_t & pkt_prefix
+    allowed = prefix | first_col
+    act_b = allowed & pair_lt(thi_b, tlo_b, we_hi, we_lo)
+    q = q.at[lstr.TQ_THI, :, :k].set(jnp.where(act_b, NEVER32, thi_b))
+    q = q.at[lstr.TQ_TLO, :, :k].set(jnp.where(act_b, NEVER32, tlo_b))
+
+    f = lstr.endpoint_cols(
+        ts.flows, tb.flow_segs, tb.flow_mss, tb.flow_last
+    )
+    mul = s.min_used_lat
+    log_on = bool(p.log_capacity)
+    bs_hi, bs_lo = p.bootstrap_end >> 31, p.bootstrap_end & MASK31
+
+    # slots run through scan_or_unroll: ONE law copy under XLA:CPU's
+    # rolled scan (K inlined law bodies made CPU compile explode), a
+    # fusable Python loop on the accelerator
+    xs = {
+        "thi": thi_b.T,
+        "tlo": tlo_b.T,
+        "auxh": jnp.moveaxis(ts.q[lstr.TQ_AUXH, :, :k], 1, 0),
+        "auxl": jnp.moveaxis(ts.q[lstr.TQ_AUXL, :, :k], 1, 0),
+        "size": jnp.moveaxis(ts.q[lstr.TQ_SIZE, :, :k], 1, 0),
+        "phi": jnp.moveaxis(ts.q[lstr.TQ_PHI, :, :k], 1, 0),
+        "plo": jnp.moveaxis(ts.q[lstr.TQ_PLO, :, :k], 1, 0),
+        "act": act_b.T,
+    }
+
+    def tier_slot(carry, x):
+        f, v, mul = carry
+        thi, tlo = x["thi"], x["tlo"]
+        auxh, auxl, size = x["auxh"], x["auxl"], x["size"]
+        phi, plo = x["phi"], x["plo"]
+        act = x["act"]
+        kind, src = unpack_aux_hi(auxh)
+
+        # -- PACKET: dn bucket + CoDel on compact rows ---------------------
+        is_pkt = act & (kind == PACKET)
+        bits = (size + FRAME_OVERHEAD_BYTES) * 8
+        (dn_tok, dn_nrh, dn_nrl, dn_ldh, dn_ldl, td_hi, td_lo) = (
+            bucket_charge_vec(
+                v[lstr.TV_DN_TOK], v[lstr.TV_DN_NRH], v[lstr.TV_DN_NRL],
+                v[lstr.TV_DN_LDH], v[lstr.TV_DN_LDL],
+                tb.flow_dn_rate, tb.flow_dn_burst, tb.flow_dn_kfull,
+                tb.flow_dn_kfi, thi, tlo, bits, is_pkt, p.bucket_interval,
+            )
+        )
+        sojourn = pair_sub_clamp(td_hi, td_lo, thi, tlo, NEVER32)
+        (cd_fh, cd_fl, cd_dh, cd_dl, cd_cnt, cd_drop_state, codel_drop) = (
+            codel_offer_arrays(
+                v[lstr.TV_CD_FATH], v[lstr.TV_CD_FATL], v[lstr.TV_CD_DNH],
+                v[lstr.TV_CD_DNL], v[lstr.TV_CD_CNT],
+                v[lstr.TV_CD_DROP].astype(bool),
+                td_hi, td_lo, sojourn, is_pkt, tb.codel_div,
+            )
+        )
+        deliver = is_pkt & ~codel_drop
+        v = v.at[lstr.TV_DN_TOK].set(dn_tok)
+        v = v.at[lstr.TV_DN_NRH].set(dn_nrh)
+        v = v.at[lstr.TV_DN_NRL].set(dn_nrl)
+        v = v.at[lstr.TV_DN_LDH].set(dn_ldh)
+        v = v.at[lstr.TV_DN_LDL].set(dn_ldl)
+        v = v.at[lstr.TV_CD_FATH].set(cd_fh)
+        v = v.at[lstr.TV_CD_FATL].set(cd_fl)
+        v = v.at[lstr.TV_CD_DNH].set(cd_dh)
+        v = v.at[lstr.TV_CD_DNL].set(cd_dl)
+        v = v.at[lstr.TV_CD_CNT].set(cd_cnt)
+        v = v.at[lstr.TV_CD_DROP].set(cd_drop_state.astype(i32))
+        v = v.at[lstr.TV_N_DEL].add(deliver)
+        v = v.at[lstr.TV_N_CODEL].add(is_pkt & codel_drop)
+
+        # -- delivery elision gate ----------------------------------------
+        # elide only under the wide-pop guarantee (window < RTO_MIN): it
+        # proves no armed LOCAL can sort below an in-window t_deliver, so
+        # inline processing cannot jump an RTO.  Otherwise (huge-latency
+        # graphs) every delivery takes the exact queued path.
+        if p.stream_wide_pop:
+            del_now = deliver & pair_lt(td_hi, td_lo, we_hi, we_lo)
+        else:
+            del_now = false_e
+        ins_valid = deliver & ~del_now  # fallback DELIVERY self-insert
+        is_del = act & (kind == DELIVERY)
+
+        # stimulus time: the delivery time either way
+        sh = jnp.where(del_now, td_hi, thi)
+        sl = jnp.where(del_now, td_lo, tlo)
+        flags_in, sseq_in, sack_in = lstr.unpack_pay(phi, plo)
+        seg_stim = (
+            (del_now | is_del) & ((phi | plo) != 0)
+            & (is_cl_e | (src == tb.flow_clid))
+        )
+        is_loc = act & (kind == LOCAL)
+        stim_open = is_loc & (size == -1) & is_cl_e
+        stim_rto = is_loc & (size == lstr.SZ_RTO) & (plo == tb.flow_clid)
+
+        f1, em1 = lstr.open_flow_vec(f, sh, sl, stim_open)
+        f = lstr._merge_cols(f, f1, stim_open)
+        f3, em3 = lstr.on_rto_vec(f, sh, sl, stim_rto)
+        f = lstr._merge_cols(f, f3, stim_rto)
+        f4, em4 = lstr.on_segment_vec(
+            f, sh, sl, seg_stim, flags_in, sseq_in, sack_in, size
+        )
+        f = lstr._merge_cols(f, f4, seg_stim)
+        sem = lstr._merge_emit(
+            lstr._merge_emit(em1, em3, stim_rto), em4, seg_stim
+        )
+        stream_stim = stim_open | stim_rto | seg_stim
+        f = f._replace(
+            completed=f.completed | (sem.completed_now & stream_stim)
+        )
+        f, sem, st_burst = lstr.pump_epilogue_vec(f, sh, sl, stream_stim, sem)
+        st_send = sem.send_valid & stream_stim
+        st_rto = sem.rto_valid & stream_stim
+
+        # -- slot-0 control send (up bucket, loss, arrival) ---------------
+        se_size = sem.send_size
+        se_bits = (se_size + FRAME_OVERHEAD_BYTES) * 8
+        (up_tok, up_nrh, up_nrl, up_ldh, up_ldl, se_dep_hi, se_dep_lo) = (
+            bucket_charge_vec(
+                v[lstr.TV_UP_TOK], v[lstr.TV_UP_NRH], v[lstr.TV_UP_NRL],
+                v[lstr.TV_UP_LDH], v[lstr.TV_UP_LDL],
+                tb.flow_up_rate, tb.flow_up_burst, tb.flow_up_kfull,
+                tb.flow_up_kfi, sh, sl, se_bits, st_send,
+                p.bucket_interval,
+            )
+        )
+        se_seq = v[lstr.TV_SEND_SEQ]
+        if p.has_loss:
+            e_past_bs = pair_ge(sh, sl, bs_hi, bs_lo)
+            eu = rand_u32_lane(
+                p.seed,
+                (el.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
+                se_seq,
+            )
+            se_lost = st_send & e_past_bs & (
+                tb.flow_thresh_all | (eu < tb.flow_thresh_u32)
+            )
+        else:
+            se_lost = false_e
+        if p.dynamic_runahead:
+            mul = jnp.minimum(
+                mul, jnp.min(jnp.where(st_send, tb.flow_lat, NEVER32))
+            )
+        se_thi, se_tlo = pair_max(
+            *pair_add32(se_dep_hi, se_dep_lo, tb.flow_lat), we_hi, we_lo
+        )
+        se_valid = st_send & ~se_lost
+        se_phi, se_plo = lstr.pack_pay(
+            sem.send_flags, sem.send_seq, sem.send_ack
+        )
+
+        # -- RTO arm (LOCAL self-insert at the own row) --------------------
+        sa_valid = st_rto
+        sa_thi, sa_tlo = sem.rto_thi, sem.rto_tlo
+        sa_auxl = v[lstr.TV_LOCAL_SEQ]
+
+        # -- burst chain (client half), charging compact up-bucket rows ----
+        cthi, ctlo = sh[cl_sl], sl[cl_sl]
+        b_lat_c = tb.flow_lat[cl_sl]
+        cl_lanes_u32 = el[cl_sl].astype(jnp.uint32)
+
+        def bstep(carry, cols, first: bool):
+            tok, nrh, nrl, ldh, ldl, nloss, mu, sent_before = carry
+            bm, bflags, bunit, back, bsize = cols
+            bbits = (bsize + FRAME_OVERHEAD_BYTES) * 8
+            if first:
+                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = (
+                    bucket_charge_vec(
+                        tok, nrh, nrl, ldh, ldl,
+                        tb.flow_up_rate[cl_sl], tb.flow_up_burst[cl_sl],
+                        tb.flow_up_kfull[cl_sl], tb.flow_up_kfi[cl_sl],
+                        cthi, ctlo, bbits, bm, p.bucket_interval,
+                    )
+                )
+            else:
+                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = (
+                    bucket_charge_chained_vec(
+                        tok, nrh, nrl, ldh, ldl, tb.flow_up_rate[cl_sl],
+                        tb.flow_up_burst[cl_sl], bbits, bm,
+                        p.bucket_interval, cthi, ctlo,
+                    )
+                )
+            bseq = se_seq[cl_sl] + sent_before
+            if p.has_loss:
+                bu = rand_u32_lane(
+                    p.seed,
+                    (cl_lanes_u32 | jnp.uint32(rng_mod.LOSS_STREAM)),
+                    bseq,
+                )
+                blost = bm & e_past_bs[cl_sl] & (
+                    tb.flow_thresh_all[cl_sl] | (bu < tb.flow_thresh_u32[cl_sl])
+                )
+                nloss = nloss + blost
+            else:
+                blost = false_c
+            if p.dynamic_runahead:
+                mu = jnp.minimum(
+                    mu, jnp.min(jnp.where(bm, b_lat_c, NEVER32))
+                )
+            barr_hi, barr_lo = pair_max(
+                *pair_add32(bdep_hi, bdep_lo, b_lat_c), we_hi, we_lo
+            )
+            bphi, bplo = lstr.pack_pay(bflags, bunit, back)
+            outs = (
+                bm & ~blost, barr_hi, barr_lo, bseq, bsize, bphi, bplo,
+                blost, bdep_hi, bdep_lo,
+            )
+            return (tok, nrh, nrl, ldh, ldl, nloss, mu,
+                    sent_before + bm), outs
+
+        up_nloss = v[lstr.TV_N_LOSS] + se_lost
+        carry0 = (
+            up_tok[cl_sl], up_nrh[cl_sl], up_nrl[cl_sl], up_ldh[cl_sl],
+            up_ldl[cl_sl], up_nloss[cl_sl], mul,
+            st_send[cl_sl].astype(i32),
+        )
+        st_burst_c = jax.tree.map(lambda a: a[:, cl_sl], tuple(st_burst))
+        first_cols = jax.tree.map(lambda a: a[0], st_burst_c)
+        rest_cols = jax.tree.map(lambda a: a[1:], st_burst_c)
+        carry, out0 = bstep(carry0, first_cols, True)
+        n_rest = st_burst_c[0].shape[0] - 1
+        if n_rest:
+            carry, bouts_rest = scan_or_unroll(
+                lambda c_, x: bstep(c_, x, False), carry, rest_cols, n_rest
+            )
+            bouts = jax.tree.map(
+                lambda a0, ar: jnp.concatenate([a0[None], ar]),
+                out0, bouts_rest,
+            )
+        else:
+            bouts = jax.tree.map(lambda a0: a0[None], out0)
+        (tok_c, nrh_c, nrl_c, ldh_c, ldl_c, nloss_c, mul, sent_after) = carry
+        burst_total = sent_after - st_send[cl_sl].astype(i32)
+        pad_c = jnp.zeros(s_flows, dtype=i32)
+
+        v = v.at[lstr.TV_UP_TOK].set(
+            jnp.concatenate([tok_c, up_tok[s_flows:]]))
+        v = v.at[lstr.TV_UP_NRH].set(
+            jnp.concatenate([nrh_c, up_nrh[s_flows:]]))
+        v = v.at[lstr.TV_UP_NRL].set(
+            jnp.concatenate([nrl_c, up_nrl[s_flows:]]))
+        v = v.at[lstr.TV_UP_LDH].set(
+            jnp.concatenate([ldh_c, up_ldh[s_flows:]]))
+        v = v.at[lstr.TV_UP_LDL].set(
+            jnp.concatenate([ldl_c, up_ldl[s_flows:]]))
+        v = v.at[lstr.TV_N_LOSS].set(
+            jnp.concatenate([nloss_c, up_nloss[s_flows:]]))
+        v = v.at[lstr.TV_SEND_SEQ].add(
+            st_send + jnp.concatenate([burst_total, pad_c]))
+        v = v.at[lstr.TV_N_SENDS].add(
+            st_send + jnp.concatenate([burst_total, pad_c]))
+        v = v.at[lstr.TV_LOCAL_SEQ].add(sa_valid)
+
+        (bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
+         blost_all, bdep_hi_all, bdep_lo_all) = bouts
+
+        out = {
+            "ins_valid": ins_valid, "ins_thi": td_hi, "ins_tlo": td_lo,
+            "ins_auxh": pack_aux_hi(jnp.full(s2, DELIVERY, dtype=i32), src),
+            "ins_auxl": auxl, "ins_size": size, "ins_phi": phi,
+            "ins_plo": plo,
+            "se_valid": se_valid, "se_thi": se_thi, "se_tlo": se_tlo,
+            "se_seq": se_seq, "se_size": se_size, "se_phi": se_phi,
+            "se_plo": se_plo,
+            "sa_valid": sa_valid, "sa_thi": sa_thi, "sa_tlo": sa_tlo,
+            "sa_auxl": sa_auxl,
+            "bo_valid": bo_valid, "bo_thi": bo_thi, "bo_tlo": bo_tlo,
+            "bo_auxl": bo_auxl, "bo_size": bo_size, "bo_phi": bo_phi,
+            "bo_plo": bo_plo,
+        }
+        if log_on:
+            t64d = t_join(td_hi, td_lo)
+            out["rec_valid"] = is_pkt
+            out["rec_time"] = t64d
+            out["rec_src"] = src.astype(i64)
+            out["rec_dst"] = el.astype(i64)
+            out["rec_seq"] = auxl.astype(i64)
+            out["rec_size"] = size.astype(i64)
+            out["rec_outcome"] = jnp.where(
+                codel_drop, DROP_CODEL, DELIVERED
+            ).astype(i64)
+            st64 = t_join(sh, sl)
+            out["srec_valid"] = se_lost
+            out["srec_time"] = st64
+            out["srec_seq"] = se_seq.astype(i64)
+            out["srec_size"] = se_size.astype(i64)
+            out["brec_valid"] = blost_all
+            out["brec_time"] = jnp.broadcast_to(
+                st64[cl_sl][None, :], blost_all.shape
+            )
+            out["brec_seq"] = bo_auxl.astype(i64)
+            out["brec_size"] = bo_size.astype(i64)
+            if p.stream_pcap:
+                out["spc_valid"] = st_send & tb.flow_pcap
+                out["spc_time"] = t_join(se_dep_hi, se_dep_lo)
+                out["spc_seq"] = se_seq.astype(i64)
+                out["spc_size"] = se_size.astype(i64)
+                out["bpc_valid"] = (
+                    (bo_valid | blost_all) & tb.flow_pcap[cl_sl][None, :]
+                )
+                out["bpc_time"] = t_join(bdep_hi_all, bdep_lo_all)
+                out["bpc_seq"] = bo_auxl.astype(i64)
+                out["bpc_size"] = bo_size.astype(i64)
+        return (f, v, mul), out
+
+    (f, v, mul), outs = scan_or_unroll(
+        tier_slot, (f, v, mul), xs, k
+    )
+    ts = ts._replace(flows=lstr.endpoint_split(f), v=v)
+    s = s._replace(min_used_lat=mul)
+
+    # ---- merge: queue + all slot channels + diverted mesh cross ----------
+    def stack(key):  # [K, 2S] -> [2S, K]
+        return jnp.moveaxis(outs[key], 0, 1)
+
+    # se channels swap halves (emitter-indexed -> receiver-indexed: client
+    # row r receives its server's sends and vice versa)
+    def swap(a):
+        return jnp.concatenate([a[s_flows:], a[:s_flows]], axis=0)
+
+    kk = k
+    bb = int(outs["bo_valid"].shape[1])
+    never_kb = jnp.full((s_flows, kk * bb), NEVER32, dtype=i32)
+    zero_kb = jnp.zeros((s_flows, kk * bb), dtype=i32)
+
+    def bo_block(key, pad):
+        # [K, B, S] -> [S, K*B] on the server half, pad on the client half
+        arr = outs["bo_" + key]
+        sv_rows = jnp.moveaxis(arr, 2, 0).reshape(s_flows, kk * bb)
+        return jnp.concatenate([pad, sv_rows], axis=0)  # [2S, K*B]
+
+    se_v = swap(stack("se_valid"))
+    cand_valid = [stack("ins_valid"), stack("sa_valid"), se_v]
+    cand_thi = [stack("ins_thi"), stack("sa_thi"), swap(stack("se_thi"))]
+    cand_tlo = [stack("ins_tlo"), stack("sa_tlo"), swap(stack("se_tlo"))]
+    # aux-hi: ins carries the packet's (DELIVERY, src); arms are LOCAL from
+    # the own lane; se are PACKETs from the peer lane
+    loc_auxh = pack_aux_hi(jnp.full(s2, LOCAL, dtype=i32), el)
+    pkt_from_peer = pack_aux_hi(
+        jnp.full(s2, PACKET, dtype=i32), tb.flow_peers
+    )
+    cand_auxh = [
+        stack("ins_auxh"),
+        jnp.broadcast_to(loc_auxh[:, None], (s2, kk)),
+        jnp.broadcast_to(pkt_from_peer[:, None], (s2, kk)),
+    ]
+    cand_auxl = [stack("ins_auxl"), stack("sa_auxl"), swap(stack("se_seq"))]
+    cand_size = [
+        stack("ins_size"),
+        jnp.full((s2, kk), lstr.SZ_RTO, dtype=i32),
+        swap(stack("se_size")),
+    ]
+    cand_phi = [stack("ins_phi"), jnp.zeros((s2, kk), dtype=i32),
+                swap(stack("se_phi"))]
+    cand_plo = [stack("ins_plo"),
+                jnp.broadcast_to(tb.flow_clid[:, None], (s2, kk)),
+                swap(stack("se_plo"))]
+
+    bo_v = bo_block("valid", jnp.zeros((s_flows, kk * bb), dtype=bool))
+    cand_valid.append(bo_v)
+    cand_thi.append(bo_block("thi", never_kb))
+    cand_tlo.append(bo_block("tlo", never_kb))
+    bo_auxh_c = pack_aux_hi(
+        jnp.full(s_flows, PACKET, dtype=i32), el[:s_flows]
+    )
+    cand_auxh.append(
+        jnp.concatenate([
+            jnp.zeros((s_flows, kk * bb), dtype=i32),
+            jnp.broadcast_to(bo_auxh_c[:, None], (s_flows, kk * bb)),
+        ], axis=0)
+    )
+    cand_auxl.append(bo_block("auxl", zero_kb))
+    cand_size.append(bo_block("size", zero_kb))
+    cand_phi.append(bo_block("phi", zero_kb))
+    cand_plo.append(bo_block("plo", zero_kb))
+
+    if tier_cross is not None:
+        cand_valid.append(tier_cross["valid"])
+        cand_thi.append(tier_cross["thi"])
+        cand_tlo.append(tier_cross["tlo"])
+        cand_auxh.append(tier_cross["auxh"])
+        cand_auxl.append(tier_cross["auxl"])
+        cand_size.append(tier_cross["size"])
+        cand_phi.append(jnp.zeros_like(tier_cross["auxl"]))
+        cand_plo.append(jnp.zeros_like(tier_cross["auxl"]))
+
+    cv = jnp.concatenate(cand_valid, axis=1)
+    cthi = jnp.where(cv, jnp.concatenate(cand_thi, axis=1), NEVER32)
+    ctlo = jnp.where(cv, jnp.concatenate(cand_tlo, axis=1), NEVER32)
+    cauxh = jnp.concatenate(cand_auxh, axis=1)
+    cauxl = jnp.concatenate(cand_auxl, axis=1)
+    csize = jnp.concatenate(cand_size, axis=1)
+    cphi = jnp.concatenate(cand_phi, axis=1)
+    cplo = jnp.concatenate(cand_plo, axis=1)
+
+    mthi, mtlo, mh, ml, ms, mphi, mplo = lax.sort(
+        (
+            jnp.concatenate([q[lstr.TQ_THI], cthi], axis=1),
+            jnp.concatenate([q[lstr.TQ_TLO], ctlo], axis=1),
+            jnp.concatenate([q[lstr.TQ_AUXH], cauxh], axis=1),
+            jnp.concatenate([q[lstr.TQ_AUXL], cauxl], axis=1),
+            jnp.concatenate([q[lstr.TQ_SIZE], csize], axis=1),
+            jnp.concatenate([q[lstr.TQ_PHI], cphi], axis=1),
+            jnp.concatenate([q[lstr.TQ_PLO], cplo], axis=1),
+        ),
+        dimension=1, num_keys=4, is_stable=False,
+    )
+    tail_mask = mthi[:, c2:] != NEVER32
+    v = v.at[lstr.TV_N_QUEUE].add(tail_mask.sum(axis=1, dtype=i32))
+    q = jnp.stack([
+        mthi[:, :c2], mtlo[:, :c2], mh[:, :c2], ml[:, :c2], ms[:, :c2],
+        mphi[:, :c2], mplo[:, :c2],
+    ])
+    s = s._replace(stream=ts._replace(q=q, v=v))
+
+    # ---- log appends (edge work; the bench runs log_capacity=0) ----------
+    if log_on:
+        el64 = el.astype(i64)
+        pe64 = tb.flow_peers.astype(i64)
+        el64_k = jnp.broadcast_to(el64[None, :], (kk, s2)).reshape(-1)
+        pe64_k = jnp.broadcast_to(pe64[None, :], (kk, s2)).reshape(-1)
+        s = _append_log(p, s, {
+            "valid": outs["rec_valid"].reshape(-1),
+            "time": outs["rec_time"].reshape(-1),
+            "src": outs["rec_src"].reshape(-1),
+            "dst": outs["rec_dst"].reshape(-1),
+            "seq": outs["rec_seq"].reshape(-1),
+            "size": outs["rec_size"].reshape(-1),
+            "outcome": outs["rec_outcome"].reshape(-1),
+        })
+        s = _append_log(p, s, {
+            "valid": outs["srec_valid"].reshape(-1),
+            "time": outs["srec_time"].reshape(-1),
+            "src": el64_k, "dst": pe64_k,
+            "seq": outs["srec_seq"].reshape(-1),
+            "size": outs["srec_size"].reshape(-1),
+            "outcome": jnp.full(kk * s2, DROP_LOSS, dtype=i64),
+        })
+        shape_b = outs["brec_valid"].shape  # [K, B, S]
+        el64_b = jnp.broadcast_to(
+            el64[:s_flows][None, None, :], shape_b).reshape(-1)
+        pe64_b = jnp.broadcast_to(
+            pe64[:s_flows][None, None, :], shape_b).reshape(-1)
+        s = _append_log(p, s, {
+            "valid": outs["brec_valid"].reshape(-1),
+            "time": outs["brec_time"].reshape(-1),
+            "src": el64_b, "dst": pe64_b,
+            "seq": outs["brec_seq"].reshape(-1),
+            "size": outs["brec_size"].reshape(-1),
+            "outcome": jnp.full(
+                shape_b[0] * shape_b[1] * s_flows, DROP_LOSS, dtype=i64),
+        })
+        if p.stream_pcap:
+            s = _append_log(p, s, {
+                "valid": outs["spc_valid"].reshape(-1),
+                "time": outs["spc_time"].reshape(-1),
+                "src": el64_k, "dst": pe64_k,
+                "seq": outs["spc_seq"].reshape(-1),
+                "size": outs["spc_size"].reshape(-1),
+                "outcome": jnp.full(kk * s2, PCAP_TX, dtype=i64),
+            })
+            s = _append_log(p, s, {
+                "valid": outs["bpc_valid"].reshape(-1),
+                "time": outs["bpc_time"].reshape(-1),
+                "src": el64_b, "dst": pe64_b,
+                "seq": outs["bpc_seq"].reshape(-1),
+                "size": outs["bpc_size"].reshape(-1),
+                "outcome": jnp.full(
+                    shape_b[0] * shape_b[1] * s_flows, PCAP_TX, dtype=i64),
+            })
+        # queue-overflow records
+        t_tail = t_join(mthi[:, c2:], mtlo[:, c2:])
+        _k2, o_src = unpack_aux_hi(mh[:, c2:])
+        rows64 = jnp.broadcast_to(el64[:, None], tail_mask.shape)
+        s = _append_log(p, s, {
+            "valid": tail_mask.reshape(-1),
+            "time": t_tail.reshape(-1),
+            "src": o_src.reshape(-1).astype(i64),
+            "dst": rows64.reshape(-1),
+            "seq": ml[:, c2:].reshape(-1).astype(i64),
+            "size": ms[:, c2:].reshape(-1).astype(i64),
+            "outcome": jnp.full(tail_mask.size, DROP_QUEUE, dtype=i64),
+        })
+    return s
+
+
 def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
     """Build the raw one-ITERATION advance (pop ≤K, process, merge) against
     the window already in ``state.now_we_hi/lo``.  The step driver wraps
@@ -1842,7 +2442,27 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
     ``pure_dataflow=True`` (the fused device run) removes every
     ``lax.cond`` skip path: device control flow costs a host round-trip
     per decision on the tunneled runtime, so unconditional masked work is
-    faster there.  The step driver keeps the skips — on CPU they pay."""
+    faster there.  The step driver keeps the skips — on CPU they pay.
+
+    TIERED mode: the [N] machinery runs with a derived params view whose
+    model set excludes the stream models (the whole stream slot body,
+    payload columns, and 7-operand merge vanish from the [N] tier); the
+    [2S] stream tier runs as its own pop/process/merge pass per
+    iteration (``_stream_tier_iter``), fed the diverted cross rows."""
+
+    tiered = p.stream_tiered
+    if tiered:
+        p_lane = dataclasses.replace(
+            p,
+            models_present=tuple(
+                m for m in p.models_present if m not in STREAM_MODELS
+            ),
+            stream_tiered=False,
+            stream_clients=(),
+            stream_pcap=False,
+        )
+    else:
+        p_lane = p
 
     k = p.pops_per_iter
 
@@ -1854,7 +2474,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
     # DELIVERY inserts) that the CPU heap pops before later queue entries,
     # so they co-pop only same-instant PACKET prefixes (a packet pop
     # generates nothing that sorts before a same-time PACKET).
-    mp_r = set(p.models_present)
+    mp_r = set(p_lane.models_present)
     passive_ids = sorted(PASSIVE_MODELS & mp_r)
 
     def iter_body(s: LaneState) -> LaneState:
@@ -1870,7 +2490,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
         for _mid in passive_ids:
             passive_lane = passive_lane | (tb.model == _mid)
         allowed = passive_lane[:, None] | (same_t & (pkt_prefix | first_col))
-        if p.stream_present and p.stream_wide_pop:
+        if p_lane.stream_present and p_lane.stream_wide_pop:
             # Stream lanes may co-pop WITHIN-WINDOW queue prefixes beyond
             # the same-instant rule (distinct times included):
             # - PACKET pops touch only per-lane network state (dn bucket,
@@ -1897,7 +2517,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
             stream_lane = (tb.model == M_STREAM_CLIENT) | (
                 tb.model == M_STREAM_SERVER
             )
-            if p.stream_one_to_one:
+            if p_lane.stream_one_to_one:
                 stream_prefix = jnp.cumprod(
                     kind_cols != LOCAL, axis=1
                 ).astype(bool)
@@ -1918,9 +2538,9 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
             # without the stream tier there is no payload column at all
             # (dead carry costs per-iteration wall time); slots still see
             # zeros operands, which XLA folds
-            "phi": s.q_phi[:, :k] if p.stream_present
+            "phi": s.q_phi[:, :k] if p_lane.stream_present
             else jnp.zeros((p.n_lanes, k), dtype=jnp.int32),
-            "plo": s.q_plo[:, :k] if p.stream_present
+            "plo": s.q_plo[:, :k] if p_lane.stream_present
             else jnp.zeros((p.n_lanes, k), dtype=jnp.int32),
             "act": act,
         }
@@ -1936,7 +2556,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
         # host round-trip per decision (~100x slower iterations measured
         # on the mixed mesh) while compile tolerates the inlined body
         slot_dataflow = pure_dataflow and (
-            not p.stream_present or jax.default_backend() != "cpu"
+            not p_lane.stream_present or jax.default_backend() != "cpu"
         )
 
         def scan_body(carry, slot_cols):
@@ -1944,19 +2564,19 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
             if slot_dataflow:
                 # _process_slot is fully masked by `act`: unconditional
                 # masked work beats a control decision on the device
-                return _process_slot(p, tb, st, slot_cols, we_hi, we_lo)
+                return _process_slot(p_lane, tb, st, slot_cols, we_hi, we_lo)
 
             def live(st_):
-                return _process_slot(p, tb, st_, slot_cols, we_hi, we_lo)
+                return _process_slot(p_lane, tb, st_, slot_cols, we_hi, we_lo)
 
             def dead(st_):
                 nb = jnp.zeros(p.n_lanes, dtype=bool)
                 z64 = jnp.zeros(p.n_lanes, dtype=jnp.int64)
                 z32 = jnp.zeros(p.n_lanes, dtype=jnp.int32)
-                if p.stream_present:
+                if p_lane.stream_present:
                     from ..net import ltcp as _ltcp
 
-                    s2 = 2 * len(p.stream_clients)
+                    s2 = 2 * len(p_lane.stream_clients)
                     eb = jnp.zeros(s2, dtype=bool)
                     ei = jnp.zeros(s2, dtype=jnp.int32)
                     se = (eb, ei, ei, ei, ei, ei, ei)
@@ -1970,7 +2590,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                         b64 = jnp.zeros(bshape, dtype=jnp.int64)
                         srec = (eb, e64, e64, e64)
                         brec = (bo_b, b64, b64, b64)
-                        if p.stream_pcap:
+                        if p_lane.stream_pcap:
                             spc = (eb, e64, e64, e64)
                             bpc = (bo_b, b64, b64, b64)
                         else:
@@ -2012,7 +2632,15 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
         # made tiny parity runs hundreds of times slower.
         s, emits = scan_or_unroll(scan_body, s, slots, k)
 
-        if pure_dataflow:
+        if tiered:
+            # unconditional merge (the tier needs the diverted cross rows
+            # every iteration), then the [2S] stream tier's own pass
+            s, over_rec, tier_cross = _merge_append(
+                p_lane, tb, s, emits, divert=True
+            )
+            s = _append_log(p, s, over_rec)
+            s = _stream_tier_iter(p, tb, s, we_hi, we_lo, tier_cross)
+        elif pure_dataflow:
             # always merge: a merge whose insert channels are all empty
             # reduces to the row re-sort that restores the sorted
             # invariant, so one unconditional path replaces the cond
@@ -2027,7 +2655,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 | jnp.any(emits.arm_valid)
                 | jnp.any(emits.out_valid)
             )
-            if p.stream_present:
+            if p_lane.stream_present:
                 any_new = (
                     any_new
                     | jnp.any(emits.se_valid)
@@ -2040,7 +2668,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 return _append_log(p, st, over_rec)
 
             def do_sort(st: LaneState) -> LaneState:
-                return _sort_queues(st, with_pay=p.stream_present)
+                return _sort_queues(st, with_pay=p_lane.stream_present)
 
             s = lax.cond(any_new, do_merge, do_sort, s)
 
@@ -2070,7 +2698,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 "outcome": jnp.full((kk * p.n_lanes,), PCAP_TX,
                                     dtype=jnp.int64),
             })
-        if p.stream_present and p.stream_pcap and p.log_capacity:
+        if p_lane.stream_present and p_lane.stream_pcap and p.log_capacity:
             # stream outbound pcap captures (PCAP_TX at departure)
             kk, s2 = emits.spc_valid.shape
             s_flows = s2 // 2
@@ -2099,7 +2727,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 "outcome": jnp.full(
                     (kk * bb * s_flows,), PCAP_TX, dtype=jnp.int64),
             })
-        if p.stream_present and p.log_capacity:
+        if p_lane.stream_present and p.log_capacity:
             # stream loss records (DROP_LOSS at the send instant): slot-0
             # control sends [K, 2S] and burst data segments [K, B, S],
             # with lanes/peers from the static flow tables
@@ -2156,8 +2784,8 @@ def _build_round(p: LaneParams, tb: LaneTables):
     iter_body = _build_iter(p, tb)
 
     def round_fn(s: LaneState) -> tuple[LaneState, jnp.ndarray]:
-        # rows sorted: col 0 is each lane's min; lexicographic pair min
-        start = t_join(*pair_min_lanes(s.q_thi[:, 0], s.q_tlo[:, 0]))
+        # rows sorted: col 0 is each queue's min; lexicographic pair min
+        start = t_join(*_queue_min(p, s))
         done = start >= p.stop_time
         window_end = jnp.minimum(
             start + _effective_runahead(p, s), p.stop_time
@@ -2166,7 +2794,7 @@ def _build_round(p: LaneParams, tb: LaneTables):
         s = s._replace(now_we_hi=we_hi, now_we_lo=we_lo)
 
         def cond(st: LaneState):
-            mh, ml = pair_min_lanes(st.q_thi[:, 0], st.q_tlo[:, 0])
+            mh, ml = _queue_min(p, st)
             return pair_lt(mh, ml, st.now_we_hi, st.now_we_lo)
 
         def body(st: LaneState):
@@ -2267,12 +2895,11 @@ def _build_full_run(p: LaneParams, tb: LaneTables):
 
     def full_run(s: LaneState) -> LaneState:
         def cond(carry):
-            q = carry[0]
-            mh, ml = pair_min_lanes(q[0, :, 0], q[1, :, 0])
+            mh, ml = _queue_min(p, unpack_state(carry))
             return pair_lt(mh, ml, stop_hi, stop_lo)
 
         def step(st: LaneState):
-            mn_hi, mn_lo = pair_min_lanes(st.q_thi[:, 0], st.q_tlo[:, 0])
+            mn_hi, mn_lo = _queue_min(p, st)
             live = pair_lt(mn_hi, mn_lo, stop_hi, stop_lo)
             fresh = pair_ge(mn_hi, mn_lo, st.now_we_hi, st.now_we_lo) & live
             # clamp before adding runahead: min_next may be the NEVER pair
@@ -2422,7 +3049,7 @@ def _build_hybrid_run(p: LaneParams, tb: LaneTables):
 
         def cond(carry):
             st = unpack_state(carry)
-            mh, ml = pair_min_lanes(st.q_thi[:, 0], st.q_tlo[:, 0])
+            mh, ml = _queue_min(p, st)
             in_window = pair_lt(mh, ml, st.now_we_hi, st.now_we_lo)
             bh, bl = ext_bound(st, ext_hi, ext_lo)
             host_in_cur = pair_lt(bh, bl, st.now_we_hi, st.now_we_lo)
@@ -2433,7 +3060,7 @@ def _build_hybrid_run(p: LaneParams, tb: LaneTables):
 
         def body(carry):
             st = unpack_state(carry)
-            mn_hi, mn_lo = pair_min_lanes(st.q_thi[:, 0], st.q_tlo[:, 0])
+            mn_hi, mn_lo = _queue_min(p, st)
             bh, bl = ext_bound(st, ext_hi, ext_lo)
             # the GLOBAL min: host-side events participate in the window law
             mn_hi, mn_lo = pair_sel(
@@ -2455,7 +3082,7 @@ def _build_hybrid_run(p: LaneParams, tb: LaneTables):
             return pack_state(iter_fn(st))
 
         s = unpack_state(lax.while_loop(cond, body, pack_state(s)))
-        lane_min = t_join(*pair_min_lanes(s.q_thi[:, 0], s.q_tlo[:, 0]))
+        lane_min = t_join(*_queue_min(p, s))
         return s, lane_min
 
     return hybrid_run

@@ -777,3 +777,67 @@ def endpoint_split(f: FlowCols) -> StreamState:
     rows = _to_rows(f)
     s_flows = rows.shape[0] // 2
     return StreamState(cl=rows[:s_flows], sv=rows[s_flows:])
+
+
+# --------------------------------------------------------------------------
+# the TIERED stream backend (one-to-one configs): stream endpoints own a
+# dedicated [2S, C2] event-queue block plus COMPACT per-endpoint network
+# state, so the [N]-wide lane machinery carries no stream work at all.
+# Sound only in one-to-one mode: each endpoint lane hosts exactly one flow,
+# so its dn/up buckets, CoDel state, and per-host counters are in
+# bijection with endpoint rows.
+# --------------------------------------------------------------------------
+
+# row indices of the packed [TV_COUNT, 2S] int32 tier vector matrix
+(TV_DN_TOK, TV_DN_NRH, TV_DN_NRL, TV_DN_LDH, TV_DN_LDL,
+ TV_CD_FATH, TV_CD_FATL, TV_CD_DNH, TV_CD_DNL, TV_CD_CNT, TV_CD_DROP,
+ TV_UP_TOK, TV_UP_NRH, TV_UP_NRL, TV_UP_LDH, TV_UP_LDL,
+ TV_SEND_SEQ, TV_LOCAL_SEQ, TV_N_SENDS, TV_N_LOSS, TV_N_DEL, TV_N_CODEL,
+ TV_N_QUEUE) = range(23)
+TV_COUNT = 23
+
+
+class TierState(NamedTuple):
+    """Device state of the tiered stream backend, packed into THREE
+    arrays so the while-loop carry stays flat (the tunneled runtime pays
+    a per-buffer cost every iteration):
+
+    - ``flows``: the [S, F] endpoint law matrices (StreamState);
+    - ``q``: [7, 2S, C2] int32 — the endpoints' event queues as stacked
+      key/payload planes (thi, tlo, auxh, auxl, size, phi, plo), each
+      row kept sorted by the 4-word key exactly like the [N] queues;
+    - ``v``: [TV_COUNT, 2S] int32 — buckets, CoDel, and counters (the
+      TV_* rows above)."""
+
+    flows: StreamState
+    q: jnp.ndarray
+    v: jnp.ndarray
+
+
+(TQ_THI, TQ_TLO, TQ_AUXH, TQ_AUXL, TQ_SIZE, TQ_PHI, TQ_PLO) = range(7)
+
+
+def init_tier_state(
+    s_flows: int,
+    capacity: int,
+    dn_tokens,
+    up_tokens,
+    interval: int,
+) -> TierState:
+    """Fresh tier state.  ``dn_tokens``/``up_tokens`` are the [2S] initial
+    bucket fills (= burst) of each endpoint's lane; time-state starts at
+    the same values LaneState uses (next_refill = one interval in,
+    CoDel first_above = unset sentinel)."""
+    i32 = jnp.int32
+    s2 = 2 * s_flows
+    q = jnp.zeros((7, s2, capacity), dtype=i32)
+    q = q.at[TQ_THI].set(NEVER32)
+    q = q.at[TQ_TLO].set(NEVER32)
+    v = jnp.zeros((TV_COUNT, s2), dtype=i32)
+    v = v.at[TV_DN_TOK].set(jnp.asarray(dn_tokens, dtype=i32))
+    v = v.at[TV_UP_TOK].set(jnp.asarray(up_tokens, dtype=i32))
+    v = v.at[TV_DN_NRL].set(interval)
+    v = v.at[TV_UP_NRL].set(interval)
+    # CD_UNSET mirrors lanes.CD_UNSET (module split avoids the import cycle)
+    v = v.at[TV_CD_FATH].set(-(1 << 31) + 1)
+    return TierState(flows=init_stream_state(s_flows), q=q, v=v)

@@ -258,6 +258,16 @@ class TpuEngine:
         one_to_one = bool(client_ids.size) and all(
             peer_counts.get(sid, 0) == 1 for sid in server_ids
         ) and all(pid in server_ids for pid in peer_counts)
+        # TIERED stream backend: one-to-one flows move to a dedicated
+        # [2S]-row tier (docs/tpu-backend.md).  Hybrid (external) runs
+        # keep the older split-exchange path: host injections land in
+        # [N] rows, which the tier would orphan for stream lanes.
+        tiered = bool(
+            one_to_one
+            and cfg.experimental.tpu_stream_tiered
+            and not ext_mask.any()
+        )
+        self._tiered = tiered
 
         # wide stream co-pop is sound only when every possible lookahead
         # window ends before RTO_MIN (DELIVERY pops then provably insert
@@ -309,6 +319,9 @@ class TpuEngine:
                 ].any()
             ),
             cross_capacity=cfg.experimental.tpu_cross_capacity,
+            stream_tiered=tiered,
+            stream_pops=cfg.experimental.tpu_stream_events_per_round,
+            stream_capacity=cfg.experimental.tpu_stream_queue_capacity,
             external_any=bool(ext_mask.any()),
             # worst case: every external lane pops a full slot row of
             # packets in one iteration; the egress buffer keeps at least
@@ -462,15 +475,44 @@ class TpuEngine:
             lane_external=(
                 jnp.asarray(ext_mask) if ext_mask.any() else ()
             ),
+            flow_dn_rate=jnp.asarray(dn[el_np, 0], dtype=i32) if tiered else (),
+            flow_dn_burst=jnp.asarray(dn[el_np, 1], dtype=i32) if tiered else (),
+            flow_dn_kfull=jnp.asarray(dn_kfull[el_np]) if tiered else (),
+            flow_dn_kfi=jnp.asarray(dn_kfi[el_np]) if tiered else (),
+            lane_stream=(
+                jnp.asarray(np.isin(np.arange(n), el_np)) if tiered else ()
+            ),
         )
         self._init_events = init_events
         self._local_seq0 = local_seq0
+        self._el_np = el_np  # [2S] endpoint lanes (tiered routing/collect)
+        self._ep_of_lane = (
+            {int(l): r for r, l in enumerate(el_np)} if tiered else {}
+        )
+        self._dn_params = dn  # [N, 2] (rate, burst) — tier init needs bursts
+        self._up_params = up
         self._interval = lanes.DEFAULT_INTERVAL_NS
         # [window-agg] telemetry sink (step mode only; set by the facade)
         self.perf_log = None
 
     def _resolve(self, hostname: str, n: int) -> int:
         return self.dns.resolve(hostname)
+
+    def _next_event_np(self, state) -> int:
+        """Host-side earliest-event readback (step-mode telemetry):
+        queue rows are sorted, so column 0 is each queue's min — [N]
+        lanes plus the [2S] tier block when tiered."""
+        nxt = int(
+            np.asarray(
+                lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
+            ).min()
+        )
+        if self.params.stream_tiered:
+            tq = state.stream.q
+            nxt = min(nxt, int(np.asarray(lanes.t_join(
+                tq[lstr_mod.TQ_THI, :, 0], tq[lstr_mod.TQ_TLO, :, 0]
+            )).min()))
+        return nxt
 
     def current_runahead(self) -> int:
         """Live window width (dynamic runahead reads the device scalar;
@@ -497,7 +539,27 @@ class TpuEngine:
         q_auxl = np.zeros((n, c), dtype=np.int32)
         q_size = np.zeros((n, c), dtype=np.int32)
         fill = np.zeros(n, dtype=np.int64)
+        # tiered: stream endpoints' init events live in the tier queue
+        c2 = p.stream_capacity
+        s2 = 2 * self._s_flows
+        if p.stream_tiered:
+            tq_time = np.full((s2, c2), NEVER, dtype=np.int64)
+            tq_auxh = np.zeros((s2, c2), dtype=np.int32)
+            tq_auxl = np.zeros((s2, c2), dtype=np.int32)
+            tq_size = np.zeros((s2, c2), dtype=np.int32)
+            tfill = np.zeros(s2, dtype=np.int64)
         for lane, t, kind, src, seq, size in self._init_events:
+            row = self._ep_of_lane.get(lane)
+            if row is not None:
+                i = tfill[row]
+                tq_time[row, i] = t
+                tq_auxh[row, i] = (kind << lanes.AUX_KIND_SHIFT) | (
+                    src << lanes.AUX_SRC_SHIFT
+                )
+                tq_auxl[row, i] = seq
+                tq_size[row, i] = size
+                tfill[row] += 1
+                continue
             i = fill[lane]
             q_time[lane, i] = t
             q_auxh[lane, i] = (kind << lanes.AUX_KIND_SHIFT) | (
@@ -524,10 +586,42 @@ class TpuEngine:
         # while-loop carry pays a per-buffer cost every iteration on the
         # tunneled runtime, so dead zero arrays are real wall time.
         # Flow matrices are COMPACTED: [S, F] per endpoint side
-        stream0 = (
-            lstr_mod.init_stream_state(self._s_flows)
-            if p.stream_present else ()
-        )
+        if p.stream_tiered:
+            el = self._el_np
+            stream0 = lstr_mod.init_tier_state(
+                self._s_flows, c2,
+                dn_tokens=self._dn_params[el, 1],
+                up_tokens=self._up_params[el, 1],
+                interval=self._interval,
+            )
+            # establish the tier rows' sorted invariant + initial local
+            # seq counters (one start marker consumed per endpoint)
+            order = np.lexsort((tq_auxl, tq_auxh, tq_time), axis=1)
+            tq_time = np.take_along_axis(tq_time, order, axis=1)
+            tq_auxh = np.take_along_axis(tq_auxh, order, axis=1)
+            tq_auxl = np.take_along_axis(tq_auxl, order, axis=1)
+            tq_size = np.take_along_axis(tq_size, order, axis=1)
+            tnever = tq_time == NEVER
+            tq = np.zeros((7, s2, c2), dtype=np.int32)
+            tq[lstr_mod.TQ_THI] = np.where(
+                tnever, lanes.NEVER32, tq_time >> 31
+            )
+            tq[lstr_mod.TQ_TLO] = np.where(
+                tnever, lanes.NEVER32, tq_time & lanes.MASK31
+            )
+            tq[lstr_mod.TQ_AUXH] = tq_auxh
+            tq[lstr_mod.TQ_AUXL] = tq_auxl
+            tq[lstr_mod.TQ_SIZE] = tq_size
+            v0 = np.asarray(stream0.v)
+            v0 = v0.copy()
+            v0[lstr_mod.TV_LOCAL_SEQ] = self._local_seq0[self._el_np]
+            stream0 = stream0._replace(
+                q=jnp.asarray(tq), v=jnp.asarray(v0)
+            )
+        elif p.stream_present:
+            stream0 = lstr_mod.init_stream_state(self._s_flows)
+        else:
+            stream0 = ()
 
         up_burst = np.asarray(self.tables.up_burst)
         dn_burst = np.asarray(self.tables.dn_burst)
@@ -542,8 +636,14 @@ class TpuEngine:
             q_auxh=jnp.asarray(q_auxh),
             q_auxl=jnp.asarray(q_auxl),
             q_size=jnp.asarray(q_size),
-            q_phi=jnp.zeros((n, c), dtype=jnp.int32) if p.stream_present else (),
-            q_plo=jnp.zeros((n, c), dtype=jnp.int32) if p.stream_present else (),
+            q_phi=(
+                jnp.zeros((n, c), dtype=jnp.int32)
+                if p.lanes_have_payload else ()
+            ),
+            q_plo=(
+                jnp.zeros((n, c), dtype=jnp.int32)
+                if p.lanes_have_payload else ()
+            ),
             stream=stream0,
             send_seq=jnp.asarray(z32),
             local_seq=jnp.asarray(self._local_seq0, dtype=i32),
@@ -648,11 +748,18 @@ class TpuEngine:
                     lane_next = np.asarray(
                         lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
                     )
-                    start = int(lane_next.min())
+                    start = self._next_event_np(state)
                     we_pred = min(
                         start + self.current_runahead(), self.params.stop_time
                     )
                     active = int((lane_next < we_pred).sum())
+                    if self.params.stream_tiered:
+                        tq = state.stream.q
+                        tier_next = np.asarray(lanes.t_join(
+                            tq[lstr_mod.TQ_THI, :, 0],
+                            tq[lstr_mod.TQ_TLO, :, 0],
+                        ))
+                        active += int((tier_next < we_pred).sum())
                 state, done = round_fn(state)
                 if bool(done):
                     break
@@ -660,11 +767,7 @@ class TpuEngine:
                     window_end = int(
                         (int(state.now_we_hi) << 31) | int(state.now_we_lo)
                     )
-                    next_ev = int(
-                        np.asarray(
-                            lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
-                        ).min()
-                    )
+                    next_ev = self._next_event_np(state)
                     if self.perf_log is not None:
                         self.perf_log.window_agg(
                             active, start, window_end,
@@ -748,7 +851,24 @@ class TpuEngine:
                     f"lane counter {fname} wrapped past 2**31; this run "
                     "exceeds the lane backend's int32 counter range"
                 )
-        n_queue_drops = int(np.asarray(s.n_queue).sum())
+        # tiered stream backend: fold the [2S] tier's compact counters
+        # into the lane totals (the tier owns stream endpoints' network
+        # accounting)
+        tv = (
+            np.asarray(s.stream.v) if self.params.stream_tiered else None
+        )
+        if tv is not None and int(tv[lstr_mod.TV_SEND_SEQ].min(initial=0)) < 0:
+            raise RuntimeError(
+                "tier counter send_seq wrapped past 2**31; this run "
+                "exceeds the lane backend's int32 counter range"
+            )
+
+        def tier_sum(row: int) -> int:
+            return int(tv[row].sum()) if tv is not None else 0
+
+        n_queue_drops = int(np.asarray(s.n_queue).sum()) + tier_sum(
+            lstr_mod.TV_N_QUEUE
+        )
         if n_queue_drops and self.strict_capacity:
             raise RuntimeError(
                 f"{n_queue_drops} events dropped on lane-queue overflow; raise "
@@ -785,17 +905,23 @@ class TpuEngine:
         hops = np.asarray(s.n_hops)
         add("phold_hops", int(hops[model == lanes.M_PHOLD].sum()))
         add("lane_iters", int(s.iters) - getattr(self, "_iters_salt", 0))
-        add("lane_delivered", int(delivered.sum()))
-        add("lane_drop_loss", int(np.asarray(s.n_loss).sum()))
-        add("lane_drop_codel", int(np.asarray(s.n_codel).sum()))
-        add("lane_drop_queue", int(np.asarray(s.n_queue).sum()))
-        add("lane_sends", int(np.asarray(s.n_sends).sum()))
+        add("lane_delivered", int(delivered.sum()) + tier_sum(lstr_mod.TV_N_DEL))
+        add("lane_drop_loss", int(np.asarray(s.n_loss).sum())
+            + tier_sum(lstr_mod.TV_N_LOSS))
+        add("lane_drop_codel", int(np.asarray(s.n_codel).sum())
+            + tier_sum(lstr_mod.TV_N_CODEL))
+        add("lane_drop_queue", n_queue_drops)
+        add("lane_sends", int(np.asarray(s.n_sends).sum())
+            + tier_sum(lstr_mod.TV_N_SENDS))
 
         if self.params.stream_present:
             # compacted flow matrices: every cl row is a client endpoint,
             # every sv row its server endpoint
-            cl_m = np.asarray(s.stream.cl)
-            sv_m = np.asarray(s.stream.sv)
+            flows = (
+                s.stream.flows if self.params.stream_tiered else s.stream
+            )
+            cl_m = np.asarray(flows.cl)
+            sv_m = np.asarray(flows.sv)
             done = cl_m[:, lstr_mod.C_COMPLETED] != 0
             if done.any():
                 # tx/retransmit totals count at completion, like the CPU

@@ -105,6 +105,12 @@ class ExperimentalOptions:
     # is counted and strict mode raises, exactly like queue overflow
     tpu_cross_capacity: int = 0
     tpu_mesh_shape: Optional[tuple[int, ...]] = None  # None = all devices
+    # TIERED stream backend (one-to-one stream configs): stream endpoints
+    # run on a dedicated [2S]-row tier with their own queue block and pop
+    # rate, keeping the [N]-wide machinery stream-free (docs/tpu-backend.md)
+    tpu_stream_tiered: bool = True
+    tpu_stream_events_per_round: int = 8  # tier pops per iteration (K_s)
+    tpu_stream_queue_capacity: int = 64  # tier queue width (C2)
 
 
 @dataclasses.dataclass

@@ -170,6 +170,11 @@ class Simulation:
                     "perf-logging is not supported on the hybrid tpu "
                     "backend; running without it"
                 )
+            if self.cfg.experimental.tpu_mesh_shape is not None:
+                log.warning(
+                    "tpu_mesh_shape is not supported on the hybrid tpu "
+                    "backend; running single-device"
+                )
             engine = self.engine = HybridEngine(self.cfg)
             t0 = time.perf_counter()
             on_window = self._make_on_window(

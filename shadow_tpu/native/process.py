@@ -63,8 +63,8 @@ PREEMPT_QUANTUM_NS = 10_000_000  # 10 ms
 from errno import (  # noqa: E402
     EADDRINUSE, EAGAIN, EALREADY, EBADF, EBUSY, ECHILD, ECONNREFUSED,
     ECONNRESET, EDEADLK, EDESTADDRREQ, EHOSTUNREACH, EINPROGRESS, EINTR,
-    EINVAL, EISCONN, ENOSYS, ENOTCONN, ENOTSOCK, EOPNOTSUPP, EPERM,
-    EPIPE, ESRCH,
+    EINVAL, EISCONN, ENOENT, ENOSYS, ENOTCONN, ENOTSOCK, EOPNOTSUPP,
+    EPERM, EPIPE, ESRCH,
     ETIMEDOUT,
 )
 
@@ -1382,6 +1382,14 @@ class ManagedApp:
             return
         path = self.chan.req_payload().decode("utf-8", "surrogateescape")
         mask = int(req.args[1])
+        # kernel contract: a watch on a nonexistent path answers ENOENT
+        # (the reference fork's stub always said wd=1; apps that probe
+        # for missing paths see the real errno here).  Absolute paths
+        # only: relative ones resolve against the CHILD's cwd, which the
+        # shim does not virtualize — keep the permissive stub for those
+        if path.startswith("/") and not os.path.lexists(path):
+            self._reply(api, "inotify-add", -ENOENT)
+            return
         wd = sock.next_wd
         sock.next_wd += 1
         sock.watches[wd] = (path, mask)
