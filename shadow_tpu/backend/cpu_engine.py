@@ -280,6 +280,8 @@ class CpuEngine:
             host = self.hosts[hid]
             for p in hopt.processes:
                 app = create_model(p.path, list(p.args), dict(p.environment))
+                if hasattr(app, "set_congestion"):
+                    app.set_congestion(hopt.congestion)
                 host.apps.append(app)
                 host.push_local(
                     p.start_time, Task(lambda h, a=app: _start_app(h, a), label="start")

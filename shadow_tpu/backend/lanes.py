@@ -388,6 +388,7 @@ class LaneTables(NamedTuple):
     flow_segs: jnp.ndarray  # [2S] int32 (zeros on the server half)
     flow_mss: jnp.ndarray  # [2S] int32
     flow_last: jnp.ndarray  # [2S] int32
+    flow_cc: jnp.ndarray  # [2S] int32 CC algorithm (ltcp.CC_RENO/CC_CUBIC)
     flow_up_rate: jnp.ndarray  # [2S] int32: the endpoint lane's up bucket
     flow_up_burst: jnp.ndarray  # [2S] int32
     flow_up_kfull: jnp.ndarray  # [2S] int32
@@ -929,7 +930,7 @@ def _process_slot(
         )
         stream_stim = stim_open | stim_rto | stim_seg
         f = lstr.endpoint_cols(
-            s.stream, tb.flow_segs, tb.flow_mss, tb.flow_last
+            s.stream, tb.flow_segs, tb.flow_mss, tb.flow_last, tb.flow_cc
         )
         f1, em1 = lstr.open_flow_vec(f, ethi, etlo, stim_open)
         f = lstr._merge_cols(f, f1, stim_open)
@@ -1955,7 +1956,7 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
     q = q.at[lstr.TQ_TLO, :, :k].set(jnp.where(act_b, NEVER32, tlo_b))
 
     f = lstr.endpoint_cols(
-        ts.flows, tb.flow_segs, tb.flow_mss, tb.flow_last
+        ts.flows, tb.flow_segs, tb.flow_mss, tb.flow_last, tb.flow_cc
     )
     mul = s.min_used_lat
     log_on = bool(p.log_capacity)

@@ -77,8 +77,9 @@ def unpack_pay(w0, w1):
  C_IN_REC, C_RECOVER, C_MAX_SENT, C_RTT_SEQ,
  C_SRTT_HI, C_SRTT_LO, C_RTTVAR_HI, C_RTTVAR_LO, C_RTO_HI, C_RTO_LO,
  C_RTT_TS_HI, C_RTT_TS_LO, C_RTODL_HI, C_RTODL_LO, C_RTOEV_HI, C_RTOEV_LO,
- C_TX_SEGS, C_RETRANS, C_COMPLETED, C_RX_SEGS, C_RX_BYTES) = range(28)
-N_COLS = 28
+ C_TX_SEGS, C_RETRANS, C_COMPLETED, C_RX_SEGS, C_RX_BYTES,
+ C_WMAX, C_ORIGIN, C_EPOCH_HI, C_EPOCH_LO, C_KQ) = range(33)
+N_COLS = 33
 
 
 class StreamState(NamedTuple):
@@ -102,6 +103,8 @@ def _fresh_matrix(n: int) -> jnp.ndarray:
     m = m.at[:, C_RTODL_LO].set(NEVER32)
     m = m.at[:, C_RTOEV_HI].set(NEVER32)
     m = m.at[:, C_RTOEV_LO].set(NEVER32)
+    m = m.at[:, C_EPOCH_HI].set(NEVER32)
+    m = m.at[:, C_EPOCH_LO].set(NEVER32)
     return m
 
 
@@ -142,10 +145,17 @@ class FlowCols(NamedTuple):
     completed: jnp.ndarray  # bool
     rx_segs: jnp.ndarray
     rx_bytes: jnp.ndarray
+    # CUBIC state (inert under CC_RENO)
+    w_max_fp: jnp.ndarray
+    cub_origin_fp: jnp.ndarray
+    cub_epoch_hi: jnp.ndarray  # pair (NEVER32 = no epoch yet)
+    cub_epoch_lo: jnp.ndarray
+    cub_k_q: jnp.ndarray
     role: jnp.ndarray  # SENDER / RECEIVER
     segs: jnp.ndarray  # transfer shape (client flows; 0 for server role)
     mss: jnp.ndarray
     last_bytes: jnp.ndarray
+    cc: jnp.ndarray  # static per flow: ltcp.CC_RENO / CC_CUBIC
 
 
 _MATRIX_FIELDS = (
@@ -161,6 +171,9 @@ _MATRIX_FIELDS = (
     ("rtoev_hi", C_RTOEV_HI), ("rtoev_lo", C_RTOEV_LO),
     ("tx_segs", C_TX_SEGS), ("retransmits", C_RETRANS),
     ("rx_segs", C_RX_SEGS), ("rx_bytes", C_RX_BYTES),
+    ("w_max_fp", C_WMAX), ("cub_origin_fp", C_ORIGIN),
+    ("cub_epoch_hi", C_EPOCH_HI), ("cub_epoch_lo", C_EPOCH_LO),
+    ("cub_k_q", C_KQ),
 )
 _BOOL_FIELDS = (("in_rec", C_IN_REC), ("completed", C_COMPLETED))
 
@@ -214,6 +227,102 @@ def _seg_flags(f: FlowCols, unit):
 
 def _flight(f: FlowCols):
     return f.snd_nxt - f.snd_una
+
+
+def _icbrt32_vec(x):
+    """Vector twin of ltcp.icbrt32 — the identical 11-iteration bitwise
+    floor-cbrt, unrolled.  ``b << s`` may wrap int32 in lanes where the
+    take branch is false; those lanes discard the value (when taken,
+    b << s <= x < 2**31, so no wrap)."""
+    y = jnp.zeros_like(x)
+    for s in range(30, -1, -3):
+        y = y + y
+        b = 3 * y * (y + 1) + 1
+        take = (x >> s) >= b
+        x = jnp.where(take, x - (b << s), x)
+        y = jnp.where(take, y + 1, y)
+    return y
+
+
+def _cc_on_loss(f: FlowCols, m) -> FlowCols:
+    """ltcp.cc_on_loss under mask ``m``: per-algorithm ssthresh; CUBIC
+    records W_max (fast convergence) and resets its epoch."""
+    cub = m & (f.cc == ltcp.CC_CUBIC)
+    ren = m & ~cub
+    # flight <= MAX window segs (law invariant): the product fits int32
+    fl_fp = jnp.minimum(_flight(f), 1 << 15) * ltcp.FP
+    new_wmax = jnp.where(
+        f.cwnd_fp < f.w_max_fp,
+        (f.cwnd_fp * ltcp.CUBIC_FC_MUL) >> 10,
+        f.cwnd_fp,
+    )
+    return f._replace(
+        w_max_fp=jnp.where(cub, new_wmax, f.w_max_fp),
+        cub_epoch_hi=jnp.where(cub, NEVER32, f.cub_epoch_hi),
+        cub_epoch_lo=jnp.where(cub, NEVER32, f.cub_epoch_lo),
+        ssthresh_fp=jnp.where(
+            cub,
+            jnp.maximum(
+                (f.cwnd_fp * ltcp.CUBIC_BETA_MUL) >> 10, ltcp.MIN_SSTHRESH_FP
+            ),
+            jnp.where(
+                ren,
+                jnp.maximum(fl_fp // 2, ltcp.MIN_SSTHRESH_FP),
+                f.ssthresh_fp,
+            ),
+        ),
+    )
+
+
+def _cc_grow_ca(f: FlowCols, nh, nl, m) -> FlowCols:
+    """ltcp.cc_grow_ca under mask ``m`` (congestion-avoidance growth for
+    one new ACK); no MAX_CWND clamp here — the caller clamps, exactly
+    like the scalar flow."""
+    cub = m & (f.cc == ltcp.CC_CUBIC)
+    # epoch start on the first CA ACK after a loss (or ever)
+    start = cub & (f.cub_epoch_hi == NEVER32)
+    below = f.cwnd_fp < f.w_max_fp
+    k_new = jnp.where(
+        below,
+        4 * _icbrt32_vec((f.w_max_fp - f.cwnd_fp) * ltcp.CUBIC_K_MUL),
+        0,
+    )
+    f = f._replace(
+        cub_epoch_hi=jnp.where(start, nh, f.cub_epoch_hi),
+        cub_epoch_lo=jnp.where(start, nl, f.cub_epoch_lo),
+        cub_origin_fp=jnp.where(
+            start, jnp.where(below, f.w_max_fp, f.cwnd_fp), f.cub_origin_fp
+        ),
+        cub_k_q=jnp.where(start, k_new, f.cub_k_q),
+    )
+    # d_q = min((now - epoch) >> 20, D_MAX) on pairs: value = hi*2**31+lo,
+    # so >> 20 is hi*2**11 + (lo >> 20); hi is pre-clamped so the shift
+    # cannot wrap (any clamped case is >= D_MAX anyway)
+    dh, dl = lp.pair_sub_pair(nh, nl, f.cub_epoch_hi, f.cub_epoch_lo)
+    d_q = jnp.minimum(
+        jnp.minimum(dh, 1 << 19) * (1 << 11) + (dl >> 20), ltcp.CUBIC_D_MAX
+    )
+    offs = d_q - f.cub_k_q
+    neg = offs < 0
+    offs = jnp.minimum(jnp.abs(offs), ltcp.CUBIC_D_MAX)
+    delta_fp = (
+        ((((offs * offs) >> 10) * offs) >> 10) * ltcp.CUBIC_C_MUL
+    ) >> 10
+    target_fp = jnp.where(
+        neg, f.cub_origin_fp - delta_fp, f.cub_origin_fp + delta_fp
+    )
+    cwnd_safe = jnp.maximum(f.cwnd_fp, 1)
+    cub_grow = jnp.where(
+        target_fp > f.cwnd_fp,
+        jnp.maximum(1, (target_fp - f.cwnd_fp) * ltcp.FP // cwnd_safe),
+        jnp.maximum(1, (ltcp.FP * ltcp.FP) // (100 * cwnd_safe)),
+    )
+    ren_grow = jnp.maximum(1, (ltcp.FP * ltcp.FP) // cwnd_safe)
+    return f._replace(
+        cwnd_fp=jnp.where(
+            m, f.cwnd_fp + jnp.where(cub, cub_grow, ren_grow), f.cwnd_fp
+        )
+    )
 
 
 # NOTE: the scalar law's per-unit send gate (ltcp._can_send_new) has no
@@ -469,16 +578,12 @@ def on_rto_vec(f: FlowCols, nh, nl, m) -> tuple[FlowCols, StreamEmit]:
         rto_tlo=jnp.where(rearm, f.rtodl_lo, em.rto_tlo),
     )
     fire = m & ~rearm
-    # flight <= MAX window segs (law invariant): the product fits int32
-    fl_fp = jnp.minimum(_flight(f), 1 << 15) * ltcp.FP
     r2h, r2l = lp.pair_mul_small(f.rto_hi, f.rto_lo, 2)
     over = lp.pair_lt(_RTO_MAX_P[0], _RTO_MAX_P[1], r2h, r2l)
     r2h = jnp.where(over, _RTO_MAX_P[0], r2h)
     r2l = jnp.where(over, _RTO_MAX_P[1], r2l)
+    f = _cc_on_loss(f, fire)
     f = f._replace(
-        ssthresh_fp=jnp.where(
-            fire, jnp.maximum(fl_fp // 2, ltcp.MIN_SSTHRESH_FP), f.ssthresh_fp
-        ),
         cwnd_fp=jnp.where(fire, ltcp.FP, f.cwnd_fp),
         dup_acks=jnp.where(fire, 0, f.dup_acks),
         in_rec=jnp.where(fire, False, f.in_rec),
@@ -577,21 +682,13 @@ def on_segment_vec(
     ca = growth & ~ss
     f = f._replace(
         dup_acks=jnp.where(growth, 0, f.dup_acks),
-        cwnd_fp=jnp.minimum(
-            jnp.where(
-                ss,
-                f.cwnd_fp + acked * ltcp.FP,
-                jnp.where(
-                    ca,
-                    f.cwnd_fp
-                    + jnp.maximum(
-                        1, (ltcp.FP * ltcp.FP) // jnp.maximum(f.cwnd_fp, 1)
-                    ),
-                    f.cwnd_fp,
-                ),
-            ),
-            ltcp.MAX_CWND_FP,
-        ),
+        cwnd_fp=jnp.where(ss, f.cwnd_fp + acked * ltcp.FP, f.cwnd_fp),
+    )
+    f = _cc_grow_ca(f, nh, nl, ca)
+    f = f._replace(
+        cwnd_fp=jnp.where(
+            growth, jnp.minimum(f.cwnd_fp, ltcp.MAX_CWND_FP), f.cwnd_fp
+        )
     )
     rtt_m = new_ack & (f.rtt_seq >= 0) & (ack > f.rtt_seq)
     f = _rtt_sample(f, nh, nl, rtt_m)
@@ -620,14 +717,11 @@ def on_segment_vec(
     count = dup & ~f.in_rec
     f = f._replace(dup_acks=jnp.where(count, f.dup_acks + 1, f.dup_acks))
     fr = count & (f.dup_acks == ltcp.DUP_THRESH)
-    fl_fp = jnp.minimum(_flight(f), 1 << 15) * ltcp.FP
     f = f._replace(
         in_rec=jnp.where(fr, True, f.in_rec),
         recover=jnp.where(fr, f.snd_nxt, f.recover),
-        ssthresh_fp=jnp.where(
-            fr, jnp.maximum(fl_fp // 2, ltcp.MIN_SSTHRESH_FP), f.ssthresh_fp
-        ),
     )
+    f = _cc_on_loss(f, fr)
     f = f._replace(
         cwnd_fp=jnp.where(
             fr, f.ssthresh_fp + ltcp.DUP_THRESH * ltcp.FP, f.cwnd_fp
@@ -737,7 +831,7 @@ def _merge_emit(a: StreamEmit, b: StreamEmit, m) -> StreamEmit:
     ])
 
 
-def endpoint_cols(st: StreamState, flow_segs, flow_mss, flow_last):
+def endpoint_cols(st: StreamState, flow_segs, flow_mss, flow_last, flow_cc):
     """The COMPACTED [2S] FlowCols view of the flow matrices: rows
     0..S-1 are the S client endpoints, rows S..2S-1 the matching server
     endpoints (flow slot order).  No per-lane gather/scatter exists any
@@ -759,6 +853,7 @@ def endpoint_cols(st: StreamState, flow_segs, flow_mss, flow_last):
     vals["segs"] = flow_segs
     vals["mss"] = flow_mss
     vals["last_bytes"] = flow_last
+    vals["cc"] = flow_cc
     return FlowCols(**vals)
 
 

@@ -99,6 +99,7 @@ class TpuEngine:
         st_segs = np.zeros(n, dtype=np.int32)
         st_mss = np.zeros(n, dtype=np.int32)
         st_last = np.zeros(n, dtype=np.int32)
+        st_cc = np.zeros(n, dtype=np.int32)
         init_events: list[tuple[int, int, int, int, int, int]] = []  # lane,t,kind,src,seq,size
         local_seq0 = np.ones(n, dtype=np.int64)
 
@@ -137,6 +138,9 @@ class TpuEngine:
                 (p, create_model(p.path, list(p.args)))
                 for p in hopt.processes
             ]
+            for _, a in apps:
+                if hasattr(a, "set_congestion"):
+                    a.set_congestion(hopt.congestion)
             if len(apps) > 1:
                 # MULTI-PROCESS hosts: supported for tgen mesh/client/
                 # server combinations with at most one timer-driving
@@ -204,6 +208,7 @@ class TpuEngine:
                     )
                 st_segs[hid], st_last[hid] = app.fs.segs, app.fs.last_bytes
                 st_mss[hid] = app.mss
+                st_cc[hid] = app.fs.cc
                 init_events.append((hid, t0, lanes.LOCAL, hid, 0, -1))
             elif isinstance(app, StreamServer):
                 model[hid] = lanes.M_STREAM_SERVER
@@ -423,12 +428,19 @@ class TpuEngine:
             flow_last = np.concatenate(
                 [st_last[fcl], np.zeros(s_flows, dtype=np.int32)]
             )
+            # CC follows the data sender (the client host's congestion
+            # option); receiver endpoints stay CC_RENO like the scalar
+            # StreamServer's default-constructed FlowState
+            flow_cc = np.concatenate(
+                [st_cc[fcl], np.zeros(s_flows, dtype=np.int32)]
+            )
             flow_clid = np.concatenate([fcl, fcl])
         else:
             el_np = peer_np = np.zeros(2, dtype=np.int32)
             flow_lat = np.zeros(2, dtype=np.int32)
             flow_thr = np.zeros(2, dtype=np.int64)
             flow_segs = flow_mss = flow_last = np.zeros(2, dtype=np.int32)
+            flow_cc = np.zeros(2, dtype=np.int32)
             flow_clid = np.zeros(2, dtype=np.int32)
 
         self.tables = lanes.LaneTables(
@@ -466,6 +478,7 @@ class TpuEngine:
             flow_segs=jnp.asarray(flow_segs, dtype=i32),
             flow_mss=jnp.asarray(flow_mss, dtype=i32),
             flow_last=jnp.asarray(flow_last, dtype=i32),
+            flow_cc=jnp.asarray(flow_cc, dtype=i32),
             flow_up_rate=jnp.asarray(up[el_np, 0], dtype=i32),
             flow_up_burst=jnp.asarray(up[el_np, 1], dtype=i32),
             flow_up_kfull=jnp.asarray(up_kfull[el_np]),

@@ -135,6 +135,11 @@ class HostOptions:
     log_level: Optional[str] = None
     pcap_enabled: bool = False
     pcap_capture_size: int = 65535
+    # TCP congestion-control algorithm for this host's flows (the
+    # reference's pluggable tcp_cong.c interface: tcp_cong_reno.c and the
+    # CUBIC analog here); applies to both the byte-stream stack and the
+    # lane/ltcp stream tier (data-sender side)
+    congestion: str = "reno"  # "reno" | "cubic"
     count: int = 1  # convenience host multiplier (hostname gets a suffix)
 
 
@@ -322,6 +327,12 @@ class ConfigOptions:
         names = [h.hostname for h in self.hosts]
         if len(set(names)) != len(names):
             raise ConfigError("duplicate hostnames")
+        for h in self.hosts:
+            if h.congestion not in ("reno", "cubic"):
+                raise ConfigError(
+                    f"host {h.hostname!r}: congestion must be reno|cubic, "
+                    f"got {h.congestion!r}"
+                )
 
 
 def _require(doc: dict[str, Any], key: str, section: str) -> Any:
@@ -406,6 +417,7 @@ def _parse_host(name: str, doc: dict[str, Any]) -> HostOptions:
         log_level=doc.pop("log_level", None),
         pcap_enabled=bool(doc.pop("pcap_enabled", False)),
         pcap_capture_size=units.parse_bytes(doc.pop("pcap_capture_size", 65535)),
+        congestion=str(doc.pop("congestion", "reno")),
         count=1,
     )
     if doc:

@@ -92,6 +92,12 @@ class StreamClient(_FlowDriver):
             mss=int(kv.pop("mss", 1448)),
         )
 
+    def set_congestion(self, name: str) -> None:
+        """Engine hook: the host's ``congestion`` option selects this
+        flow's algorithm (CC follows the data sender; the server end's
+        receiver role never grows a window)."""
+        self.fs.cc = ltcp.CC_BY_NAME[name]
+
     def on_start(self, api: HostApi) -> None:
         self._peer = api.resolve(self.server)
         # conn id = this process's index on its host: two stream-clients on

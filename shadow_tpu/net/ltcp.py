@@ -68,6 +68,28 @@ INIT_CWND_FP = 10 * FP  # RFC 6928 initial window, segment units
 INIT_SSTHRESH_FP = 1 << 30
 MIN_SSTHRESH_FP = 2 * FP
 DUP_THRESH = 3
+
+# -- congestion control algorithms (tcp_cong.c's pluggable interface,
+# realized as a per-flow selector so the vector form stays branch-free) -----
+CC_RENO = 0
+CC_CUBIC = 1
+CC_BY_NAME = {"reno": CC_RENO, "cubic": CC_CUBIC}
+
+# CUBIC (RFC 9438 / tcp_cubic.c) as pure int32-safe fixed point.  The
+# window law is W(t) = C*(t-K)^3 + W_origin with C = 0.4 segs/s^3 and
+# beta = 0.3.  Time is measured in "q units" of 2**20 ns (~1.05 ms) and a
+# second is approximated as 2**30 ns (a documented 7.4% stretch: the law
+# is DEFINED by this fixed-point algorithm, identically in the scalar and
+# vector twins, not by real-valued CUBIC):
+CUBIC_BETA_MUL = 717  # ~0.70 * 1024: multiplicative decrease on loss
+CUBIC_FC_MUL = 870  # ~0.85 * 1024 = (2-beta)/2: fast-convergence shrink
+CUBIC_C_MUL = 410  # ~0.40 * 1024: the C coefficient of the cubic term
+# K in q units satisfies K_q^3 = diff_fp * 2**20 / 0.4 = diff_fp * 64*40960,
+# so K_q = 4 * icbrt32(diff_fp * 40960); diff_fp <= MAX_CWND_FP keeps the
+# argument inside int32 (49152 * 40960 < 2**31)
+CUBIC_K_MUL = 40960
+CUBIC_D_MAX = 8192  # epoch-age clamp, q units (~8.8 s; window saturates
+# far earlier: the cubic term at D_MAX is ~205 segments)
 # Constant advertised receive window.  Sized so one full flight (plus
 # cross-traffic and timer arms) fits the lane backend's default bounded
 # queue capacity with headroom: every in-flight segment is a resident
@@ -106,9 +128,15 @@ class FlowState:
     snd_nxt: int = 0
     rcv_nxt: int = 0
     # congestion control
+    cc: int = CC_RENO  # CC_RENO | CC_CUBIC (static per flow)
     cwnd_fp: int = INIT_CWND_FP
     ssthresh_fp: int = INIT_SSTHRESH_FP
     dup_acks: int = 0
+    # CUBIC state (inert under CC_RENO)
+    w_max_fp: int = 0  # window size at the last loss event
+    cub_origin_fp: int = 0  # the epoch's plateau (W_origin)
+    cub_epoch: int = NEVER  # epoch start, ns (NEVER = no epoch yet)
+    cub_k_q: int = 0  # K in q units (2**20 ns)
     in_rec: bool = False  # fast recovery (until ack >= recover)
     recover: int = 0  # snd_nxt at loss detection
     max_sent: int = 0  # highest unit ever transmitted + 1 (retransmit marker)
@@ -165,6 +193,68 @@ def seg_flags(fs: FlowState, unit: int) -> int:
     if fs.role == SENDER and 1 <= unit <= fs.segs:
         return F_DATA | F_ACK
     return F_FIN | F_ACK  # sender unit segs+1, receiver unit 1
+
+
+def icbrt32(x: int) -> int:
+    """floor(cbrt(x)) for 0 <= x < 2**31 by the classic bitwise method —
+    11 fixed iterations; the vector twin (lanes_stream._icbrt32_vec)
+    unrolls the identical loop."""
+    y = 0
+    for s in range(30, -1, -3):
+        y += y
+        b = 3 * y * (y + 1) + 1
+        if (x >> s) >= b:
+            x -= b << s
+            y += 1
+    return y
+
+
+def cc_on_loss(fs: FlowState) -> None:
+    """Multiplicative decrease at loss detection (fast-retransmit entry
+    and RTO): set ssthresh by the flow's algorithm; CUBIC additionally
+    records W_max (with fast convergence) and resets its epoch."""
+    if fs.cc == CC_CUBIC:
+        if fs.cwnd_fp < fs.w_max_fp:  # fast convergence
+            fs.w_max_fp = (fs.cwnd_fp * CUBIC_FC_MUL) >> 10
+        else:
+            fs.w_max_fp = fs.cwnd_fp
+        fs.cub_epoch = NEVER
+        fs.ssthresh_fp = max(
+            (fs.cwnd_fp * CUBIC_BETA_MUL) >> 10, MIN_SSTHRESH_FP
+        )
+    else:
+        fs.ssthresh_fp = max(flight(fs) * FP // 2, MIN_SSTHRESH_FP)
+
+
+def cc_grow_ca(fs: FlowState, now: int) -> None:
+    """Congestion-avoidance growth for one new ACK (cwnd >= ssthresh).
+    Reno: +1/cwnd per ACK.  CUBIC: advance toward the cubic target."""
+    if fs.cc != CC_CUBIC:
+        fs.cwnd_fp += max(1, (FP * FP) // fs.cwnd_fp)
+        return
+    if fs.cub_epoch == NEVER:  # new epoch starts at the first CA ACK
+        fs.cub_epoch = now
+        if fs.cwnd_fp < fs.w_max_fp:
+            fs.cub_origin_fp = fs.w_max_fp
+            fs.cub_k_q = 4 * icbrt32((fs.w_max_fp - fs.cwnd_fp) * CUBIC_K_MUL)
+        else:
+            fs.cub_origin_fp = fs.cwnd_fp
+            fs.cub_k_q = 0
+    d_q = min((now - fs.cub_epoch) >> 20, CUBIC_D_MAX)
+    offs = d_q - fs.cub_k_q
+    neg = offs < 0
+    if neg:
+        offs = -offs
+    if offs > CUBIC_D_MAX:
+        offs = CUBIC_D_MAX
+    delta_fp = (((((offs * offs) >> 10) * offs) >> 10) * CUBIC_C_MUL) >> 10
+    target_fp = (
+        fs.cub_origin_fp - delta_fp if neg else fs.cub_origin_fp + delta_fp
+    )
+    if target_fp > fs.cwnd_fp:
+        fs.cwnd_fp += max(1, (target_fp - fs.cwnd_fp) * FP // fs.cwnd_fp)
+    else:  # at/above the curve: minimal probing growth (~1%/ACK)
+        fs.cwnd_fp += max(1, (FP * FP) // (100 * fs.cwnd_fp))
 
 
 def cwnd_segs(fs: FlowState) -> int:
@@ -317,8 +407,7 @@ def _on_rto_inner(fs: FlowState, now: int) -> Emit:
         em.arm_rto = fs.rto_deadline
         return em
     # timeout: collapse the window, back off, go-back-N from the hole
-    fl_fp = flight(fs) * FP
-    fs.ssthresh_fp = max(fl_fp // 2, MIN_SSTHRESH_FP)
+    cc_on_loss(fs)
     fs.cwnd_fp = FP
     fs.dup_acks = 0
     fs.in_rec = False
@@ -392,8 +481,8 @@ def _on_segment_inner(
                 fs.dup_acks = 0
                 if fs.cwnd_fp < fs.ssthresh_fp:  # slow start (byte counting)
                     fs.cwnd_fp += acked * FP
-                else:  # congestion avoidance, +1/cwnd per ACK
-                    fs.cwnd_fp += max(1, (FP * FP) // fs.cwnd_fp)
+                else:  # congestion avoidance (per-algorithm growth)
+                    cc_grow_ca(fs, now)
                 fs.cwnd_fp = min(fs.cwnd_fp, MAX_CWND_FP)
             if fs.rtt_seq >= 0 and ack > fs.rtt_seq:
                 _rtt_sample(fs, now)
@@ -411,7 +500,7 @@ def _on_segment_inner(
                 if fs.dup_acks == DUP_THRESH:
                     fs.in_rec = True
                     fs.recover = fs.snd_nxt
-                    fs.ssthresh_fp = max(flight(fs) * FP // 2, MIN_SSTHRESH_FP)
+                    cc_on_loss(fs)
                     fs.cwnd_fp = fs.ssthresh_fp + DUP_THRESH * FP
                     _pull_back(fs, now, em)
 

@@ -182,10 +182,11 @@ class HostNetStack:
         return lst
 
     def _default_config(self) -> TcpConfig:
-        exp = self.host.engine.cfg.experimental
+        cfg = self.host.engine.cfg
         return TcpConfig(
-            send_buffer=exp.socket_send_buffer,
-            recv_buffer=exp.socket_recv_buffer,
+            send_buffer=cfg.experimental.socket_send_buffer,
+            recv_buffer=cfg.experimental.socket_recv_buffer,
+            congestion=cfg.hosts[self.host.host_id].congestion,
         )
 
     # -- inbound demux (interface.rs association lookup order) -------------

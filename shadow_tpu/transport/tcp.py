@@ -42,6 +42,8 @@ import dataclasses
 import enum
 from typing import Optional
 
+from ..net import ltcp
+
 SEQ_MASK = 0xFFFFFFFF
 NANOS_PER_SEC = 1_000_000_000
 
@@ -186,6 +188,115 @@ class TcpConfig:
     time_wait: int = 60 * NANOS_PER_SEC  # 2*MSL
     init_cwnd_segments: int = 10  # Linux IW10
     sack: bool = True  # RFC 2018/6675 selective acknowledgment
+    congestion: str = "reno"  # "reno" | "cubic" (tcp_cong.c's registry)
+
+
+def _icbrt(x: int) -> int:
+    """floor(cbrt(x)) for arbitrary non-negative Python ints (Newton)."""
+    if x <= 0:
+        return 0
+    y = 1 << ((x.bit_length() + 2) // 3)
+    while True:
+        y2 = (2 * y + x // (y * y)) // 3
+        if y2 >= y:
+            while y * y * y > x:
+                y -= 1
+            return y
+        y = y2
+
+
+class CongestionControl:
+    """The pluggable congestion-control operations of the reference's
+    tcp_cong.c (tcp_cong_reno.c is one registered instance), byte units.
+    ``grow_ca`` advances cwnd for one new ACK in congestion avoidance;
+    ``on_loss`` sets ssthresh at loss detection (fast-retransmit entry
+    and RTO) and updates any algorithm state."""
+
+    name = "?"
+
+    def grow_ca(self, tcp: "TcpState", now: int) -> None:
+        raise NotImplementedError
+
+    def on_loss(self, tcp: "TcpState", now: int) -> None:
+        raise NotImplementedError
+
+
+class RenoCC(CongestionControl):
+    """NewReno (tcp_cong_reno.c): AIMD, +mss²/cwnd per ACK, halve on loss."""
+
+    name = "reno"
+
+    def grow_ca(self, tcp: "TcpState", now: int) -> None:
+        mss = tcp.cfg.mss
+        tcp.cwnd += max(mss * mss // max(tcp.cwnd, 1), 1)
+
+    def on_loss(self, tcp: "TcpState", now: int) -> None:
+        tcp.ssthresh = max(tcp._outstanding() // 2, 2 * tcp.cfg.mss)
+
+
+class CubicCC(CongestionControl):
+    """CUBIC (RFC 9438) in bytes with the same fixed-point time algebra
+    as the lane tier's law (net/ltcp.py, whose CUBIC_* constants this
+    class shares): q units of 2**20 ns, a second approximated as 2**30
+    ns, C = CUBIC_C_MUL/1024, beta = 0.3.  Scalar-only stack on plain
+    Python ints, so — unlike the int32 lane twin — no epoch/offset
+    clamps: windows here are bounded by buffers, not by RWND_SEGS, and
+    the unclamped cubic must keep advancing for arbitrarily large
+    W_max - cwnd gaps and epoch ages."""
+
+    name = "cubic"
+
+    def __init__(self) -> None:
+        self.w_max = 0  # bytes
+        self.epoch: Optional[int] = None  # ns
+        self.origin = 0  # bytes
+        self.k_q = 0
+
+    def grow_ca(self, tcp: "TcpState", now: int) -> None:
+        mss = tcp.cfg.mss
+        if self.epoch is None:
+            self.epoch = now
+            if tcp.cwnd < self.w_max:
+                self.origin = self.w_max
+                # K_q^3 = (w_max - cwnd)/mss / 0.4 * 2**30  (exact 2.5x)
+                self.k_q = _icbrt(
+                    (self.w_max - tcp.cwnd) * 5 * (1 << 30) // (2 * mss)
+                )
+            else:
+                self.origin = tcp.cwnd
+                self.k_q = 0
+        d_q = (now - self.epoch) >> 20
+        offs = d_q - self.k_q
+        neg = offs < 0
+        if neg:
+            offs = -offs
+        # delta bytes = C * (offs/1024 s)^3 * mss = offs^3*mss*C_MUL >> 40
+        delta = (offs * offs * offs * mss * ltcp.CUBIC_C_MUL) >> 40
+        target = self.origin - delta if neg else self.origin + delta
+        if target > tcp.cwnd:
+            tcp.cwnd += max((target - tcp.cwnd) * mss // tcp.cwnd, 1)
+        else:  # at/above the curve: minimal probing growth
+            tcp.cwnd += max(mss * mss // (100 * max(tcp.cwnd, 1)), 1)
+
+    def on_loss(self, tcp: "TcpState", now: int) -> None:
+        if tcp.cwnd < self.w_max:  # fast convergence
+            self.w_max = (tcp.cwnd * ltcp.CUBIC_FC_MUL) >> 10
+        else:
+            self.w_max = tcp.cwnd
+        self.epoch = None
+        tcp.ssthresh = max(
+            (tcp.cwnd * ltcp.CUBIC_BETA_MUL) >> 10, 2 * tcp.cfg.mss
+        )
+
+
+CC_REGISTRY = {"reno": RenoCC, "cubic": CubicCC}
+
+
+def make_cc(name: str) -> CongestionControl:
+    try:
+        return CC_REGISTRY[name]()
+    except KeyError:
+        raise ValueError(f"unknown congestion-control algorithm {name!r}")
 
 
 class TcpState:
@@ -221,7 +332,9 @@ class TcpState:
         self.rcv_nxt = 0
         self.rcv_wscale = 0  # shift we advertise (and divide our window by)
         self.rcv_fin_seq: Optional[int] = None  # peer FIN position, if seen
-        # Reno congestion state (tcp_cong_reno.c)
+        # congestion state (tcp_cong.c; the algorithm object carries any
+        # per-connection extra state, e.g. CUBIC's epoch)
+        self.cc = make_cc(self.cfg.congestion)
         self.cwnd = 0
         self.ssthresh = 1 << 30
         self.dup_acks = 0
@@ -472,7 +585,7 @@ class TcpState:
             and not hdr.flags & TcpFlags.FIN
             and not hdr.flags & TcpFlags.SYN
         ):
-            self._on_dup_ack()
+            self._on_dup_ack(now)
 
         self._maybe_transition_on_ack(now, ack)
 
@@ -522,7 +635,7 @@ class TcpState:
             if self.cwnd < self.ssthresh:
                 self.cwnd += min(newly, mss)  # slow start
             else:
-                self.cwnd += max(mss * mss // max(self.cwnd, 1), 1)  # CA
+                self.cc.grow_ca(self, now)  # per-algorithm CA growth
         self.retries = 0
         if self._outstanding() > 0 or self.fin_pending or self.syn_pending:
             self._arm_rto(now)
@@ -530,7 +643,7 @@ class TcpState:
             self.rto_deadline = None
             self.rto = self._computed_rto()
 
-    def _on_dup_ack(self) -> None:
+    def _on_dup_ack(self, now: int) -> None:
         mss = self.cfg.mss
         self.dup_acks += 1
         if self.in_recovery:
@@ -541,8 +654,8 @@ class TcpState:
                 # go-back-N stall the scoreboard exists to avoid)
                 self.rexmit_pending = True
         elif self.dup_acks == 3:
-            # fast retransmit (tcp_cong_reno.c)
-            self.ssthresh = max(self._outstanding() // 2, 2 * mss)
+            # fast retransmit (tcp_cong.c entry: per-algorithm ssthresh)
+            self.cc.on_loss(self, now)
             self.recover = self.snd_max
             self.in_recovery = True
             self.cwnd = self.ssthresh + 3 * mss
@@ -943,8 +1056,8 @@ class TcpState:
             self.state = State.RST
             return
         mss = self.cfg.mss
-        # Reno RTO response: collapse to one segment, halve ssthresh
-        self.ssthresh = max(self._outstanding() // 2, 2 * mss)
+        # RTO response: collapse to one segment; per-algorithm ssthresh
+        self.cc.on_loss(self, now)
         self.cwnd = mss
         self.in_recovery = False
         self.dup_acks = 0

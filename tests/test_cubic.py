@@ -1,0 +1,274 @@
+"""Pluggable congestion control + CUBIC, across all three tiers.
+
+The reference exposes a CC plugin interface
+(src/main/host/descriptor/tcp_cong.c) with Reno as the registered
+instance (tcp_cong_reno.c); this framework adds CUBIC (RFC 9438) as a
+second algorithm in each tier:
+
+- scalar ltcp law (net/ltcp.py): per-flow ``cc`` selector, fixed-point
+  integer CUBIC shared bit-for-bit with the lane twin;
+- vector lane tier (backend/lanes_stream.py): parity-tested against the
+  scalar oracle via the engine event logs;
+- byte-stream stack (transport/tcp.py): CongestionControl objects on
+  TcpState (CC_REGISTRY), selected per host by the ``congestion`` host
+  option through net/stack.py.
+"""
+
+import pytest
+
+from shadow_tpu.backend.cpu_engine import CpuEngine
+from shadow_tpu.config.options import ConfigError, ConfigOptions
+from shadow_tpu.net import ltcp
+from shadow_tpu.transport.tcp import (
+    CubicCC,
+    RenoCC,
+    TcpConfig,
+    TcpState,
+    _icbrt,
+    make_cc,
+)
+
+from test_lane_parity import STREAM_PAIR, both_logs
+from test_ltcp import WireSim
+
+MS = 1_000_000
+
+
+# --------------------------------------------------------------------------
+# integer cube roots (the law's primitive)
+# --------------------------------------------------------------------------
+
+
+def test_icbrt32_is_floor_cbrt():
+    vals = list(range(0, 2000)) + [
+        2**31 - 1, 10**9, 123456789, 8, 26, 27, 28, 63, 64, 65
+    ]
+    for x in vals:
+        y = ltcp.icbrt32(x)
+        assert y**3 <= x < (y + 1) ** 3, x
+
+
+def test_icbrt32_vector_twin_matches_scalar():
+    jnp = pytest.importorskip("jax.numpy")
+    from shadow_tpu.backend.lanes_stream import _icbrt32_vec
+
+    import numpy as np
+
+    xs = np.array(
+        [0, 1, 7, 8, 26, 27, 1000, 123456789, 10**9, 2**31 - 1, 2**30],
+        dtype=np.int32,
+    )
+    got = np.asarray(_icbrt32_vec(jnp.asarray(xs)))
+    want = np.array([ltcp.icbrt32(int(x)) for x in xs], dtype=np.int32)
+    assert (got == want).all()
+
+
+def test_icbrt_bigint_newton():
+    for x in [0, 1, 7, 8, 27, 2**40, 2**40 + 1, 10**15, 5 * 2**30 * 100000]:
+        y = _icbrt(x)
+        assert y**3 <= x < (y + 1) ** 3, x
+
+
+# --------------------------------------------------------------------------
+# scalar ltcp law under CUBIC
+# --------------------------------------------------------------------------
+
+
+def _cubic_wire(size=400 * 1448, drop=None):
+    w = WireSim(size=size, drop=drop)
+    w.client.cc = ltcp.CC_CUBIC
+    return w
+
+
+class TestLtcpCubic:
+    def test_lossless_transfer_completes(self):
+        w = _cubic_wire().run()
+        assert w.client.state == ltcp.DONE
+        assert w.server.state == ltcp.DONE
+        assert w.server.rx_bytes == 400 * 1448
+        assert w.client.retransmits == 0
+
+    def test_loss_sets_beta_ssthresh_and_wmax(self):
+        # drop one mid-stream data segment -> fast retransmit entry uses
+        # the CUBIC multiplicative decrease (717/1024), not flight/2
+        w = _cubic_wire(
+            drop=lambda d, fl, seq, ack, nth: d == "c2s" and seq == 30
+            and fl & ltcp.F_DATA and nth < 40
+        )
+        w.run()
+        assert w.client.state == ltcp.DONE
+        assert w.client.retransmits > 0
+        assert w.client.w_max_fp > 0  # a loss event recorded W_max
+        assert w.client.ssthresh_fp >= ltcp.MIN_SSTHRESH_FP
+
+    def test_cubic_growth_follows_target_after_loss(self):
+        # after recovery the window must regrow toward W_max (concave
+        # region) without exceeding MAX_CWND_FP
+        seen = set()
+
+        def drop_first(d, fl, seq, ack, nth):
+            if d == "c2s" and fl & ltcp.F_DATA and seq in (40, 41):
+                if seq not in seen:
+                    seen.add(seq)
+                    return True
+            return False
+
+        w = _cubic_wire(size=1500 * 1448, drop=drop_first)
+        w.run()
+        assert w.client.state == ltcp.DONE
+        assert ltcp.FP <= w.client.cwnd_fp <= ltcp.MAX_CWND_FP
+        assert w.server.rx_bytes == 1500 * 1448
+
+    def test_reno_flows_unaffected_by_cubic_fields(self):
+        # default flows never touch the CUBIC state
+        w = WireSim(size=100 * 1448).run()
+        assert w.client.cc == ltcp.CC_RENO
+        assert w.client.cub_epoch == ltcp.NEVER
+        assert w.client.w_max_fp == 0
+
+    def test_heavy_loss_cubic_still_completes(self):
+        import random
+
+        rng = random.Random(11)
+        dropped = {}
+
+        def drop(d, fl, seq, ack, nth):
+            key = (d, nth)
+            if key not in dropped:
+                dropped[key] = rng.random() < 0.12
+            return dropped[key]
+
+        w = _cubic_wire(size=120 * 1448, drop=drop).run()
+        assert w.client.state == ltcp.DONE
+        assert w.server.rx_bytes == 120 * 1448
+
+
+# --------------------------------------------------------------------------
+# lane-tier parity: vector CUBIC vs scalar oracle, bit-identical logs
+# --------------------------------------------------------------------------
+
+CUBIC_PAIR = STREAM_PAIR.replace(
+    "c: {network_node_id: 0,",
+    "c: {network_node_id: 0, congestion: cubic,",
+)
+
+
+def test_stream_cubic_parity():
+    cpu, tpu = both_logs(CUBIC_PAIR)
+    assert cpu.counters["stream_complete"] == 1
+    assert cpu.counters["stream_rx_bytes"] == 200_000
+    assert cpu.log_tuples() == tpu.log_tuples()
+    for k in ("stream_complete", "stream_rx_bytes", "stream_rx_segs",
+              "stream_tx_segs", "stream_flows_done", "stream_retransmits"):
+        assert cpu.counters.get(k) == tpu.counters.get(k), k
+
+
+def test_stream_cubic_lossy_parity():
+    # loss engages the CUBIC epoch/W_max machinery on both sides; the
+    # event logs must still match bit-for-bit
+    yaml = CUBIC_PAIR.replace(
+        'latency "15 ms"', 'latency "15 ms" packet_loss 0.03'
+    )
+    cpu, tpu = both_logs(yaml)
+    assert cpu.counters["stream_complete"] == 1
+    assert cpu.counters["stream_retransmits"] > 0
+    assert cpu.log_tuples() == tpu.log_tuples()
+    assert cpu.counters.get("stream_retransmits") == tpu.counters.get(
+        "stream_retransmits"
+    )
+
+
+def test_cubic_and_reno_diverge():
+    # sanity that the knob changes behavior at all: with loss in play the
+    # two algorithms must NOT produce identical wire schedules
+    lossy_reno = STREAM_PAIR.replace(
+        'latency "15 ms"', 'latency "15 ms" packet_loss 0.05'
+    )
+    lossy_cubic = CUBIC_PAIR.replace(
+        'latency "15 ms"', 'latency "15 ms" packet_loss 0.05'
+    )
+    reno = CpuEngine(ConfigOptions.from_yaml(lossy_reno)).run()
+    cubic = CpuEngine(ConfigOptions.from_yaml(lossy_cubic)).run()
+    assert reno.counters["stream_complete"] == 1
+    assert cubic.counters["stream_complete"] == 1
+    assert reno.log_tuples() != cubic.log_tuples()
+
+
+# --------------------------------------------------------------------------
+# byte-stream stack (transport/tcp.py)
+# --------------------------------------------------------------------------
+
+
+class TestByteStackCubic:
+    def test_registry_and_config(self):
+        assert isinstance(make_cc("reno"), RenoCC)
+        assert isinstance(make_cc("cubic"), CubicCC)
+        with pytest.raises(ValueError):
+            make_cc("vegas")
+        t = TcpState(TcpConfig(congestion="cubic"))
+        assert isinstance(t.cc, CubicCC)
+
+    def test_cubic_transfer_completes(self):
+        from test_tcp import Wire, handshake, transfer
+
+        cfg = TcpConfig(congestion="cubic")
+        a, b, wire = handshake(cfg_a=cfg, cfg_b=cfg)
+        data = bytes(range(256)) * 2000  # 512 kB
+        got = transfer(a, b, wire, data)
+        assert got == data
+
+    def test_cubic_lossy_transfer_completes(self):
+        from test_tcp import handshake, transfer
+
+        cfg = TcpConfig(congestion="cubic")
+        a, b, wire = handshake(loss={9, 17, 30}, cfg_a=cfg, cfg_b=cfg)
+        data = bytes(range(256)) * 400
+        got = transfer(a, b, wire, data)
+        assert got == data
+
+    def test_on_loss_law(self):
+        t = TcpState(TcpConfig(congestion="cubic"))
+        t.cwnd = 100_000
+        t.cc.on_loss(t, 0)
+        assert t.ssthresh == max((100_000 * 717) >> 10, 2 * t.cfg.mss)
+        assert t.cc.w_max == 100_000
+        # second loss at a smaller window: fast convergence shrinks W_max
+        t.cwnd = 50_000
+        t.cc.on_loss(t, 0)
+        assert t.cc.w_max == (50_000 * 870) >> 10
+
+    def test_grow_ca_moves_toward_target(self):
+        t = TcpState(TcpConfig(congestion="cubic"))
+        t.cwnd = 20_000
+        t.ssthresh = 10_000  # in CA
+        t.cc.w_max = 80_000
+        now = 0
+        for i in range(4000):
+            now += 1_000_000  # 1 ms per ACK
+            t.cc.grow_ca(t, now)
+        # after ~4 s of ACK clocking the window must have regrown to the
+        # plateau region around W_max (and beyond: convex region)
+        assert t.cwnd >= 70_000
+
+
+# --------------------------------------------------------------------------
+# config plumbing
+# --------------------------------------------------------------------------
+
+CFG_YAML = """
+general: {stop_time: 1s}
+hosts:
+  a: {congestion: cubic, processes: [{path: stream-server}]}
+"""
+
+
+def test_host_option_parses():
+    cfg = ConfigOptions.from_yaml(CFG_YAML)
+    assert cfg.hosts[0].congestion == "cubic"
+    cfg.validate()
+
+
+def test_host_option_validates():
+    cfg = ConfigOptions.from_yaml(CFG_YAML.replace("cubic", "vegas"))
+    with pytest.raises(ConfigError, match="congestion"):
+        cfg.validate()

@@ -442,6 +442,7 @@ def test_vector_law_keeps_ack_rto_arm_through_opened_pump():
         jnp.concatenate([segs, z1]),
         jnp.concatenate([mss, z1]),
         jnp.concatenate([last, z1]),
+        jnp.zeros(2, dtype=jnp.int32),  # flow_cc: reno
     )  # [2S]=2 rows: row 0 = the client endpoint, row 1 = its server
     now = 1_000_000_000
     nh = jnp.full(2, p(now)[0], dtype=jnp.int32)
