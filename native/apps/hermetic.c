@@ -1,0 +1,124 @@
+/* hermetic: prints every host-state observable the simulation claims to
+ * virtualize — file timestamps (stat family), directory enumeration
+ * order (getdents), /proc/uptime, sysinfo, sched_getaffinity — so the
+ * dual-target test can assert that no wall-clock-derived byte reaches a
+ * managed program (reference capability: the virtualized descriptor
+ * layer, src/main/host/descriptor/regular_file.c, and the syscall
+ * handlers of handler/mod.rs).  Run natively the numbers are the host's;
+ * under the sim they must be pure functions of simulated state. */
+#define _GNU_SOURCE
+#include <dirent.h>
+#include <fcntl.h>
+#include <sched.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/sysinfo.h>
+#include <time.h>
+#include <unistd.h>
+
+static void print_stat(const char *tag, const char *path) {
+    struct stat st;
+    if (stat(path, &st) != 0) {
+        printf("%s=ERR\n", tag);
+        return;
+    }
+    printf("%s=%lld.%09ld,%lld.%09ld,%lld.%09ld\n", tag,
+           (long long)st.st_mtim.tv_sec, st.st_mtim.tv_nsec,
+           (long long)st.st_atim.tv_sec, st.st_atim.tv_nsec,
+           (long long)st.st_ctim.tv_sec, st.st_ctim.tv_nsec);
+}
+
+int main(int argc, char **argv) {
+    (void)argc;
+    /* 1. a file the simulation never wrote: this executable */
+    print_stat("self_mtime", argv[0]);
+
+    /* 2. write tracking: create, stat, advance sim time, write, stat */
+    mkdir("hermdir", 0755);
+    int fd = open("hermdir/w.txt", O_CREAT | O_WRONLY | O_TRUNC, 0644);
+    if (fd < 0) return 1;
+    write(fd, "x", 1);
+    struct stat st;
+    fstat(fd, &st);
+    printf("write_pre=%lld.%09ld\n", (long long)st.st_mtim.tv_sec,
+           st.st_mtim.tv_nsec);
+    usleep(100000); /* +100 ms simulated */
+    write(fd, "y", 1);
+    fstat(fd, &st);
+    printf("write_post=%lld.%09ld\n", (long long)st.st_mtim.tv_sec,
+           st.st_mtim.tv_nsec);
+    close(fd);
+    print_stat("path_mtime", "hermdir/w.txt");
+
+    /* 3. enumeration order: create c, a, b — readdir must be sorted */
+    const char *names[] = {"hermdir/c.txt", "hermdir/a.txt",
+                           "hermdir/b.txt"};
+    for (unsigned i = 0; i < sizeof(names) / sizeof(names[0]); i++) {
+        int f = open(names[i], O_CREAT | O_WRONLY, 0644);
+        if (f >= 0) {
+            write(f, "z", 1);
+            close(f);
+        }
+    }
+    DIR *d = opendir("hermdir");
+    printf("dirents=");
+    if (d) {
+        struct dirent *e;
+        int first = 1;
+        while ((e = readdir(d)) != NULL) {
+            if (e->d_name[0] == '.') continue;
+            printf(first ? "%s" : ",%s", e->d_name);
+            first = 0;
+        }
+        closedir(d);
+    }
+    printf("\n");
+
+    /* 3b. explicit timestamps: utimensat's SET time must be what later
+     * stats report (not the kernel's wall-clock echo of it) */
+    struct timespec tv[2];
+    tv[0].tv_sec = 946684800 + 1234;
+    tv[0].tv_nsec = 0;
+    tv[1].tv_sec = 946684800 + 1234;
+    tv[1].tv_nsec = 500000000;
+    utimensat(AT_FDCWD, "hermdir/w.txt", tv, 0);
+    print_stat("utimens_mtime", "hermdir/w.txt");
+
+    /* 3c. unlink forgets: a recreated file starts from the epoch even if
+     * the host fs reuses the inode */
+    unlink("hermdir/c.txt");
+    int rf = open("hermdir/c.txt", O_CREAT | O_WRONLY, 0644);
+    if (rf >= 0) close(rf); /* created but never written */
+    print_stat("recreated_mtime", "hermdir/c.txt");
+
+    /* 4. /proc/uptime */
+    char buf[128] = {0};
+    int pf = open("/proc/uptime", O_RDONLY);
+    if (pf >= 0) {
+        ssize_t r = read(pf, buf, sizeof(buf) - 1);
+        if (r > 0) buf[r] = 0;
+        close(pf);
+        char *nl = strchr(buf, '\n');
+        if (nl) *nl = 0;
+        printf("proc_uptime=%s\n", buf);
+    } else {
+        printf("proc_uptime=ERR\n");
+    }
+
+    /* 5. sysinfo */
+    struct sysinfo si;
+    if (sysinfo(&si) == 0)
+        printf("sysinfo=up:%ld,load:%lu,ram:%llu,procs:%u\n", si.uptime,
+               si.loads[0], (unsigned long long)si.totalram, si.procs);
+
+    /* 6. affinity: the modeled CPU set */
+    cpu_set_t cs;
+    CPU_ZERO(&cs);
+    if (sched_getaffinity(0, sizeof(cs), &cs) == 0)
+        printf("cpus=%d\n", CPU_COUNT(&cs));
+
+    printf("done\n");
+    fflush(stdout);
+    return 0;
+}

@@ -429,6 +429,8 @@ static void shim_attach(const char *path) { g_shm = shim_map(path); }
  */
 
 static uint64_t sim_now_ns(void);      /* defined in the time section */
+static void meta_note_write(int fd);   /* file-metadata scrub layer */
+static void fd_meta_reset(int fd);
 static uint64_t splitmix64_next(void); /* defined in the random section */
 
 /* deterministic entropy fill, shared by the getrandom interposer and the
@@ -1922,7 +1924,9 @@ ssize_t write(int fd, const void *buf, size_t n) {
     if (is_nlfd(fd)) return nl_send(fd, buf, n);
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLOUT, 0);
-        return real_write(fd, buf, n);
+        ssize_t r = real_write(fd, buf, n);
+        if (r > 0) meta_note_write(fd);
+        return r;
     }
     return vfd_sendto(fd, buf, n, 0, 0, 0);
 }
@@ -1989,6 +1993,7 @@ int shutdown(int fd, int how) {
 
 int close(int fd) {
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
+    fd_meta_reset(fd);
     if (is_nlfd(fd)) {
         memset(&nl_state[fd], 0, sizeof(nl_state[fd]));
         vfd_release(fd);
@@ -4037,7 +4042,9 @@ ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
 ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
     if (!is_vfd(fd)) {
         maybe_yield(fd, POLLOUT, 0);
-        return (ssize_t)raw_writev(fd, iov, iovcnt);
+        ssize_t r = (ssize_t)raw_writev(fd, iov, iovcnt);
+        if (r > 0) meta_note_write(fd);
+        return r;
     }
     ssize_t total = iov_total(iov, iovcnt);
     if (total < 0) {
@@ -4140,6 +4147,7 @@ int dup2(int oldfd, int newfd) {
     if (is_vfd(newfd)) close(newfd); /* real replaces a simulated socket */
     int fd = real_dup2(oldfd, newfd);
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
+    fd_meta_reset(fd);
     if (fd >= 0 && g_ready) epoll_forget_fd(fd);
     return fd;
 #undef real_dup2
@@ -4157,6 +4165,7 @@ int dup3(int oldfd, int newfd, int flags) {
     if (is_vfd(newfd)) close(newfd);
     int fd = real_dup3(oldfd, newfd, flags);
     if (fd >= 0 && fd < SHIM_MAX_FDS) fd_fifo_cache[fd] = 0;
+    fd_meta_reset(fd);
     if (fd >= 0 && g_ready) epoll_forget_fd(fd);
     return fd;
 #undef real_dup3
@@ -4279,6 +4288,294 @@ static long shim_futex_emu(long uaddr, long op, long val, long timeout,
             return shim_raw_syscall6(SYS_futex, uaddr, op, val, timeout,
                                      uaddr2, val3);
     }
+}
+
+/* ------------------------------------------------------------------ */
+/* Simulated file metadata (hermeticity).  The reference virtualizes the
+ * file layer in its descriptor table (src/main/host/descriptor/
+ * regular_file.c: timestamps on the simulated clock); this shim keeps
+ * files native but SCRUBS every wall-clock-derived byte out of what the
+ * plugin can observe:
+ *
+ * - stat family: atime/mtime/ctime are the sim time of the last write
+ *   the simulation made to that inode (tracked below), or the simulation
+ *   epoch (2000-01-01) for files it never wrote;
+ * - getdents64: entries sorted by name (host readdir order is
+ *   filesystem-state dependent);
+ * - sysinfo + /proc/uptime: uptime from the simulated clock, loads and
+ *   memory figures fixed constants;
+ * - sched_getaffinity: the modeled 1-CPU set (cpu 0), matching
+ *   vdso_repl_getcpu.
+ *
+ * Write tracking is per-process (the shim sees this process's writes);
+ * cross-process mtime propagation would need the manager-side file table
+ * the reference has — documented limitation. */
+
+#include <sys/sysinfo.h>
+
+#define SHIM_SIM_EPOCH_NS 946684800000000000ull /* 2000-01-01T00:00:00Z */
+
+/* inode -> last-write sim time, open-addressed (sim threads are
+ * turn-taking, so no lock) */
+#define META_SLOTS 1024
+static struct { uint64_t key; uint64_t wns; } meta_tab[META_SLOTS];
+
+static uint64_t meta_key(uint64_t dev, uint64_t ino) {
+    uint64_t k = dev * 0x9E3779B97F4A7C15ull ^ ino;
+    return k ? k : 1; /* 0 marks an empty slot */
+}
+
+static void meta_note(uint64_t dev, uint64_t ino, uint64_t ns) {
+    uint64_t k = meta_key(dev, ino);
+    size_t i = (size_t)(k % META_SLOTS);
+    for (size_t probe = 0; probe < META_SLOTS; probe++) {
+        size_t s = (i + probe) % META_SLOTS;
+        if (meta_tab[s].key == k || meta_tab[s].key == 0) {
+            meta_tab[s].key = k;
+            meta_tab[s].wns = ns;
+            return;
+        }
+    }
+    /* table full: overwrite the home slot (bounded, deterministic) */
+    meta_tab[i].key = k;
+    meta_tab[i].wns = ns;
+}
+
+static int meta_get(uint64_t dev, uint64_t ino, uint64_t *ns) {
+    uint64_t k = meta_key(dev, ino);
+    size_t i = (size_t)(k % META_SLOTS);
+    for (size_t probe = 0; probe < META_SLOTS; probe++) {
+        size_t s = (i + probe) % META_SLOTS;
+        if (meta_tab[s].key == 0) return 0;
+        if (meta_tab[s].key == k) {
+            *ns = meta_tab[s].wns;
+            return 1;
+        }
+    }
+    return 0;
+}
+
+/* a deleted/replaced file's inode may be reused by the host fs for an
+ * unrelated new file; mapping it back to the epoch (rather than slot
+ * deletion, which open addressing complicates) removes the
+ * host-allocation-dependent resurrection of the old write time */
+static void meta_forget(uint64_t dev, uint64_t ino) {
+    uint64_t k = meta_key(dev, ino);
+    size_t i = (size_t)(k % META_SLOTS);
+    for (size_t probe = 0; probe < META_SLOTS; probe++) {
+        size_t s = (i + probe) % META_SLOTS;
+        if (meta_tab[s].key == 0) return;
+        if (meta_tab[s].key == k) {
+            meta_tab[s].wns = SHIM_SIM_EPOCH_NS;
+            return;
+        }
+    }
+}
+
+/* forget by path (pre-unlink/pre-rename-destination): resolve the inode
+ * about to become free */
+static void meta_forget_path(int dirfd, const char *path, int flags) {
+    if (!g_shm || !path) return;
+    struct stat st;
+    long r = shim_raw_syscall6(SYS_newfstatat, dirfd, (long)path, (long)&st,
+                              flags | AT_SYMLINK_NOFOLLOW, 0, 0);
+    if (r == 0) meta_forget((uint64_t)st.st_dev, (uint64_t)st.st_ino);
+}
+
+/* utimensat/futimens: the app set explicit timestamps — record the SET
+ * mtime so later stats reflect it (UTIME_NOW resolves to the SIMULATED
+ * clock; letting the kernel's wall-clock value stand would leak).  The
+ * kernel call still runs (permissions/errno), its wall times are then
+ * shadowed by this table. */
+static void meta_note_utimens(int dirfd, const char *path,
+                              const struct timespec *times, int flags) {
+    if (!g_shm) return;
+    uint64_t dev, ino;
+    struct stat st;
+    long r;
+    if (path)
+        r = shim_raw_syscall6(SYS_newfstatat, dirfd, (long)path, (long)&st,
+                              flags, 0, 0);
+    else
+        r = shim_raw_syscall6(SYS_fstat, dirfd, (long)&st, 0, 0, 0, 0);
+    if (r != 0) return;
+    dev = (uint64_t)st.st_dev;
+    ino = (uint64_t)st.st_ino;
+    if (!times) {
+        meta_note(dev, ino, sim_now_ns());
+        return;
+    }
+    const struct timespec *mt = &times[1];
+    if (mt->tv_nsec == UTIME_OMIT) return;
+    if (mt->tv_nsec == UTIME_NOW)
+        meta_note(dev, ino, sim_now_ns());
+    else
+        meta_note(dev, ino, (uint64_t)mt->tv_sec * 1000000000ull +
+                                (uint64_t)mt->tv_nsec);
+}
+
+/* per-fd (dev, ino) cache so write tracking costs one fstat per fd
+ * lifetime, not one per write */
+static uint8_t fd_meta_state[SHIM_MAX_FDS]; /* 0 unknown, 1 reg, 2 other */
+static uint64_t fd_meta_dev[SHIM_MAX_FDS];
+static uint64_t fd_meta_ino[SHIM_MAX_FDS];
+
+static void fd_meta_reset(int fd) {
+    if (fd >= 0 && fd < SHIM_MAX_FDS) fd_meta_state[fd] = 0;
+}
+
+static void meta_note_write(int fd) {
+    if (!g_shm || fd < 0 || fd >= SHIM_MAX_FDS) return;
+    if (fd_meta_state[fd] == 0) {
+        struct stat st;
+        long r = shim_raw_syscall6(SYS_fstat, fd, (long)&st, 0, 0, 0, 0);
+        if (r == 0 && (S_ISREG(st.st_mode) || S_ISDIR(st.st_mode))) {
+            fd_meta_state[fd] = 1;
+            fd_meta_dev[fd] = (uint64_t)st.st_dev;
+            fd_meta_ino[fd] = (uint64_t)st.st_ino;
+        } else {
+            fd_meta_state[fd] = 2;
+        }
+    }
+    if (fd_meta_state[fd] == 1)
+        meta_note(fd_meta_dev[fd], fd_meta_ino[fd], sim_now_ns());
+}
+
+static void meta_set_times(uint64_t dev, uint64_t ino, uint64_t mode,
+                           int64_t *sec_out, int64_t *nsec_out) {
+    uint64_t ns = SHIM_SIM_EPOCH_NS;
+    (void)mode;
+    meta_get(dev, ino, &ns);
+    *sec_out = (int64_t)(ns / 1000000000ull);
+    *nsec_out = (int64_t)(ns % 1000000000ull);
+}
+
+static void scrub_stat(struct stat *st) {
+    if (!st || !g_shm) return;
+    int64_t sec, nsec;
+    meta_set_times((uint64_t)st->st_dev, (uint64_t)st->st_ino,
+                   (uint64_t)st->st_mode, &sec, &nsec);
+    st->st_atim.tv_sec = st->st_mtim.tv_sec = st->st_ctim.tv_sec =
+        (time_t)sec;
+    st->st_atim.tv_nsec = st->st_mtim.tv_nsec = st->st_ctim.tv_nsec =
+        (long)nsec;
+}
+
+static void scrub_statx(struct statx *sx) {
+    if (!sx || !g_shm) return;
+    int64_t sec, nsec;
+    meta_set_times(((uint64_t)sx->stx_dev_major << 32) | sx->stx_dev_minor,
+                   sx->stx_ino, sx->stx_mode, &sec, &nsec);
+    sx->stx_atime.tv_sec = sx->stx_btime.tv_sec = sx->stx_ctime.tv_sec =
+        sx->stx_mtime.tv_sec = sec;
+    sx->stx_atime.tv_nsec = sx->stx_btime.tv_nsec = sx->stx_ctime.tv_nsec =
+        sx->stx_mtime.tv_nsec = (uint32_t)nsec;
+}
+
+/* getdents64: pin directory enumeration order (sort by name).  The
+ * kernel-side count is clamped to DENTS_BYTES so every batch fits the
+ * static scratch (the SIGSYS path runs on the interrupted thread's
+ * stack — goroutine stacks can be ~8 KiB, so NO large frames here; the
+ * scratch is static under a spinlock).  Order is deterministic per
+ * batch; directories whose enumeration spans several 120 KiB batches
+ * (several thousand entries) are only per-batch sorted — documented
+ * limitation (the reference virtualizes enumeration wholesale in its
+ * descriptor layer, handler/mod.rs getdents).  d_off values ride along
+ * with their entries — seekdir across a sorted batch is unsupported. */
+struct shim_dirent64 {
+    uint64_t d_ino;
+    int64_t d_off;
+    unsigned short d_reclen;
+    unsigned char d_type;
+    char d_name[];
+};
+
+#define DENTS_BYTES (120 * 1024)
+#define DENTS_MAX (DENTS_BYTES / 24 + 64) /* min reclen is 24 bytes */
+static char dents_tmp[DENTS_BYTES];
+static struct shim_dirent64 *dents_ents[DENTS_MAX];
+static int dents_lock; /* raw spinlock: the scratch is shared */
+
+static void dents_acquire(void) {
+    while (__atomic_exchange_n(&dents_lock, 1, __ATOMIC_ACQUIRE))
+        shim_raw_syscall6(SYS_sched_yield, 0, 0, 0, 0, 0, 0);
+}
+
+static void dents_release(void) {
+    __atomic_store_n(&dents_lock, 0, __ATOMIC_RELEASE);
+}
+
+static long scrub_getdents(char *buf, long n) {
+    dents_acquire();
+    struct shim_dirent64 **ents = dents_ents;
+    int cnt = 0;
+    long off = 0;
+    while (off < n && cnt < DENTS_MAX) {
+        struct shim_dirent64 *d = (struct shim_dirent64 *)(buf + off);
+        if (d->d_reclen == 0) break;
+        ents[cnt++] = d;
+        off += d->d_reclen;
+    }
+    if (off != n || cnt >= DENTS_MAX) {
+        dents_release();
+        return n; /* malformed batch: leave as-is */
+    }
+    /* insertion sort by name (batches are small; deterministic) */
+    for (int i = 1; i < cnt; i++) {
+        struct shim_dirent64 *key = ents[i];
+        int j = i - 1;
+        while (j >= 0 && strcmp(ents[j]->d_name, key->d_name) > 0) {
+            ents[j + 1] = ents[j];
+            j--;
+        }
+        ents[j + 1] = key;
+    }
+    /* rewrite the batch in sorted order through the bounce buffer */
+    long w = 0;
+    for (int i = 0; i < cnt; i++) {
+        memcpy(dents_tmp + w, ents[i], ents[i]->d_reclen);
+        w += ents[i]->d_reclen;
+    }
+    memcpy(buf, dents_tmp, (size_t)w);
+    dents_release();
+    return n;
+}
+
+static long emu_sysinfo(struct sysinfo *si) {
+    if (!si) return -EFAULT;
+    memset(si, 0, sizeof(*si));
+    uint64_t now = sim_now_ns();
+    si->uptime = (long)((now - SHIM_SIM_EPOCH_NS) / 1000000000ull);
+    /* loads zero; fixed modeled memory figures (16 GiB total, half free) */
+    si->totalram = 16ull << 30;
+    si->freeram = 8ull << 30;
+    si->bufferram = 0;
+    si->totalswap = 0;
+    si->freeswap = 0;
+    si->procs = 16;
+    si->mem_unit = 1;
+    return 0;
+}
+
+/* /proc/uptime synthesized from the simulated clock: opening it returns
+ * a memfd pre-filled at the open instant (read offsets behave normally;
+ * the file does not tick while open — matching a single read() snapshot,
+ * which is how every real consumer uses it) */
+static long maybe_open_proc_uptime(const char *path) {
+    if (!g_shm || !path || strcmp(path, "/proc/uptime") != 0) return -1;
+    long fd = shim_raw_syscall6(SYS_memfd_create, (long)"sim_uptime", 0, 0,
+                               0, 0, 0);
+    if (fd < 0) return -1;
+    uint64_t up = (sim_now_ns() - SHIM_SIM_EPOCH_NS) / 10000000ull; /* cs */
+    char line[64];
+    int len = snprintf(line, sizeof(line), "%llu.%02llu %llu.%02llu\n",
+                       (unsigned long long)(up / 100),
+                       (unsigned long long)(up % 100),
+                       (unsigned long long)(up / 100),
+                       (unsigned long long)(up % 100));
+    shim_raw_syscall6(SYS_write, fd, (long)line, len, 0, 0, 0);
+    shim_raw_syscall6(SYS_lseek, fd, 0, 0 /* SEEK_SET */, 0, 0, 0);
+    return fd;
 }
 
 /* Adapter: the public wrappers use libc conventions (-1 + errno); the
@@ -4696,8 +4993,116 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
             *handled = 0;
             return 0;
 
+        /* ---- file metadata / host-state hermeticity (the scrub layer
+         * above; scrub_* are no-ops before the channel is up) ---- */
+        case SYS_stat:
+        case SYS_lstat:
+        case SYS_fstat: {
+            long r = shim_raw_syscall6(nr, a1, a2, 0, 0, 0, 0);
+            if (r == 0) scrub_stat((struct stat *)a2);
+            return r;
+        }
+        case SYS_newfstatat: {
+            long r = shim_raw_syscall6(nr, a1, a2, a3, a4, 0, 0);
+            if (r == 0) scrub_stat((struct stat *)a3);
+            return r;
+        }
+        case SYS_statx: {
+            long r = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, 0);
+            if (r == 0) scrub_statx((struct statx *)a5);
+            return r;
+        }
+        case SYS_getdents64: {
+            /* clamp the batch so it always fits the sort scratch — the
+             * caller just sees a smaller batch and loops */
+            long cap = a3 > DENTS_BYTES && g_shm ? DENTS_BYTES : a3;
+            long r = shim_raw_syscall6(nr, a1, a2, cap, 0, 0, 0);
+            if (r > 0 && g_shm) return scrub_getdents((char *)a2, r);
+            return r;
+        }
+#ifdef SYS_close_range
+        case SYS_close_range: {
+            long r = shim_raw_syscall6(nr, a1, a2, a3, 0, 0, 0);
+            if (r == 0) {
+                long hi = a2 < SHIM_MAX_FDS - 1 ? a2 : SHIM_MAX_FDS - 1;
+                for (long f = a1 < 0 ? 0 : a1; f <= hi; f++) {
+                    fd_meta_reset((int)f);
+                    fd_fifo_cache[f] = 0;
+                    if (g_ready) epoll_forget_fd((int)f);
+                }
+            }
+            return r;
+        }
+#endif
+        case SYS_unlink:
+            meta_forget_path(AT_FDCWD, (const char *)a1, 0);
+            break;
+        case SYS_unlinkat:
+            meta_forget_path((int)a1, (const char *)a2, 0);
+            break;
+        case SYS_rename:
+            meta_forget_path(AT_FDCWD, (const char *)a2, 0);
+            break;
+        case SYS_renameat:
+        case SYS_renameat2:
+            meta_forget_path((int)a3, (const char *)a4, 0);
+            break;
+        case SYS_utimensat: {
+            long r = shim_raw_syscall6(nr, a1, a2, a3, a4, 0, 0);
+            if (r == 0)
+                meta_note_utimens((int)a1, (const char *)a2,
+                                  (const struct timespec *)a3, (int)a4);
+            return r;
+        }
+        case SYS_utimes:
+        case SYS_utime: {
+            long r = shim_raw_syscall6(nr, a1, a2, 0, 0, 0, 0);
+            if (r == 0 && g_shm) {
+                /* legacy forms: map to "set to sim-now" (their
+                 * second-granularity payloads come from the app's
+                 * simulated clock anyway) */
+                struct stat st;
+                if (shim_raw_syscall6(SYS_newfstatat, AT_FDCWD, a1,
+                                      (long)&st, 0, 0, 0) == 0)
+                    meta_note((uint64_t)st.st_dev, (uint64_t)st.st_ino,
+                              sim_now_ns());
+            }
+            return r;
+        }
+        case SYS_sysinfo:
+            if (!g_shm) break;
+            return emu_sysinfo((struct sysinfo *)a1);
+        case SYS_sched_getaffinity: {
+            if (!g_shm) break;
+            size_t len = (size_t)a2;
+            unsigned long *mask = (unsigned long *)a3;
+            if (len < sizeof(unsigned long)) return -EINVAL;
+            if (!mask) return -EFAULT;
+            memset(mask, 0, len);
+            mask[0] = 1; /* the modeled single CPU (vdso_repl_getcpu) */
+            return (long)sizeof(unsigned long);
+        }
+        case SYS_open: {
+            long fd = maybe_open_proc_uptime((const char *)a1);
+            if (fd >= 0) return fd;
+            break;
+        }
+        case SYS_openat: {
+            long fd = maybe_open_proc_uptime((const char *)a2);
+            if (fd >= 0) return fd;
+            break;
+        }
+        case SYS_pwrite64:
+        case SYS_pwritev:
+        case SYS_pwritev2: {
+            long r = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
+            if (r > 0) meta_note_write((int)a1);
+            return r;
+        }
         default:
             *handled = 0;
             return 0;
     }
+    *handled = 0;
+    return 0;
 }
