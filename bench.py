@@ -9,26 +9,35 @@ sim/wall speedup (6.38x, fork Ethereum-testnet study, BASELINE.md) — the
 only quantitative end-to-end number the reference publishes.  The extra
 keys record:
 
-- ``cpu_sim_s_per_wall_s`` / ``speedup_vs_cpu_backend``: the OTHER side
-  of the north-star ratio — the same workload timed on the CPU
-  thread-per-host path (shorter sim; the rate is steady-state);
 - ``mixed_sim_s_per_wall_s`` (+ flow counters): the MIXED TCP/UDP mesh
   of north-star config #4 at FULL scale — the UDP mesh with lane-TCP
-  stream flows (handshake, NewReno, burst transmission, RTO —
-  backend/lanes_stream.py on device, int32 pairs) crossing it.  The
-  round-2 device fault is fixed and all flows complete; the rate is
-  below the headline because stream workloads need several while-loop
-  iterations per window (see docs/tpu-backend.md's cost model).
+  stream flows (backend/lanes_stream.py on device, int32 pairs);
+- ``managed_sim_s_per_wall_s``: the MANAGED-process path — relay chains
+  of real OS binaries (tcpecho/relay under the shim) with model
+  background traffic (config/scenarios.py), the workload class the
+  reference's 6.38x was measured on (MyTest/SUMMARY.md);
+- ``configs``: the full BASELINE.md evaluation ladder — (1) 2-host
+  transfer, (2) 100-host UDP star, (3) 1k mixed mesh, (4) the 10k mixed
+  mesh above, (5) the managed relay-chain scenario — each as
+  sim-s/wall-s so regressions are visible per tier;
+- ``cpu_sim_s_per_wall_s`` / ``speedup_vs_cpu_backend``: the OTHER side
+  of the north-star ratio — the same workload timed on the CPU
+  thread-per-host path (shorter sim; the rate is steady-state).
 
 Env knobs (for local runs; the driver uses the defaults):
   SHADOW_TPU_BENCH_HOSTS         lanes in the mesh    (default 10000)
   SHADOW_TPU_BENCH_SIM_SECONDS   simulated duration   (default 30)
   SHADOW_TPU_BENCH_MIXED_HOSTS   mixed-mesh lanes     (default 10000; 0 skips)
   SHADOW_TPU_BENCH_CPU_SIM_SECONDS  cpu-side duration (default 1; 0 skips)
+  SHADOW_TPU_BENCH_LADDER        1 = run the config ladder (default 1)
+  SHADOW_TPU_BENCH_MANAGED       1 = run the managed scenario (default 1)
 """
 
 import json
 import os
+import shutil
+import subprocess
+import tempfile
 import time
 
 import shadow_tpu  # noqa: F401  (enables jax x64 mode)
@@ -36,6 +45,8 @@ from shadow_tpu.backend.tpu_engine import TpuEngine
 from shadow_tpu.config.presets import (
     flagship_mesh_config,
     mixed_flagship_config,
+    transfer_pair_config,
+    udp_star_config,
 )
 
 REFERENCE_SPEEDUP = 6.38  # BASELINE.md: 180 sim-s in 28.23 wall-s
@@ -45,6 +56,8 @@ SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_SIM_SECONDS", "30"))
 REPEATS = int(os.environ.get("SHADOW_TPU_BENCH_REPEATS", "3"))
 MIXED_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_MIXED_HOSTS", "10000"))
 CPU_SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_CPU_SIM_SECONDS", "1"))
+LADDER = os.environ.get("SHADOW_TPU_BENCH_LADDER", "1") == "1"
+MANAGED = os.environ.get("SHADOW_TPU_BENCH_MANAGED", "1") == "1"
 
 
 # the tunneled runtime caches EXECUTIONS across processes keyed on
@@ -67,19 +80,53 @@ def _pure_cfg(sim_seconds, backend="tpu"):
     return cfg
 
 
+def _best_device_rate(cfg, salt0, repeats=None):
+    """Best sim-s/wall-s over a few salted device runs (shared/remote
+    chip: the best run is the one without foreign interference)."""
+    eng = TpuEngine(cfg, log_capacity=0)
+    best = eng.run(mode="device", precompile=True, cache_salt=salt0)
+    for i in range(max((repeats or REPEATS) - 1, 0)):
+        r = eng.run(mode="device", cache_salt=salt0 + 1 + i)
+        if r.sim_seconds_per_wall_second > best.sim_seconds_per_wall_second:
+            best = r
+    return best
+
+
+def _managed_rate():
+    """The managed-process scenario (relay chains of real binaries) on
+    the CPU engine, timed end-to-end as sim-s/wall-s."""
+    from shadow_tpu.config.scenarios import (
+        managed_chain_config,
+        managed_proc_count,
+    )
+    from shadow_tpu.engine.sim import Simulation
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    subprocess.run(["make", "-C", os.path.join(repo, "native")],
+                   check=True, capture_output=True)
+    chains, cpc, peers, sim_s = 8, 2, 40, 30
+    tmp = tempfile.mkdtemp(prefix="shadow_bench_managed_")
+    try:
+        cfg = managed_chain_config(
+            os.path.join(tmp, "data"), chains=chains,
+            clients_per_chain=cpc, peers=peers, sim_seconds=sim_s,
+        )
+        t0 = time.perf_counter()
+        result = Simulation(cfg).run()
+        wall = time.perf_counter() - t0
+        ok = not result.process_errors
+        return {
+            "managed_sim_s_per_wall_s": round(sim_s / wall, 4),
+            "managed_hosts": len(cfg.hosts),
+            "managed_procs": managed_proc_count(chains, cpc),
+            "managed_ok": bool(ok),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
-    engine = TpuEngine(_pure_cfg(SIM_SECONDS), log_capacity=0)
-    # precompile: the timed run is the steady-state device program;
-    # collect() raises on queue/log overflow, so the number can't silently
-    # come from a diverged simulation.  The chip is shared/remote, so take
-    # the best of a few runs — each input-salted so none can be served
-    # from the runtime's execution cache
-    result = engine.run(mode="device", precompile=True,
-                        cache_salt=_SALT + 1)
-    for i in range(max(REPEATS - 1, 0)):
-        r = engine.run(mode="device", cache_salt=_SALT + 2 + i)
-        if r.sim_seconds_per_wall_second > result.sim_seconds_per_wall_second:
-            result = r
+    result = _best_device_rate(_pure_cfg(SIM_SECONDS), _SALT + 1)
     value = result.sim_seconds_per_wall_second
 
     out = {
@@ -88,33 +135,55 @@ def main() -> None:
         "unit": "sim_s/wall_s",
         "vs_baseline": round(value / REFERENCE_SPEEDUP, 4),
     }
+    configs = {"tgen_mesh_10k_udp": round(value, 4)}
 
     # the MIXED TCP/UDP mesh (north-star config #4's full shape): the
-    # stream tier on device alongside the datagram mesh, at FULL 10k
-    # lanes (the round-2 device fault is fixed; flows complete)
+    # stream tier on device alongside the datagram mesh, at FULL 10k lanes
     if MIXED_HOSTS > 0:
-        pairs = max(MIXED_HOSTS // 100, 1)
-        mixed_cfg = mixed_flagship_config(MIXED_HOSTS, sim_seconds=5)
-        meng = TpuEngine(mixed_cfg, log_capacity=0)
-        mr = meng.run(mode="device", precompile=True,
-                      cache_salt=_SALT + 100)
-        for i in range(max(REPEATS - 1, 0)):
-            r2 = meng.run(mode="device", cache_salt=_SALT + 101 + i)
-            if r2.sim_seconds_per_wall_second > mr.sim_seconds_per_wall_second:
-                mr = r2
+        mr = _best_device_rate(
+            mixed_flagship_config(MIXED_HOSTS, sim_seconds=5), _SALT + 100
+        )
         out["mixed_hosts"] = MIXED_HOSTS
         out["mixed_sim_s_per_wall_s"] = round(
             mr.sim_seconds_per_wall_second, 4
         )
-        out["mixed_stream_pairs"] = pairs
+        out["mixed_stream_pairs"] = max(MIXED_HOSTS // 100, 1)
         out["mixed_stream_flows_done"] = int(
             mr.counters.get("stream_flows_done", 0)
         )
         out["mixed_iters"] = int(mr.counters.get("lane_iters", 0))
+        configs["tgen_mesh_10k_mixed"] = out["mixed_sim_s_per_wall_s"]
+
+    # BASELINE.md ladder configs 1-3 (4 is above, 5 is the managed run)
+    if LADDER:
+        r1 = _best_device_rate(
+            transfer_pair_config(sim_seconds=60), _SALT + 200, repeats=2
+        )
+        configs["transfer_2host"] = round(r1.sim_seconds_per_wall_second, 4)
+        r2 = _best_device_rate(
+            udp_star_config(100, sim_seconds=30), _SALT + 300, repeats=2
+        )
+        configs["udp_star_100"] = round(r2.sim_seconds_per_wall_second, 4)
+        r3 = _best_device_rate(
+            mixed_flagship_config(1000, sim_seconds=10), _SALT + 400,
+            repeats=2,
+        )
+        configs["tgen_mesh_1k_mixed"] = round(
+            r3.sim_seconds_per_wall_second, 4
+        )
+
+    # config #5: the MANAGED relay-chain scenario (real binaries) — the
+    # workload class the reference measured itself on
+    if MANAGED:
+        m = _managed_rate()
+        out.update(m)
+        configs["managed_relay_chains"] = m["managed_sim_s_per_wall_s"]
+
+    out["configs"] = configs
 
     # the OTHER side of the north-star ratio: the PARALLEL CPU backend on
     # the headline workload (shorter sim — the rate is steady-state).
-    # MpCpuEngine forks one worker per core, the honest analog of the
+    # MpCpuEngine spawns one worker per core, the honest analog of the
     # reference's thread-per-core scheduler for pure-model hosts
     if CPU_SIM_SECONDS > 0:
         from shadow_tpu.backend.cpu_mp import MpCpuEngine

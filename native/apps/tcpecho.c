@@ -285,6 +285,23 @@ int main(int argc, char **argv) {
     if (argc >= 7 && strcmp(argv[1], "client") == 0)
         return run_client(argv[2], atoi(argv[3]), atoi(argv[4]), atoi(argv[5]),
                           atoi(argv[6]));
+    if (argc >= 7 && strcmp(argv[1], "hclient") == 0) {
+        /* client mode addressed by NAME through the simulated resolver
+         * (relay-chain scenarios name their guard, like tor clients) */
+        struct addrinfo hints = {0}, *res = NULL;
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        if (getaddrinfo(argv[2], argv[3], &hints, &res) != 0) {
+            printf("hclient resolve %s failed\n", argv[2]);
+            return 1;
+        }
+        char ipbuf[64];
+        struct sockaddr_in *sin = (struct sockaddr_in *)res->ai_addr;
+        inet_ntop(AF_INET, &sin->sin_addr, ipbuf, sizeof(ipbuf));
+        freeaddrinfo(res);
+        return run_client(ipbuf, atoi(argv[3]), atoi(argv[4]), atoi(argv[5]),
+                          atoi(argv[6]));
+    }
     if (argc >= 4 && strcmp(argv[1], "nbclient") == 0)
         return run_nbclient(argv[2], atoi(argv[3]));
     if (argc >= 4 && strcmp(argv[1], "rclient") == 0)

@@ -110,8 +110,6 @@ class MpCpuEngine:
 
     def __init__(self, cfg: ConfigOptions, workers: int = 0) -> None:
         cfg.validate()
-        from ..models.base import _REGISTRY
-
         for hopt in cfg.hosts:
             if hopt.pcap_enabled:
                 raise ValueError(
@@ -119,16 +117,13 @@ class MpCpuEngine:
                     "worker replica would open the capture files); use "
                     "CpuEngine"
                 )
-            for p in hopt.processes:
-                # create_model's dispatch rule without instantiating
-                # thousands of throwaway models: a non-registered path is
-                # the native-shim (managed process) tier
-                if p.path not in _REGISTRY:
-                    raise ValueError(
-                        "MpCpuEngine runs pure-model hosts only; managed "
-                        "OS processes use CpuEngine's threaded scheduler "
-                        "(which genuinely parallelizes them)"
-                    )
+        # Managed (native-shim) hosts are supported: every worker replica
+        # instantiates all ManagedApp objects, but a process LAUNCHES only
+        # when its host's start task executes — and workers execute owned
+        # hosts only, so each OS process, its futex channels, and its
+        # stdout files belong to exactly one worker.  Cross-partition
+        # traffic (TcpSegment/bytes payloads) pickles through the pipes
+        # like any model payload.
         self.cfg = cfg
         self.workers = workers if workers > 0 else (os.cpu_count() or 1)
         self.workers = max(1, min(self.workers, len(cfg.hosts)))

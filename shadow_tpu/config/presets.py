@@ -84,6 +84,81 @@ hosts:
     return cfg
 
 
+def transfer_pair_config(
+    size_bytes: int = 50_000_000, sim_seconds: int = 60, backend: str = "tpu"
+) -> ConfigOptions:
+    """BASELINE config #1: a 2-host client->server transfer over one link
+    (the reference's examples/docs/basic-file-transfer shape), as a
+    lane-TCP stream flow."""
+    return ConfigOptions.from_yaml(f"""
+general:
+  stop_time: {sim_seconds} s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        node [ id 1 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        edge [ source 0 target 1 latency "10 ms" ]
+      ]
+experimental:
+  network_backend: {backend}
+  tpu_lane_queue_capacity: 128
+hosts:
+  c:
+    network_node_id: 0
+    processes:
+      - path: stream-client
+        args: --server s --size {size_bytes}
+  s:
+    network_node_id: 1
+    processes:
+      - path: stream-server
+""")
+
+
+def udp_star_config(
+    n_hosts: int = 100,
+    sim_seconds: int = 10,
+    interval: str = "10ms",
+    size: int = 1428,
+    backend: str = "tpu",
+) -> ConfigOptions:
+    """BASELINE config #2: a UDP-only tgen star — n-1 clients send fixed
+    datagrams to one server host (single switch, no TCP state).  The
+    server lane's queue must hold every in-flight client datagram, so
+    capacity scales with the fan-in (the clients all fire each interval)."""
+    capacity = max(64, 2 * n_hosts)
+    return ConfigOptions.from_yaml(f"""
+general:
+  stop_time: {sim_seconds} s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+      ]
+experimental:
+  network_backend: {backend}
+  tpu_lane_queue_capacity: {capacity}
+hosts:
+  srv:
+    network_node_id: 0
+    processes:
+      - path: tgen-server
+  cli:
+    count: {n_hosts - 1}
+    network_node_id: 0
+    processes:
+      - path: tgen-client
+        args: --server srv --interval {interval} --size {size}
+""")
+
+
 def mixed_flagship_config(
     n_hosts: int, sim_seconds: int = 5, backend: str = "tpu"
 ) -> ConfigOptions:

@@ -90,7 +90,16 @@ class Dns:
         return "".join(lines)
 
     def write_hosts_file(self, path: str | Path) -> Path:
+        """Atomic (tmp + rename): MpCpuEngine worker replicas all write
+        this file concurrently while other workers' managed processes may
+        be resolving through it — a truncate-then-write would expose an
+        empty file mid-write.  Every replica writes identical bytes, so
+        the last rename is a no-op content-wise."""
+        import os
+
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
-        path.write_text(self.hosts_file())
+        tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+        tmp.write_text(self.hosts_file())
+        os.replace(tmp, path)
         return path
