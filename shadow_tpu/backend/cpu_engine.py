@@ -41,6 +41,13 @@ DROP_QUEUE = 3
 
 OUTCOME_NAMES = {0: "delivered", 1: "loss", 2: "codel", 3: "queue"}
 
+# the loopback interface's fixed one-way delay (the reference gives every
+# host a localhost/internet interface pair, namespace.rs:25-60; here lo
+# is a latency-only serial law: no token buckets, no CoDel, no loss —
+# self-addressed 127/8 traffic from managed stacks rides it)
+LOOPBACK_LATENCY_NS = 10_000
+LOOPBACK_IP = "127.0.0.1"
+
 
 @dataclasses.dataclass
 class LogRecord:
@@ -116,8 +123,10 @@ class Host:
     def num_hosts(self) -> int:
         return len(self.engine.hosts)
 
-    def send(self, dst: int, size_bytes: int, payload: object = None) -> int:
-        return self.engine.send_packet(self, dst, size_bytes, payload)
+    def send(self, dst: int, size_bytes: int, payload: object = None,
+             loopback: bool = False) -> int:
+        return self.engine.send_packet(self, dst, size_bytes, payload,
+                                       loopback=loopback)
 
     def set_timer(self, t_abs_ns: int) -> None:
         app = self._current_app
@@ -378,8 +387,11 @@ class CpuEngine:
         return seq, max(t_dep + lat_ns, self.window_end)
 
     def send_packet(
-        self, src_host: Host, dst: int, size_bytes: int, payload: object = None
+        self, src_host: Host, dst: int, size_bytes: int,
+        payload: object = None, loopback: bool = False,
     ) -> int:
+        if loopback:
+            return self._loopback_send(src_host, size_bytes, payload)
         seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload)
         if arr is None:
             return seq
@@ -393,6 +405,38 @@ class CpuEngine:
         else:
             with dst_host.inbox_lock:
                 dst_host.inbox.append(ev)
+        return seq
+
+    def _loopback_send(self, host: Host, size_bytes: int,
+                       payload: object) -> int:
+        """The lo interface: self-addressed (127/8) traffic takes a
+        dedicated serial lifecycle — fixed LOOPBACK_LATENCY_NS, no token
+        buckets, no CoDel, no loss draw (the localhost half of the
+        reference's per-host interface pair, namespace.rs:25-60).  The
+        delivery never leaves the host, so it works identically under
+        the threaded, multiprocessing, and hybrid engines."""
+        seq = host.send_seq
+        host.send_seq += 1
+        t_deliver = host.now + LOOPBACK_LATENCY_NS
+        host.log_buf.append(
+            LogRecord(t_deliver, host.host_id, host.host_id, seq,
+                      size_bytes, DELIVERED)
+        )
+        if host.pcap is not None:
+            host.pcap.capture(
+                stime.sim_to_emu(t_deliver), LOOPBACK_IP, LOOPBACK_IP,
+                size_bytes, payload,
+                key=(0, host.host_id, host.host_id, seq),
+            )
+        host.queue.push(
+            Event(
+                t_deliver,
+                EventKind.DELIVERY,
+                src_host=host.host_id,
+                seq=seq,
+                data=Delivery(host.host_id, seq, size_bytes, payload),
+            )
+        )
         return seq
 
     def inbound(self, dst_host: Host, ev: Event) -> None:

@@ -108,13 +108,17 @@ class HybridEngine(CpuEngine):
 
     # -- host-side packet source half (the law IS CpuEngine's) -------------
 
-    def send_packet(self, src_host, dst, size_bytes, payload=None):
+    def send_packet(self, src_host, dst, size_bytes, payload=None,
+                    loopback=False):
         """The shared source half (``CpuEngine._packet_source_half``: up
         bucket, outbound pcap, dynamic-runahead record, Bernoulli loss)
         with a device-injection sink: the surviving packet is STAGED for
         the device instead of pushed into a host queue — the dst half
         (down bucket, CoDel, delivery) runs on the device for every lane,
-        external ones included."""
+        external ones included.  Loopback traffic never touches the
+        device: the lo interface is host-local by definition."""
+        if loopback:
+            return self._loopback_send(src_host, size_bytes, payload)
         seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload)
         if arr is None:
             return seq

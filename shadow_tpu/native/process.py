@@ -521,9 +521,14 @@ class ManagedApp:
     ) -> None:
         """A UDP datagram arrived on the host (TCP segments go to the host
         stack directly and surface through socket callbacks instead)."""
-        if payload is None or not isinstance(payload, tuple) or len(payload) != 3:
+        if (
+            payload is None
+            or not isinstance(payload, tuple)
+            or len(payload) not in (3, 4)
+        ):
             return
-        src_port, dst_port, data = payload
+        src_port, dst_port, data = payload[:3]
+        via_lo = len(payload) == 4 and payload[3]
         owner = self._host_ports(api).get(dst_port)
         if owner is None:
             # count once per datagram, not once per sibling app
@@ -533,7 +538,8 @@ class ManagedApp:
         app, sock = owner
         if app is not self or self.finished:
             return
-        src_ip_be = _ip_to_be(api.ip_of(src))
+        # a lo datagram's source address is 127.0.0.1, like Linux
+        src_ip_be = _ip_to_be("127.0.0.1" if via_lo else api.ip_of(src))
         sock.queue.append((src_ip_be, src_port, data))
         api.count("udp_rx_bytes", len(data))
         self._socket_activity_obj(api, sock)
@@ -1614,11 +1620,16 @@ class ManagedApp:
                 ret = -EALREADY
             self._reply(api, "connect", ret)
             return True
-        dst = api.net._host_for_ip(_shim_ip_to_u32be(ip_be))
+        from ..net.stack import is_loopback_u32
+
+        ip_u32 = _shim_ip_to_u32be(ip_be)
+        lo = is_loopback_u32(ip_u32)
+        dst = api.net._host_for_ip(ip_u32)
         if dst is None:
             self._reply(api, "connect", -EHOSTUNREACH)
             return True
-        sock.sim = api.net.connect(dst, port, src_port=sock.port)
+        sock.sim = api.net.connect(dst, port, src_port=sock.port,
+                                   loopback=lo)
         sock.sim.on_event = lambda s, now, vs=sock: self._tcp_event_obj(api, vs)
         api.count("managed_tcp_connects")
         if nonblock:
@@ -1743,10 +1754,17 @@ class ManagedApp:
             ip_be, port = sock.default_dst
         from ..net.dns import DnsError
 
-        try:
-            dst = api.resolve(_be_to_ip(ip_be))
-        except DnsError:
-            dst = None
+        from ..net.stack import is_loopback_u32
+
+        ipstr = _be_to_ip(ip_be)
+        lo = is_loopback_u32(_shim_ip_to_u32be(ip_be))
+        if lo:
+            dst = api.host_id
+        else:
+            try:
+                dst = api.resolve(ipstr)
+            except DnsError:
+                dst = None
         if sock.port is None:  # auto-bind an ephemeral source port
             sock.port = self._alloc_port(api)
             self._host_ports(api)[sock.port] = (self, sock)
@@ -1758,7 +1776,9 @@ class ManagedApp:
             api.count("udp_external_drops")
             self._reply(api, "sendto", len(data))
             return
-        api.send(dst, len(data) + UDP_HEADER_BYTES, payload=(sock.port, port, data))
+        payload = (sock.port, port, data, True) if lo else (sock.port, port, data)
+        api.send(dst, len(data) + UDP_HEADER_BYTES, payload=payload,
+                 loopback=lo)
         api.count("udp_tx_bytes", len(data))
         self._reply(api, "sendto", len(data))
 

@@ -43,6 +43,13 @@ from ..transport.tcp import (
 IP_HEADER_BYTES = 20
 TCP_HEADER_BYTES = 20  # simulated wire overhead per segment
 EPHEMERAL_PORT_START = 49152
+LOOPBACK_U32 = 0x7F000001  # 127.0.0.1 (the simulated lo interface)
+
+
+def is_loopback_u32(ip_u32: int) -> bool:
+    """Any 127/8 address rides the simulated lo interface (the single
+    predicate every tier uses — stack routing, managed connect/sendto)."""
+    return (ip_u32 >> 24) == 127
 
 
 @dataclasses.dataclass
@@ -149,14 +156,21 @@ class HostNetStack:
         dst_port: int,
         src_port: Optional[int] = None,
         config: Optional[TcpConfig] = None,
+        loopback: bool = False,
     ) -> SimTcpSocket:
-        """Active open to (dst_host, dst_port); segments start flowing now."""
+        """Active open to (dst_host, dst_port); segments start flowing now.
+        ``loopback`` addresses the connection 127.0.0.1 -> 127.0.0.1 (both
+        ends, like Linux) and rides the lo interface lifecycle."""
         import socket as pysocket
 
-        dst_ip = int.from_bytes(
-            pysocket.inet_aton(self.host.ip_of(dst_host)), "big"
-        )
-        local = (self._my_ip(), src_port or self._alloc_port())
+        if loopback:
+            dst_ip = LOOPBACK_U32
+            local = (LOOPBACK_U32, src_port or self._alloc_port())
+        else:
+            dst_ip = int.from_bytes(
+                pysocket.inet_aton(self.host.ip_of(dst_host)), "big"
+            )
+            local = (self._my_ip(), src_port or self._alloc_port())
         tcp = TcpState(config or self._default_config())
         iss = self.host.rand_u32()
         tcp.connect(local, (dst_ip, dst_port), iss=iss, now=self.host.now)
@@ -250,9 +264,12 @@ class HostNetStack:
         if dst is None:
             self.host.count("tcp_no_route_drops")
             return
-        self.host.send(dst, seg.wire_size, payload=seg)
+        self.host.send(dst, seg.wire_size, payload=seg,
+                       loopback=is_loopback_u32(hdr.dst_ip))
 
     def _host_for_ip(self, ip_u32: int) -> Optional[int]:
+        if is_loopback_u32(ip_u32):  # the lo interface
+            return self.host.host_id
         import socket as pysocket
 
         ip = pysocket.inet_ntoa(ip_u32.to_bytes(4, "big"))

@@ -1198,7 +1198,10 @@ class TcpListener:
         if len(self.children) >= self.backlog:
             return None  # SYN dropped; the client's RTO will retry
         child = TcpState(dataclasses.replace(self.cfg))
-        child.local_ip, child.local_port = self.local
+        # the child's local address is the SYN's destination (a listener
+        # on INADDR_ANY accepts on whichever interface the SYN targeted —
+        # so loopback connections get a 127.0.0.1 local end, like Linux)
+        child.local_ip, child.local_port = hdr.dst_ip, hdr.dst_port
         child.remote_ip, child.remote_port = key
         child._set_iss(iss)
         if child.cfg.window_scaling and hdr.wscale is not None:
