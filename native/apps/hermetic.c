@@ -12,8 +12,11 @@
 #include <sched.h>
 #include <stdio.h>
 #include <string.h>
+#include <sys/resource.h>
 #include <sys/stat.h>
+#include <sys/statfs.h>
 #include <sys/sysinfo.h>
+#include <sys/times.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -111,6 +114,21 @@ int main(int argc, char **argv) {
     if (sysinfo(&si) == 0)
         printf("sysinfo=up:%ld,load:%lu,ram:%llu,procs:%u\n", si.uptime,
                si.loads[0], (unsigned long long)si.totalram, si.procs);
+
+    /* 5b. statfs / getrusage / times: more host-state observables */
+    struct statfs sf;
+    if (statfs(".", &sf) == 0)
+        printf("statfs=blocks:%llu,bfree:%llu\n",
+               (unsigned long long)sf.f_blocks,
+               (unsigned long long)sf.f_bfree);
+    struct rusage ru;
+    if (getrusage(RUSAGE_SELF, &ru) == 0)
+        printf("rusage=ut:%ld.%06ld,maxrss:%ld\n",
+               (long)ru.ru_utime.tv_sec, (long)ru.ru_utime.tv_usec,
+               ru.ru_maxrss);
+    struct tms tb;
+    long tk = (long)times(&tb);
+    printf("times=ret:%ld,ut:%ld\n", tk, (long)tb.tms_utime);
 
     /* 6. affinity: the modeled CPU set */
     cpu_set_t cs;

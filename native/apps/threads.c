@@ -231,12 +231,81 @@ static int run_udp(const char *ip, int port, int count) {
     return rv == NULL ? 0 : 1;
 }
 
+/* -- churn: 100+ thread create/join/detach waves with signals in
+ * flight — the glibc-runtime stand-in for the reference's Go gate
+ * (src/test/golang/: goroutine churn + signals; no Go toolchain in this
+ * image, so the same pressure is applied at the pthread layer) -------- */
+
+#include <signal.h>
+
+static volatile sig_atomic_t usr1_count;
+
+static void on_usr1(int sig) {
+    (void)sig;
+    usr1_count++;
+}
+
+static void *churn_worker(void *arg) {
+    long idx = (long)(intptr_t)arg;
+    pthread_mutex_lock(&lock);
+    counter++;
+    pthread_mutex_unlock(&lock);
+    if (idx % 5 == 0) kill(getpid(), SIGUSR1); /* signal in flight */
+    usleep(500 + (idx % 7) * 100);
+    pthread_mutex_lock(&lock);
+    counter++;
+    pthread_mutex_unlock(&lock);
+    return (void *)(intptr_t)idx;
+}
+
+static int run_churn(int waves, int per_wave) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = on_usr1;
+    sigaction(SIGUSR1, &sa, NULL);
+    long created = 0;
+    if (per_wave > 64) per_wave = 64;
+    for (int w = 0; w < waves; w++) {
+        pthread_t th[64];
+        for (int i = 0; i < per_wave; i++) {
+            if (pthread_create(&th[i], NULL, churn_worker,
+                               (void *)(intptr_t)(w * per_wave + i)) != 0) {
+                printf("churn create failed w=%d i=%d\n", w, i);
+                return 1;
+            }
+            created++;
+        }
+        /* odd waves detach odd threads; everything else is joined with
+         * its return value checked (both retirement paths under load) */
+        for (int i = 0; i < per_wave; i++) {
+            if ((w & 1) && (i & 1)) {
+                pthread_detach(th[i]);
+            } else {
+                void *rv = NULL;
+                if (pthread_join(th[i], &rv) != 0 ||
+                    (long)(intptr_t)rv != (long)(w * per_wave + i)) {
+                    printf("churn join failed w=%d i=%d\n", w, i);
+                    return 1;
+                }
+            }
+        }
+        usleep(2000); /* let detached workers retire across sim time */
+    }
+    usleep(50000);
+    printf("churn done threads=%ld counter=%ld usr1=%d\n", created, counter,
+           (int)usr1_count);
+    return 0;
+}
+
 int main(int argc, char **argv) {
     setvbuf(stdout, NULL, _IOLBF, 0);
     if (argc < 2) {
-        fprintf(stderr, "usage: threads <pool|prodcons|sem|timed|mainexit|udp>\n");
+        fprintf(stderr, "usage: threads <pool|prodcons|sem|timed|mainexit|udp|churn>\n");
         return 2;
     }
+    if (strcmp(argv[1], "churn") == 0)
+        return run_churn(argc > 2 ? atoi(argv[2]) : 8,
+                         argc > 3 ? atoi(argv[3]) : 16);
     if (strcmp(argv[1], "pool") == 0) return run_pool();
     if (strcmp(argv[1], "prodcons") == 0) return run_prodcons();
     if (strcmp(argv[1], "sem") == 0) return run_sem();

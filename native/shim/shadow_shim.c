@@ -1088,6 +1088,27 @@ static int is_vfd(int fd) {
 
 /* Reserve a real kernel fd slot for a simulated socket so the number can't
  * collide with the plugin's own fds. */
+/* one-time operator-visible warning when a compile-time table cap is
+ * hit — the errno alone (EMFILE/ENOSPC) is correct but easy to miss in
+ * an app that retries quietly */
+static void cap_warn(int id, const char *what, int cap) {
+    static unsigned warned; /* one bit per distinct cap */
+    if (!(warned & (1u << id))) {
+        warned |= 1u << id;
+        /* raw write: reachable from the SIGSYS capture path, where
+         * stdio/malloc locks may be held by the interrupted code */
+        char buf[160];
+        int n = snprintf(buf, sizeof(buf),
+                         "shadow-shim: %s capacity (%d) exhausted - raise "
+                         "the compile-time cap in shadow_shim.c\n", what,
+                         cap);
+        if (n > 0)
+            shim_raw_syscall6(SYS_write, 2, (long)buf,
+                              n < (int)sizeof(buf) ? n : (int)sizeof(buf),
+                              0, 0, 0);
+    }
+}
+
 static int reserve_fd(void) {
     /* O_PATH: every uninterposed data syscall on the reservation (readv,
      * recvmsg, a dup...) fails loudly with EBADF instead of reading
@@ -1096,6 +1117,7 @@ static int reserve_fd(void) {
     if (fd < 0) return -1;
     if (fd >= SHIM_MAX_FDS) {
         real_close(fd);
+        cap_warn(0, "fd table (SHIM_MAX_FDS)", SHIM_MAX_FDS);
         errno = EMFILE;
         return -1;
     }
@@ -1634,9 +1656,46 @@ static void maybe_yield(int fd, short events, int dontwait) {
         pipe_wait(fd, events);
 }
 
+/* AF_UNIX bytes ride a native socket under engine-scheduled blocking;
+ * sizing its kernel buffers from the CONFIG (socket_send_buffer /
+ * socket_recv_buffer) makes the backpressure point simulation-controlled
+ * instead of a host default — the buffer-accounting half of the
+ * reference's unix.rs (its bandwidth model remains native: local IPC is
+ * memory-speed there too) */
+static void unix_size_buffers(int fd) {
+    if (fd < 0 || !g_shm) return;
+    /* the kernel DOUBLES setsockopt buffer values (for bookkeeping
+     * overhead), so pass half to land the actual backpressure point at
+     * the configured size; values below the kernel floor (~4.5 KiB) are
+     * clamped by the kernel */
+    int v = (int)(g_shm->sock_sndbuf / 2);
+    if (v > 0)
+        real_setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &v, sizeof(v));
+    v = (int)(g_shm->sock_rcvbuf / 2);
+    if (v > 0)
+        real_setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &v, sizeof(v));
+}
+
+int socketpair(int domain, int type, int protocol, int sv[2]) {
+    if (!real_socket) resolve_reals();
+    static int (*real_sp)(int, int, int, int[2]);
+    if (!real_sp) *(void **)&real_sp = dlsym(RTLD_NEXT, "socketpair");
+    int r = real_sp(domain, type, protocol, sv);
+    if (r == 0 && g_ready && domain == AF_UNIX) {
+        unix_size_buffers(sv[0]);
+        unix_size_buffers(sv[1]);
+    }
+    return r;
+}
+
 int socket(int domain, int type, int protocol) {
     if (!real_socket) resolve_reals();
     int base_type = type & ~(SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (g_ready && domain == AF_UNIX) {
+        int fd = real_socket(domain, type, protocol);
+        unix_size_buffers(fd);
+        return fd;
+    }
     if (g_ready && domain == AF_NETLINK && protocol == NETLINK_ROUTE) {
         int fd = reserve_fd();
         if (fd < 0) return -1;
@@ -2455,6 +2514,8 @@ int epoll_ctl(int epfd, int op, int fd, struct epoll_event *event) {
                 return -1;
             }
             if (n >= EPOLL_MAX_REGS) {
+                cap_warn(1, "epoll registration table (EPOLL_MAX_REGS)",
+                         EPOLL_MAX_REGS);
                 errno = ENOSPC;
                 return -1;
             }
@@ -4312,6 +4373,8 @@ static long shim_futex_emu(long uaddr, long op, long val, long timeout,
  * the reference has — documented limitation. */
 
 #include <sys/sysinfo.h>
+#include <sys/statfs.h>
+#include <sys/times.h>
 
 #define SHIM_SIM_EPOCH_NS 946684800000000000ull /* 2000-01-01T00:00:00Z */
 
@@ -5082,6 +5145,8 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
             mask[0] = 1; /* the modeled single CPU (vdso_repl_getcpu) */
             return (long)sizeof(unsigned long);
         }
+        case SYS_socketpair:
+            WRAPRET(socketpair((int)a1, (int)a2, (int)a3, (int *)a4));
         case SYS_open: {
             long fd = maybe_open_proc_uptime((const char *)a1);
             if (fd >= 0) return fd;
@@ -5098,6 +5163,70 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
             long r = shim_raw_syscall6(nr, a1, a2, a3, a4, a5, a6);
             if (r > 0) meta_note_write((int)a1);
             return r;
+        }
+        case SYS_statfs:
+        case SYS_fstatfs: {
+            /* filesystem stats are host state (free space changes run to
+             * run): answer fixed modeled figures after the real call
+             * proves the path/fd valid */
+            long r = shim_raw_syscall6(nr, a1, a2, 0, 0, 0, 0);
+            if (r == 0 && g_shm) {
+                struct statfs *sf = (struct statfs *)a2;
+                sf->f_type = 0x01021994; /* TMPFS_MAGIC */
+                sf->f_bsize = sf->f_frsize = 4096;
+                sf->f_blocks = (16ull << 30) / 4096;
+                sf->f_bfree = sf->f_bavail = (8ull << 30) / 4096;
+                sf->f_files = 1 << 20;
+                sf->f_ffree = 1 << 19;
+                memset(&sf->f_fsid, 0, sizeof(sf->f_fsid));
+            }
+            return r;
+        }
+        case SYS_getrusage: {
+            if (!g_shm) break;
+            struct rusage *ru = (struct rusage *)a2;
+            int who = (int)a1;
+            if (who != RUSAGE_SELF && who != RUSAGE_CHILDREN &&
+                who != RUSAGE_THREAD)
+                return -EINVAL;
+            if (!ru) return -EFAULT;
+            memset(ru, 0, sizeof(*ru));
+            /* SELF/THREAD: CPU time on the modeled clock (the CPU
+             * model's syscall latencies are folded into sim time);
+             * CHILDREN: zeros (child accounting is not modeled).
+             * Fixed modeled maxrss either way. */
+            if (who != RUSAGE_CHILDREN) {
+                uint64_t up = sim_now_ns() - SHIM_SIM_EPOCH_NS;
+                ru->ru_utime.tv_sec = (time_t)(up / 1000000000ull);
+                ru->ru_utime.tv_usec =
+                    (suseconds_t)((up % 1000000000ull) / 1000);
+            }
+            ru->ru_maxrss = 16384; /* KiB */
+            return 0;
+        }
+        case SYS_times: {
+            if (!g_shm) break;
+            struct tms *tb = (struct tms *)a1;
+            uint64_t up = sim_now_ns() - SHIM_SIM_EPOCH_NS;
+            long ticks = (long)(up / (1000000000ull / 100)); /* HZ=100 */
+            if (tb) {
+                tb->tms_utime = ticks;
+                tb->tms_stime = 0;
+                tb->tms_cutime = 0;
+                tb->tms_cstime = 0;
+            }
+            return ticks;
+        }
+        case SYS_sched_setaffinity: {
+            /* the modeled host has one CPU (cpu 0): masks that include
+             * it are accepted and ignored; masks that exclude it answer
+             * EINVAL exactly like a real 1-CPU kernel */
+            if (!g_shm) break;
+            size_t len = (size_t)a2;
+            const unsigned long *mask = (const unsigned long *)a3;
+            if (!mask || len < sizeof(unsigned long)) return -EINVAL;
+            if (!(mask[0] & 1ul)) return -EINVAL;
+            return 0;
         }
         default:
             *handled = 0;

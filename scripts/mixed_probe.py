@@ -28,6 +28,14 @@ if os.environ.get("PROBE_K"):
     cfg.experimental.tpu_events_per_round = int(os.environ["PROBE_K"])
 if os.environ.get("PROBE_CROSS"):
     cfg.experimental.tpu_cross_capacity = int(os.environ["PROBE_CROSS"])
+if os.environ.get("PROBE_SPOPS"):
+    cfg.experimental.tpu_stream_events_per_round = int(
+        os.environ["PROBE_SPOPS"]
+    )
+if os.environ.get("PROBE_SCAP"):
+    cfg.experimental.tpu_stream_queue_capacity = int(os.environ["PROBE_SCAP"])
+if os.environ.get("PROBE_UNROLL"):
+    cfg.experimental.tpu_round_unroll = int(os.environ["PROBE_UNROLL"])
 
 eng = TpuEngine(cfg, log_capacity=0)
 t0 = time.perf_counter()

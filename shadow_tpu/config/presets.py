@@ -164,14 +164,22 @@ def mixed_flagship_config(
 ) -> ConfigOptions:
     """The MIXED TCP/UDP mesh at its north-star tuning (the bench's and
     the probe/HLO scripts' single source of truth): 1 stream pair per 100
-    hosts streaming 2 MB across the datagram mesh."""
+    hosts streaming 2 MB across the datagram mesh.
+
+    Tuning (measured on v5e, round-5 probes — UTIL_r05.json is the
+    ground truth): with the TIERED stream backend the [N] side needs
+    only the pure mesh's queue shape (capacity 16, 2 pops/iter — the
+    pre-tier 48/4 was paying ~46% extra per iteration), and the tier
+    drains at 16 events/iter (8 left ~60% more iterations per window;
+    24 made each iteration dearer than the iterations it saved)."""
     cfg = flagship_mesh_config(
-        n_hosts, sim_seconds=sim_seconds, queue_capacity=48,
-        pops_per_round=4, stream_pairs=max(n_hosts // 100, 1),
+        n_hosts, sim_seconds=sim_seconds, queue_capacity=16,
+        pops_per_round=2, stream_pairs=max(n_hosts // 100, 1),
         stream_bytes=2_000_000, backend=backend,
     )
     # one-to-one pairing puts stream arrivals on the split exchange, so
     # the main cross block only carries the mesh's permutation spray
     # (strict mode would raise if this ever overflowed)
     cfg.experimental.tpu_cross_capacity = 8
+    cfg.experimental.tpu_stream_events_per_round = 16
     return cfg

@@ -115,6 +115,20 @@ def test_affinity_reports_modeled_cpu_set(tmp_path):
     assert vals["cpus"] == "1"
 
 
+def test_statfs_rusage_times_virtualized(tmp_path):
+    vals = _run(tmp_path, "g")
+    # fixed modeled filesystem (free space cannot vary run to run)
+    assert vals["statfs"] == (
+        f"blocks:{(16 << 30) // 4096},bfree:{(8 << 30) // 4096}"
+    )
+    # rusage/times on the modeled clock: bounded by sim elapsed (< 2 s)
+    ut = float(vals["rusage"].split(",")[0].split(":")[1])
+    assert 0 <= ut < 2.0
+    assert vals["rusage"].endswith("maxrss:16384")
+    ticks = int(vals["times"].split(",")[0].split(":")[1])
+    assert 0 <= ticks < 200  # HZ=100, < 2 sim-seconds
+
+
 def test_deterministic_across_wall_time(tmp_path):
     v1 = _run(tmp_path, "r1")
     time.sleep(1.1)  # move wall clock between runs

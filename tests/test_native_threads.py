@@ -114,6 +114,20 @@ hosts:
     assert not result.process_errors
 
 
+def test_thread_churn_with_signals(tmp_path):
+    """128 threads in create/join/detach waves with SIGUSR1s in flight
+    (the pthread stand-in for the reference's Go-runtime gate,
+    src/test/golang/): every thread runs both halves, joins check return
+    values, and signal delivery is deterministic."""
+    result, out = _run_mode(tmp_path, "churn", stop="60s")
+    assert "churn done threads=128 counter=256" in out, out
+    assert "usr1=" in out
+    assert int(out.split("usr1=")[1].split()[0]) > 0
+    assert result.counters["managed_threads"] >= 128
+    r2, out2 = _run_mode(tmp_path / "again", "churn", stop="60s")
+    assert out == out2
+
+
 def test_thread_determinism(tmp_path):
     """Same seed, two runs: bit-identical plugin output including the
     simulated timestamps (the determinism gate of SURVEY.md §4)."""

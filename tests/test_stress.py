@@ -78,6 +78,24 @@ hosts:
     )
 
 
+def test_stress_thread_churn(tmp_path):
+    """128 glibc threads in create/join/detach waves with SIGUSR1s in
+    flight (the pthread-layer stand-in for the reference's Go gate,
+    src/test/golang/): REPEATS identical runs."""
+    _repeat_identical(
+        f"""
+general: {{stop_time: 60s, seed: 5, data_directory: {tmp_path / 'd'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'threads'}
+        args: [churn, "8", "16"]
+"""
+    )
+
+
 def test_stress_unix_sockets(tmp_path):
     """Unix-domain IPC ordering (socket/unix.rs analog): the bytes ride a
     native socketpair, but blocking order is engine-scheduled (sim-yield
