@@ -27,6 +27,20 @@
 
 #define SHIM_ABI_MAGIC 0x53485457534d4833ull /* "SHTWSMH3" */
 #define SHIM_PAYLOAD_MAX 65536
+/* zero-syscall staging arena: large transfer payloads ride this shared
+ * region instead of process_vm_readv/writev round-trips (the capability
+ * of the reference's opt-in MemoryMapper, memory_mapper.rs:30-50,
+ * re-designed fork-safe: the arena lives in each process's/thread's own
+ * channel file, so children get fresh ones via PREFORK).  Access is
+ * turn-serialized exactly like the message frames. */
+#define SHIM_ARENA_SIZE (1 << 20)
+/* per-turn staging clamp (both sides MUST agree: a reply shorter than
+ * the request means buffer-full, never manager-side truncation) */
+#define SHIM_ARENA_CHUNK (256 << 10)
+/* args[4] sentinel: "the payload is in the channel arena" (page 0 is
+ * never a valid plugin buffer address, so it cannot collide with the
+ * direct-memory mode's pointer values) */
+#define SHIM_VM_ARENA 1
 
 /* plugin -> shadow ops.  Unless noted, replies carry ret = result or
  * -errno.  "nb" args request EAGAIN instead of parking the plugin. */
@@ -186,6 +200,7 @@ typedef struct {
                                   pending until the app unblocks it */
     shim_msg to_shadow;        /* plugin -> manager */
     shim_msg to_shim;          /* manager -> plugin */
+    uint8_t arena[SHIM_ARENA_SIZE]; /* zero-syscall staging (see above) */
 } shim_shmem;
 
 #endif /* SHADOW_SHIM_ABI_H */

@@ -1677,15 +1677,19 @@ static void unix_size_buffers(int fd) {
 }
 
 int socketpair(int domain, int type, int protocol, int sv[2]) {
-    if (!real_socket) resolve_reals();
-    static int (*real_sp)(int, int, int, int[2]);
-    if (!real_sp) *(void **)&real_sp = dlsym(RTLD_NEXT, "socketpair");
-    int r = real_sp(domain, type, protocol, sv);
-    if (r == 0 && g_ready && domain == AF_UNIX) {
+    /* raw syscall, NOT libc: this wrapper is reached from the SUD
+     * dispatcher too, where a libc call's syscall insn would re-trap */
+    long r = shim_raw_syscall6(SYS_socketpair, domain, type, protocol,
+                               (long)sv, 0, 0);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    if (g_ready && domain == AF_UNIX) {
         unix_size_buffers(sv[0]);
         unix_size_buffers(sv[1]);
     }
-    return r;
+    return 0;
 }
 
 int socket(int domain, int type, int protocol) {
@@ -1792,6 +1796,15 @@ int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
     return accept4(fd, addr, alen, 0);
 }
 
+/* SHADOW_TPU_NO_ARENA=1 opts large transfers out of the shared arena
+ * (falling back to the process_vm MemoryCopier mode) — primarily for
+ * exercising that path in tests */
+static int arena_enabled(void) {
+    static int v = -1;
+    if (v < 0) v = getenv("SHADOW_TPU_NO_ARENA") == NULL;
+    return v;
+}
+
 static ssize_t vfd_sendto(int fd, const void *buf, size_t n, int flags,
                           uint32_t ip, uint16_t port) {
     int nb = vfd_nonblock[fd] || (flags & MSG_DONTWAIT);
@@ -1804,11 +1817,38 @@ static ssize_t vfd_sendto(int fd, const void *buf, size_t n, int flags,
         return (ssize_t)ret_errno(shim_call(SHIM_OP_SENDTO, args, buf,
                                             (uint32_t)n, NULL, NULL, NULL));
     }
-    /* stream, large buffer: pass (addr, len) and let the manager copy
-     * straight out of our memory with process_vm_readv (the reference's
-     * MemoryCopier) — one exchange instead of len/64Ki round-trips.  The
-     * manager answers -EOPNOTSUPP when the kernel forbids cross-process
-     * reads (ptrace scope); fall back to chunking then. */
+    /* stream, large buffer, preferred path: stage through the channel's
+     * shared ARENA — one in-process memcpy, ZERO syscalls, no ptrace
+     * dependence (the reference MemoryMapper's capability, re-shaped:
+     * the mapping is the per-process channel file both sides hold).
+     * SHADOW_TPU_NO_ARENA=1 opts out, leaving the process_vm
+     * (MemoryCopier) mode below as the large-transfer path. */
+    if (arena_enabled() && n > SHIM_PAYLOAD_MAX) {
+        shim_shmem *shm = cur_shm();
+        size_t done = 0;
+        /* SHIM_ARENA_CHUNK per turn: a nonblocking writer retrying a
+         * full buffer must not pay a 1 MiB stage per EAGAIN (same
+         * rationale as the direct-memory mode's clamp) */
+        while (done < n) {
+            size_t chunk = n - done;
+            if (chunk > SHIM_ARENA_CHUNK) chunk = SHIM_ARENA_CHUNK;
+            memcpy(shm->arena, (const char *)buf + done, chunk);
+            int64_t args[6] = {fd, (int64_t)ip, port, nb, SHIM_VM_ARENA,
+                               (int64_t)chunk};
+            int64_t ret = shim_call(SHIM_OP_SENDTO, args, NULL, 0, NULL,
+                                    NULL, NULL);
+            if (ret < 0) {
+                if (done > 0) return (ssize_t)done;
+                errno = (int)-ret;
+                return -1;
+            }
+            done += (size_t)ret;
+            if (nb && (size_t)ret < chunk) break; /* buffer full */
+        }
+        return (ssize_t)done;
+    }
+    /* (addr, len) direct-memory mode: process_vm_readv — the reference's
+     * MemoryCopier — used when the arena is opted out */
     static int g_vmcopy_off;
     if (!g_vmcopy_off && n > SHIM_PAYLOAD_MAX) {
         /* matches the manager's staging clamp exactly: a reply shorter
@@ -1872,6 +1912,33 @@ static ssize_t vfd_recvfrom(int fd, void *buf, size_t n, int flags,
      * one per 64 KiB frame.  -EOPNOTSUPP on the first try means the
      * kernel forbids cross-process writes: fall back to frames for the
      * process's lifetime, like the send side. */
+    /* stream, large consuming read, preferred path: the manager stages
+     * the bytes in the channel ARENA and the shim memcpys them out —
+     * zero syscalls (see vfd_sendto) */
+    if (arena_enabled() && vfd_stream[fd] && !peek && n > SHIM_PAYLOAD_MAX) {
+        shim_shmem *shm = cur_shm();
+        for (;;) {
+            size_t want = n - off;
+            if (want > SHIM_ARENA_CHUNK) want = SHIM_ARENA_CHUNK;
+            int64_t args[6] = {fd, (int64_t)want, nb, peek, SHIM_VM_ARENA,
+                               0};
+            int64_t reply[6];
+            int64_t ret = shim_call(SHIM_OP_RECVFROM, args, NULL, 0, NULL,
+                                    NULL, reply);
+            if (ret < 0) {
+                if (off > 0) return (ssize_t)off;
+                errno = (int)-ret;
+                return -1;
+            }
+            if (off == 0)
+                fill_sockaddr(addr, alen, (uint32_t)reply[1],
+                              (uint16_t)reply[2]);
+            memcpy((char *)buf + off, shm->arena, (size_t)ret);
+            off += (size_t)ret;
+            if (ret == 0 || off >= n || !waitall) break;
+        }
+        return (ssize_t)off;
+    }
     static int g_vmwrite_off;
     if (!g_vmwrite_off && vfd_stream[fd] && !peek && n > SHIM_PAYLOAD_MAX) {
         const size_t VMCHUNK = 256u << 10;

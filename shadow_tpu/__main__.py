@@ -114,9 +114,13 @@ def main(argv: list[str] | None = None) -> int:
         print(f"config error: {e}", file=sys.stderr)
         return 2
 
-    logging.basicConfig(
+    # async buffered logging (the reference's logger crate: records are
+    # queued by the emitting thread, formatted+written by a listener
+    # thread, each line prefixed with the simulated clock)
+    from shadow_tpu.utils.shadow_log import install_async_logging
+
+    install_async_logging(
         level=getattr(logging, cfg.general.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s [%(name)s] %(message)s",
         stream=sys.stderr,
     )
     if ns.show_config:

@@ -55,6 +55,23 @@ class Simulation:
         cfg = self.cfg
         backend = cfg.experimental.network_backend
         t0 = time.perf_counter()
+        # the async logger's sim-time prefix reads the live engine's
+        # window clock (an attribute the round loop maintains anyway —
+        # no extra per-round work); cleared in the finally so a later
+        # Simulation in the same process cannot inherit a stale clock
+        from ..utils import shadow_log
+
+        shadow_log.set_sim_time_provider(
+            lambda: getattr(self.engine, "window_end", 0) or 0
+        )
+        try:
+            return self._run_logged(write_data, t0)
+        finally:
+            shadow_log.set_sim_time_provider(None)
+
+    def _run_logged(self, write_data: bool, t0: float) -> SimResult:
+        cfg = self.cfg
+        backend = cfg.experimental.network_backend
         log.info(
             "starting simulation: %d hosts, stop_time=%s, backend=%s, seed=%d",
             len(cfg.hosts),

@@ -13,6 +13,9 @@ import time
 
 SHIM_ABI_MAGIC = 0x53485457534D4833
 SHIM_PAYLOAD_MAX = 65536
+SHIM_ARENA_SIZE = 1 << 20  # zero-syscall staging arena (see the header)
+SHIM_ARENA_CHUNK = 256 << 10  # per-turn staging clamp (must match the shim)
+VM_ARENA = 1  # args[4] sentinel: payload rides the channel arena
 
 # ops
 OP_START = 1
@@ -113,6 +116,7 @@ class ShimShmem(ctypes.Structure):
         ("blocked_signals", ctypes.c_uint64),
         ("to_shadow", ShimMsg),
         ("to_shim", ShimMsg),
+        ("arena", ctypes.c_uint8 * SHIM_ARENA_SIZE),
     ]
 
 
@@ -194,6 +198,17 @@ class ShmChannel:
         self._f.close()
 
     # -- protocol ----------------------------------------------------------
+
+    def read_arena(self, n: int) -> bytes:
+        """Copy ``n`` bytes out of the zero-syscall staging arena (the
+        channel turn serializes access; the shim wrote before sending)."""
+        n = max(0, min(n, SHIM_ARENA_SIZE))
+        return ctypes.string_at(ctypes.addressof(self.shm.arena), n)
+
+    def write_arena(self, data: bytes) -> int:
+        n = min(len(data), SHIM_ARENA_SIZE)
+        ctypes.memmove(self.shm.arena, data, n)
+        return n
 
     def set_clock(self, emu_ns: int) -> None:
         self.shm.sim_clock_ns = emu_ns

@@ -1677,7 +1677,15 @@ class ManagedApp:
         if sock is None:
             self._reply(api, "sendto", -EBADF)
             return True
-        if req.args[4]:
+        if int(req.args[4]) == abi.VM_ARENA:
+            # zero-syscall arena mode: the shim staged the payload in the
+            # channel's shared arena (turn-serialized).  The counter
+            # records bytes STAGED through the arena (like the vmcopy
+            # counter records bytes staged via process_vm): a nonblocking
+            # retry may stage more than the buffer accepts
+            data = self.chan.read_arena(int(req.args[5]))
+            api.count("managed_arena_bytes", len(data))
+        elif req.args[4]:
             # direct-memory mode (MemoryCopier, memory_copier.rs): the
             # shim passed (addr, len) instead of riding the 64 KiB frame.
             # Clamp the staging copy: the send buffer can't queue more
@@ -1790,7 +1798,9 @@ class ManagedApp:
         # mode otherwise: the channel carries at most SHIM_PAYLOAD_MAX
         # bytes per reply (the caller loops).
         vm_dst = int(req.args[4])
-        if vm_dst:
+        if vm_dst == abi.VM_ARENA:
+            max_len = min(int(req.args[1]), abi.SHIM_ARENA_CHUNK)
+        elif vm_dst:
             max_len = min(int(req.args[1]), 256 * 1024)
         else:
             max_len = min(int(req.args[1]), abi.SHIM_PAYLOAD_MAX)
@@ -1839,10 +1849,15 @@ class ManagedApp:
 
     def _reply_stream_data(self, api: HostApi, sock, data: bytes,
                            peek: bool, vm_dst: int) -> None:
-        """Deliver stream bytes: direct vm_write into plugin memory
-        (MemoryCopier write side — data must have been PEEKed, it is
-        consumed only once the write lands) or the frame payload."""
-        if vm_dst:
+        """Deliver stream bytes: the zero-syscall arena, direct vm_write
+        into plugin memory (MemoryCopier write side — data must have been
+        PEEKed, it is consumed only once the write lands), or the frame
+        payload."""
+        if vm_dst == abi.VM_ARENA:
+            self.chan.write_arena(data)
+            api.count("managed_arena_bytes", len(data))
+            sock.sim.recv(len(data))  # consume exactly what landed
+        elif vm_dst:
             try:
                 abi.vm_write(self._cur.pid, vm_dst, data)
                 api.count("managed_vmcopy_bytes", len(data))

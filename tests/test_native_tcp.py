@@ -221,17 +221,35 @@ def test_big_write_waitall_fionread_sleep(tmp_path):
     out = _read(tmp_path, "cli0")
     assert "bigclient done bytes=150000" in out
     assert "slept_ms=" in out
-    # the >64KiB write moved via process_vm_readv AND the >64KiB WAITALL
-    # recv landed via process_vm_writev (the MemoryCopier's two sides) —
-    # never the 64KiB frame chunks — unless this kernel forbids
-    # cross-process access, in which case the frame fallback carried both
-    # (also correct).  >= 300k proves BOTH directions took the fast path
-    if _vm_read_allowed():
-        assert result.counters.get("managed_vmcopy_bytes", 0) >= 300_000
+    # the >64KiB write AND the >64KiB WAITALL recv both rode the
+    # zero-syscall channel ARENA (the default large-transfer path);
+    # >= 300k proves BOTH directions took it
+    assert result.counters.get("managed_arena_bytes", 0) >= 300_000
     slept = int(out.split("slept_ms=")[1].split()[0])
     assert slept >= 50  # the sleep advanced simulated time
     assert "avail_gt0=1" in out
     assert result.counters["managed_tcp_tx_bytes"] >= 300000
+
+
+def test_big_write_memory_copier_path(tmp_path, monkeypatch):
+    """SHADOW_TPU_NO_ARENA=1 opts the shim out of the arena: the same
+    transfer must ride process_vm_readv/writev (the MemoryCopier mode) —
+    or the frame fallback where the kernel forbids cross-process access."""
+    monkeypatch.setenv("SHADOW_TPU_NO_ARENA", "1")
+    cfg = ConfigOptions.from_yaml(
+        _yaml(
+            tmp_path,
+            "server, '7000', '1'",
+            [(f"bigclient, {_srv_ip(1)}, '7000', '150000'", "100ms")],
+            stop="30s",
+        )
+    )
+    result = Simulation(cfg).run()
+    out = _read(tmp_path, "cli0")
+    assert "bigclient done bytes=150000" in out
+    assert result.counters.get("managed_arena_bytes", 0) == 0
+    if _vm_read_allowed():
+        assert result.counters.get("managed_vmcopy_bytes", 0) >= 300_000
 
 
 def test_strace_logging(tmp_path):

@@ -1,0 +1,78 @@
+"""Async buffered logger (the reference's logger crate analog):
+emission enqueues, a listener thread writes, lines carry sim-time
+prefixes, and shutdown drains everything."""
+
+import io
+import logging
+import threading
+
+from shadow_tpu.utils import shadow_log
+
+
+def _fresh(buf):
+    return shadow_log.install_async_logging(logging.INFO, stream=buf)
+
+
+def test_async_emission_and_flush():
+    buf = io.StringIO()
+    _fresh(buf)
+    try:
+        log = logging.getLogger("shadow_tpu.test")
+        # emission must not do I/O on the caller: the root handler is a
+        # QueueHandler, not a StreamHandler
+        root = logging.getLogger()
+        assert len(root.handlers) == 1
+        assert isinstance(root.handlers[0], logging.handlers.QueueHandler)
+        for i in range(100):
+            log.info("line %d", i)
+    finally:
+        shadow_log.shutdown()  # drains
+    out = buf.getvalue()
+    assert out.count("line ") == 100
+    assert "line 99" in out
+
+
+def test_sim_time_prefix():
+    buf = io.StringIO()
+    _fresh(buf)
+    try:
+        shadow_log.set_sim_time_provider(lambda: 1_500_000_000)
+        logging.getLogger("shadow_tpu.test").info("stamped")
+    finally:
+        shadow_log.shutdown()
+        shadow_log.set_sim_time_provider(None)
+    assert "[1.500000000s]" in buf.getvalue()
+
+
+def test_multithreaded_emission_complete():
+    buf = io.StringIO()
+    _fresh(buf)
+    try:
+        log = logging.getLogger("shadow_tpu.test")
+
+        def worker(k):
+            for i in range(50):
+                log.info("w%d-%d", k, i)
+
+        ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        shadow_log.shutdown()
+    assert buf.getvalue().count("w") >= 200
+
+
+def test_install_is_idempotent():
+    b1, b2 = io.StringIO(), io.StringIO()
+    _fresh(b1)
+    logging.getLogger("shadow_tpu.test").info("first")
+    _fresh(b2)  # replaces, flushing the first listener
+    try:
+        logging.getLogger("shadow_tpu.test").info("second")
+    finally:
+        shadow_log.shutdown()
+    assert "first" in b1.getvalue()
+    assert "second" in b2.getvalue()
+    assert len(logging.getLogger().handlers) <= 1
