@@ -63,6 +63,63 @@ static int run_server(int port, int count) {
     return 0;
 }
 
+/* lazy sink: sleep before each read so inbound datagrams pile into the
+ * simulated recv buffer (the drop-tail gate's pressure source); prints
+ * how many it eventually drained */
+static int run_lazysink(int port, int count, long delay_ms) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    struct sockaddr_in me = {0};
+    me.sin_family = AF_INET;
+    me.sin_port = htons(port);
+    if (bind(fd, (struct sockaddr *)&me, sizeof me) != 0) {
+        perror("bind");
+        return 1;
+    }
+    long long bytes = 0;
+    int got = 0;
+    for (int i = 0; i < count; i++) {
+        sleep_ms(delay_ms);
+        char buf[2048];
+        ssize_t n = recvfrom(fd, buf, sizeof buf, 0, NULL, NULL);
+        if (n < 0) break;
+        bytes += n;
+        got++;
+    }
+    printf("lazysink: drained %d datagrams, %lld bytes\n", got, bytes);
+    close(fd);
+    return 0;
+}
+
+/* one-way flooder: sendto without waiting for echoes (pressure for the
+ * lazysink's recv buffer) */
+static int run_flood(const char *ip, int port, int count, long interval_ms,
+                     int size) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    struct sockaddr_in srv = {0};
+    srv.sin_family = AF_INET;
+    srv.sin_port = htons(port);
+    if (inet_pton(AF_INET, ip, &srv.sin_addr) != 1) {
+        fprintf(stderr, "bad ip %s\n", ip);
+        return 1;
+    }
+    char buf[2048];
+    memset(buf, 0x55, sizeof buf);
+    if (size > (int)sizeof buf) size = (int)sizeof buf;
+    for (int i = 0; i < count; i++) {
+        if (sendto(fd, buf, (size_t)size, 0, (struct sockaddr *)&srv,
+                   sizeof srv) < 0) {
+            perror("sendto");
+            return 1;
+        }
+        if (interval_ms > 0) sleep_ms(interval_ms);
+    }
+    printf("flood: sent %d datagrams of %d bytes\n", count, size);
+    close(fd);
+    return 0;
+}
+
 static int run_client(const char *ip, int port, int count, long interval_ms) {
     int fd = socket(AF_INET, SOCK_DGRAM, 0);
     if (fd < 0) { perror("socket"); return 1; }
@@ -111,6 +168,11 @@ int main(int argc, char **argv) {
     setvbuf(stdout, NULL, _IOLBF, 0);
     if (argc >= 4 && strcmp(argv[1], "server") == 0)
         return run_server(atoi(argv[2]), atoi(argv[3]));
+    if (argc >= 5 && strcmp(argv[1], "lazysink") == 0)
+        return run_lazysink(atoi(argv[2]), atoi(argv[3]), atol(argv[4]));
+    if (argc >= 7 && strcmp(argv[1], "flood") == 0)
+        return run_flood(argv[2], atoi(argv[3]), atoi(argv[4]),
+                         atol(argv[5]), atoi(argv[6]));
     if (argc >= 6 && strcmp(argv[1], "client") == 0)
         return run_client(argv[2], atoi(argv[3]), atoi(argv[4]),
                           atol(argv[5]));

@@ -333,6 +333,11 @@ class ConfigOptions:
                     f"host {h.hostname!r}: congestion must be reno|cubic, "
                     f"got {h.congestion!r}"
                 )
+        if self.experimental.interface_qdisc not in ("fifo", "round-robin"):
+            raise ConfigError(
+                "experimental.interface_qdisc must be fifo|round-robin, "
+                f"got {self.experimental.interface_qdisc!r}"
+            )
 
 
 def _require(doc: dict[str, Any], key: str, section: str) -> Any:

@@ -72,6 +72,12 @@ class Simulation:
     def _run_logged(self, write_data: bool, t0: float) -> SimResult:
         cfg = self.cfg
         backend = cfg.experimental.network_backend
+        if cfg.experimental.interface_qdisc == "round-robin":
+            log.warning(
+                "interface_qdisc: round-robin is modeled by the "
+                "endpoint-bucket law (per-host FIFO; docs/SEMANTICS.md "
+                "deviation 1) — there is no interface queue to interleave"
+            )
         log.info(
             "starting simulation: %d hosts, stop_time=%s, backend=%s, seed=%d",
             len(cfg.hosts),

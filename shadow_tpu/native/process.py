@@ -117,7 +117,7 @@ class _VSocket:
     __slots__ = ("vfd", "kind", "port", "default_dst", "queue", "sim",
                  "listener", "accept_q", "recv_shut", "refs",
                  "count", "t_next", "t_interval", "t_gen", "e_sem",
-                 "watches", "next_wd")
+                 "watches", "next_wd", "queued_bytes")
 
     def __init__(self, vfd: int, kind: str) -> None:
         self.refs = 1  # fork shares the socket across processes
@@ -126,6 +126,7 @@ class _VSocket:
         self.port: Optional[int] = None
         self.default_dst: Optional[tuple[int, int]] = None  # (ip_be, port)
         self.queue: list[tuple[int, int, bytes]] = []  # udp: (src_ip_be, src_port, data)
+        self.queued_bytes = 0  # udp: recv-buffer occupancy (drop-tail cap)
         self.sim = None  # SimTcpSocket (tcp)
         self.listener = None  # SimTcpListener (listen)
         self.accept_q: list = []  # SimTcpSockets awaiting accept()
@@ -538,9 +539,19 @@ class ManagedApp:
         app, sock = owner
         if app is not self or self.finished:
             return
+        # recv-buffer drop-tail (the reference's bounded socket buffers,
+        # udp.rs: a full buffer silently drops the datagram)
+        from ..config.options import SOCKET_RECV_BUFFER_DEFAULT
+
+        rcvbuf = (self._exp.socket_recv_buffer if self._exp
+                  else SOCKET_RECV_BUFFER_DEFAULT)
+        if sock.queued_bytes + len(data) > rcvbuf:
+            api.count("udp_rcvbuf_drops")
+            return
         # a lo datagram's source address is 127.0.0.1, like Linux
         src_ip_be = _ip_to_be("127.0.0.1" if via_lo else api.ip_of(src))
         sock.queue.append((src_ip_be, src_port, data))
+        sock.queued_bytes += len(data)
         api.count("udp_rx_bytes", len(data))
         self._socket_activity_obj(api, sock)
 
@@ -1907,8 +1918,13 @@ class ManagedApp:
 
     def _reply_udp_recv(self, api: HostApi, vfd: int, max_len: int,
                         peek: bool = False) -> None:
-        queue = self.sockets[vfd].queue
+        sock = self.sockets[vfd]
+        queue = sock.queue
         src_ip_be, src_port, data = queue[0] if peek else queue.pop(0)
+        if not peek:  # the whole datagram leaves the buffer even if the
+            sock.queued_bytes -= len(data)  # caller's read truncates it
+            if sock.queued_bytes < 0:
+                sock.queued_bytes = 0
         # UDP truncation semantics: excess bytes of the datagram are
         # discarded, the caller sees the truncated length, and recvmsg
         # callers learn about it via MSG_TRUNC (reply args[3])

@@ -111,3 +111,35 @@ def test_unknown_model_message():
 
     with pytest.raises(ValueError, match="neither a built-in model"):
         create_model("no-such-model", [])
+
+
+def test_udp_rcvbuf_drop_tail(tmp_path):
+    """Bounded UDP recv buffers (the reference's drop-tail at a full
+    socket buffer): a flooder outpaces a lazy reader whose
+    socket_recv_buffer holds only a few datagrams — the excess drops and
+    is counted; the reader drains exactly what fit over time."""
+    cfg = ConfigOptions.from_yaml(f"""
+general: {{stop_time: 10s, seed: 3, data_directory: {tmp_path / 'd'}, heartbeat_interval: null}}
+experimental: {{socket_recv_buffer: 4000}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  sink:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'pingpong'}
+        args: [lazysink, "6000", "10", "400"]
+        expected_final_state: {{exited: 0}}
+  flood:
+    network_node_id: 0
+    processes:
+      - path: {BUILD / 'pingpong'}
+        args: [flood, 11.0.0.2, "6000", "60", "100", "1000"]
+        start_time: 100ms
+        expected_final_state: {{exited: 0}}
+""")
+    result = Simulation(cfg).run()
+    assert not result.process_errors
+    # 60 KB offered into a 4 KB buffer drained at 2.5 reads/s: most drop
+    assert result.counters.get("udp_rcvbuf_drops", 0) > 20, result.counters
+    out = (tmp_path / "d" / "hosts" / "sink" / "pingpong.stdout").read_text()
+    assert "lazysink: drained 10 datagrams" in out
