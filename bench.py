@@ -53,7 +53,10 @@ REFERENCE_SPEEDUP = 6.38  # BASELINE.md: 180 sim-s in 28.23 wall-s
 
 N_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_HOSTS", "10000"))
 SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_SIM_SECONDS", "30"))
-REPEATS = int(os.environ.get("SHADOW_TPU_BENCH_REPEATS", "3"))
+# best-of count: the tunneled chip is shared, so individual runs see
+# foreign interference (probe repeats spread 5.1-6.2 on identical
+# programs); 5 samples make the best-of representative
+REPEATS = int(os.environ.get("SHADOW_TPU_BENCH_REPEATS", "5"))
 MIXED_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_MIXED_HOSTS", "10000"))
 CPU_SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_CPU_SIM_SECONDS", "1"))
 LADDER = os.environ.get("SHADOW_TPU_BENCH_LADDER", "1") == "1"

@@ -115,6 +115,22 @@ int main(int argc, char **argv) {
         printf("sysinfo=up:%ld,load:%lu,ram:%llu,procs:%u\n", si.uptime,
                si.loads[0], (unsigned long long)si.totalram, si.procs);
 
+    /* 4b. the other synthesized /proc views */
+    const char *procs[] = {"/proc/loadavg", "/proc/meminfo", "/proc/stat",
+                           "/proc/cpuinfo"};
+    for (unsigned i = 0; i < sizeof(procs) / sizeof(procs[0]); i++) {
+        char pb[256] = {0};
+        int pfd = open(procs[i], O_RDONLY);
+        if (pfd >= 0) {
+            ssize_t r = read(pfd, pb, sizeof(pb) - 1);
+            if (r > 0) pb[r] = 0;
+            close(pfd);
+            char *nl = strchr(pb, '\n');
+            if (nl) *nl = 0;
+            printf("proc_%s=%s\n", procs[i] + 6, pb);
+        }
+    }
+
     /* 5b. statfs / getrusage / times: more host-state observables */
     struct statfs sf;
     if (statfs(".", &sf) == 0)

@@ -4687,25 +4687,74 @@ static long emu_sysinfo(struct sysinfo *si) {
     return 0;
 }
 
-/* /proc/uptime synthesized from the simulated clock: opening it returns
- * a memfd pre-filled at the open instant (read offsets behave normally;
- * the file does not tick while open — matching a single read() snapshot,
- * which is how every real consumer uses it) */
-static long maybe_open_proc_uptime(const char *path) {
-    if (!g_shm || !path || strcmp(path, "/proc/uptime") != 0) return -1;
-    long fd = shim_raw_syscall6(SYS_memfd_create, (long)"sim_uptime", 0, 0,
+/* /proc/{uptime,loadavg,meminfo,stat,cpuinfo} synthesized from modeled
+ * state: opening one returns a memfd pre-filled at the open instant
+ * (read offsets behave normally; the file does not tick while open —
+ * matching a single read() snapshot, which is how real consumers use
+ * them).  Values agree with the other virtualized views: 1 CPU (getcpu/
+ * affinity), 16 GiB total / 8 GiB free (sysinfo/statfs), sim uptime. */
+static long proc_synth_fd(const char *text, int len) {
+    long fd = shim_raw_syscall6(SYS_memfd_create, (long)"sim_proc", 0, 0,
                                0, 0, 0);
     if (fd < 0) return -1;
-    uint64_t up = (sim_now_ns() - SHIM_SIM_EPOCH_NS) / 10000000ull; /* cs */
-    char line[64];
-    int len = snprintf(line, sizeof(line), "%llu.%02llu %llu.%02llu\n",
+    if (shim_raw_syscall6(SYS_write, fd, (long)text, len, 0, 0, 0) != len) {
+        shim_raw_syscall6(SYS_close, fd, 0, 0, 0, 0, 0);
+        return -1; /* fall through to the real file, never truncated synth */
+    }
+    shim_raw_syscall6(SYS_lseek, fd, 0, 0 /* SEEK_SET */, 0, 0, 0);
+    return fd;
+}
+
+static long maybe_open_synth_proc(const char *path, long flags) {
+    if (!g_shm || !path) return -1;
+    if ((flags & O_ACCMODE) != O_RDONLY)
+        return -1; /* the kernel refuses write opens of these; so do we */
+    char buf[512];
+    int len;
+    if (strcmp(path, "/proc/uptime") == 0) {
+        uint64_t up =
+            (sim_now_ns() - SHIM_SIM_EPOCH_NS) / 10000000ull; /* cs */
+        len = snprintf(buf, sizeof(buf), "%llu.%02llu %llu.%02llu\n",
                        (unsigned long long)(up / 100),
                        (unsigned long long)(up % 100),
                        (unsigned long long)(up / 100),
                        (unsigned long long)(up % 100));
-    shim_raw_syscall6(SYS_write, fd, (long)line, len, 0, 0, 0);
-    shim_raw_syscall6(SYS_lseek, fd, 0, 0 /* SEEK_SET */, 0, 0, 0);
-    return fd;
+    } else if (strcmp(path, "/proc/loadavg") == 0) {
+        len = snprintf(buf, sizeof(buf),
+                       "0.00 0.00 0.00 1/16 2\n");
+    } else if (strcmp(path, "/proc/meminfo") == 0) {
+        len = snprintf(buf, sizeof(buf),
+                       "MemTotal:       16777216 kB\n"
+                       "MemFree:         8388608 kB\n"
+                       "MemAvailable:    8388608 kB\n"
+                       "Buffers:               0 kB\n"
+                       "Cached:                0 kB\n"
+                       "SwapTotal:             0 kB\n"
+                       "SwapFree:              0 kB\n");
+    } else if (strcmp(path, "/proc/stat") == 0) {
+        uint64_t ticks =
+            (sim_now_ns() - SHIM_SIM_EPOCH_NS) / 10000000ull; /* HZ=100 */
+        len = snprintf(buf, sizeof(buf),
+                       "cpu  %llu 0 0 0 0 0 0 0 0 0\n"
+                       "cpu0 %llu 0 0 0 0 0 0 0 0 0\n"
+                       "ctxt 0\nbtime 946684800\nprocesses 2\n"
+                       "procs_running 1\nprocs_blocked 0\n",
+                       (unsigned long long)ticks,
+                       (unsigned long long)ticks);
+    } else if (strcmp(path, "/proc/cpuinfo") == 0) {
+        len = snprintf(buf, sizeof(buf),
+                       "processor\t: 0\n"
+                       "vendor_id\t: SimulatedCPU\n"
+                       "model name\t: shadow-tpu modeled core\n"
+                       "cpu MHz\t\t: 1000.000\n"
+                       "cache size\t: 1024 KB\n"
+                       "cpu cores\t: 1\n"
+                       "bogomips\t: 2000.00\n\n");
+    } else {
+        return -1;
+    }
+    if (len < 0 || len >= (int)sizeof(buf)) return -1;
+    return proc_synth_fd(buf, len);
 }
 
 /* Adapter: the public wrappers use libc conventions (-1 + errno); the
@@ -5215,12 +5264,12 @@ static long emu_owned_syscall(long nr, long a1, long a2, long a3, long a4,
         case SYS_socketpair:
             WRAPRET(socketpair((int)a1, (int)a2, (int)a3, (int *)a4));
         case SYS_open: {
-            long fd = maybe_open_proc_uptime((const char *)a1);
+            long fd = maybe_open_synth_proc((const char *)a1, a2);
             if (fd >= 0) return fd;
             break;
         }
         case SYS_openat: {
-            long fd = maybe_open_proc_uptime((const char *)a2);
+            long fd = maybe_open_synth_proc((const char *)a2, a3);
             if (fd >= 0) return fd;
             break;
         }

@@ -110,6 +110,18 @@ def test_proc_uptime_and_sysinfo_from_sim_clock(tmp_path):
     assert si["procs"] == "16"
 
 
+def test_proc_views_synthesized(tmp_path):
+    # loadavg/meminfo/stat/cpuinfo agree with the modeled host (1 CPU,
+    # 16 GiB, zero load) and never leak the real machine's figures
+    vals = _run(tmp_path, "pv")
+    assert vals["proc_loadavg"] == "0.00 0.00 0.00 1/16 2"
+    assert vals["proc_meminfo"] == "MemTotal:       16777216 kB"
+    assert vals["proc_stat"].startswith("cpu  ")
+    ticks = int(vals["proc_stat"].split()[1])
+    assert 0 <= ticks < 200  # sim uptime at HZ=100, not host jiffies
+    assert vals["proc_cpuinfo"] == "processor\t: 0"
+
+
 def test_affinity_reports_modeled_cpu_set(tmp_path):
     vals = _run(tmp_path, "e")
     assert vals["cpus"] == "1"
