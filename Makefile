@@ -3,7 +3,7 @@
 # that is otherwise env-gated off.  Mirrors the reference's determinism
 # CTest gate (src/test/determinism/CMakeLists.txt).
 
-.PHONY: test gate native
+.PHONY: test gate native smoke-faults
 
 test: native
 	python -m pytest tests/ -q
@@ -14,3 +14,11 @@ gate: native
 
 native:
 	$(MAKE) -C native
+
+# End-to-end fault-injection smoke: run the partition/heal example on the
+# cpu backend twice and require byte-identical event logs + counters (the
+# determinism contract of docs/faults.md).
+smoke-faults:
+	JAX_PLATFORMS=cpu python -m shadow_tpu examples/partition-heal.yaml \
+	  --determinism-check --data-directory /tmp/shadow-tpu-smoke-faults.data
+

@@ -341,6 +341,29 @@ class CpuEngine:
         # when experimental.perf_logging is on; None = zero overhead)
         self.perf_log = None
 
+        # fault schedule (shadow_tpu/faults/): versioned routing tables
+        # installed in place at window boundaries; every event time is a
+        # window-clamp epoch so fault replay is bit-identical
+        self.faults = None
+        if cfg.faults.events:
+            from ..faults.overlay import build_fault_runtime
+
+            self.faults = build_fault_runtime(cfg, self.graph, self.routing)
+
+    def console_fault_sink(self, tokens: list[str]) -> str:
+        """Run-control ``fault ...`` verb: schedule a fault at the current
+        window boundary (effective for all subsequent sends).  Dynamic
+        injection is interactive by nature — an in-process restart (``r``)
+        rebuilds from the config and forgets console faults."""
+        from ..faults.overlay import empty_fault_runtime
+        from ..faults.schedule import parse_console_fault
+
+        if self.faults is None:
+            self.faults = empty_fault_runtime(self.cfg, self.graph, self.routing)
+        ev = parse_console_fault(tokens, at=max(self.window_end, 1))
+        self.faults.inject(ev)
+        return f"fault {ev.kind} scheduled at {stime.fmt(ev.at)}"
+
     # -- DNS (network/dns.rs) ----------------------------------------------
 
     def resolve(self, hostname: str) -> int:
@@ -596,7 +619,18 @@ class CpuEngine:
             start = self.next_event_time()
             if start >= self.stop_time or start == stime.NEVER:
                 break
+            if self.faults is not None:
+                # apply every fault epoch at or before this window's start,
+                # then clamp the window at the next pending epoch: sends at
+                # t >= epoch see the new tables, earlier sends never do —
+                # the identical law the TPU engine's epoch segmentation
+                # enforces, so windows (and logs) stay bit-identical
+                self.faults.advance_to(start)
             self.window_end = min(start + self.current_runahead(), self.stop_time)
+            if self.faults is not None:
+                self.window_end = min(
+                    self.window_end, self.faults.window_bound(start)
+                )
             pl = self.perf_log
             if pl is not None:
                 active = sum(

@@ -230,6 +230,21 @@ class TpuEngine:
                     f"model {proc.path!r} is not lane-compiled yet; use the cpu backend"
                 )
 
+        # fault schedule: versioned latency/loss gather tables re-uploaded
+        # at epoch boundaries (shadow_tpu/faults/overlay.py); the run is
+        # segmented per epoch so no window straddles a fault
+        self._fault_overlay = None
+        self._watchdog_timeout = cfg.faults.watchdog_timeout
+        if cfg.faults.events:
+            if ext_mask.any():
+                raise LaneCompatError(
+                    "fault schedules are not supported on the hybrid tpu "
+                    "backend; use the cpu backend"
+                )
+            from ..faults.overlay import build_overlay
+
+            self._fault_overlay = build_overlay(cfg, self.graph, self.routing)
+
         capacity = cfg.experimental.tpu_lane_queue_capacity
         if cfg.experimental.tpu_cross_capacity < 0:
             raise LaneCompatError(
@@ -280,7 +295,12 @@ class TpuEngine:
         # largest link latency
         from ..net import ltcp as ltcp_mod
 
-        max_window = max(runahead, int(np.max(np.asarray(lat), initial=0)))
+        max_lat = int(np.max(np.asarray(lat), initial=0))
+        if self._fault_overlay is not None:
+            # fault epochs can raise latencies mid-run; the wide-pop bound
+            # must hold for every snapshot's tables
+            max_lat = max(max_lat, self._fault_overlay.max_latency_ns())
+        max_window = max(runahead, max_lat)
         stream_wide_pop = max_window < ltcp_mod.RTO_MIN
 
         lane_pcap = np.array([h.pcap_enabled for h in cfg.hosts], dtype=bool)
@@ -308,7 +328,14 @@ class TpuEngine:
             bootstrap_end=cfg.general.bootstrap_end_time,
             runahead=runahead,
             models_present=tuple(sorted(set(int(x) for x in model))),
-            has_loss=bool(np.any(np.asarray(thresh) > 0)),
+            # fault epochs may introduce loss later in the run: the loss
+            # draw must be compiled in from the start (the counter-based
+            # RNG keys on send seq, so drawing on loss-free segments
+            # cannot shift any stream)
+            has_loss=bool(np.any(np.asarray(thresh) > 0))
+            or (
+                self._fault_overlay is not None and self._fault_overlay.any_loss()
+            ),
             unroll=cfg.experimental.tpu_round_unroll,
             dynamic_runahead=bool(cfg.experimental.use_dynamic_runahead),
             runahead_floor=max(cfg.experimental.runahead or 0, 1),
@@ -368,6 +395,12 @@ class TpuEngine:
         # strictly below NEVER32: a latency equal to the sentinel would
         # read as "no sends yet" in the dynamic-runahead scalar
         _check("link latency (ns)", np.asarray(lat), i32max - 1)
+        if self._fault_overlay is not None:
+            _check(
+                "fault-epoch link latency (ns)",
+                np.asarray([self._fault_overlay.max_latency_ns()]),
+                i32max - 1,
+            )
         _check("runahead (ns)", np.asarray([runahead]), i32max)
         for side, b in (("up", up), ("dn", dn)):
             # the refill computes tokens + k*rate <= 2*burst + rate before
@@ -499,6 +532,8 @@ class TpuEngine:
         self._init_events = init_events
         self._local_seq0 = local_seq0
         self._el_np = el_np  # [2S] endpoint lanes (tiered routing/collect)
+        self._peer_np = peer_np  # [2S] peer lanes (fault-epoch flow tables)
+        self._node_idx = node_idx  # [N] host -> dense node index
         self._ep_of_lane = (
             {int(l): r for r, l in enumerate(el_np)} if tiered else {}
         )
@@ -721,6 +756,13 @@ class TpuEngine:
         the first merge, zero effect on results) so repeat timings cannot
         be served from the tunneled runtime's cross-process execution
         cache, which keys on (program, input buffers)."""
+        if self._fault_overlay is not None:
+            if precompile or cache_salt:
+                raise LaneCompatError(
+                    "precompile/cache_salt are bench affordances; they are "
+                    "not supported together with a fault schedule"
+                )
+            return self._run_faulted(mode, on_window=on_window)
         state = self.initial_state()
         self._iters_salt = 0
         if cache_salt:
@@ -754,41 +796,135 @@ class TpuEngine:
         else:
             round_fn = lanes.make_round_fn(self.params, self.tables)
             t0 = wall_time.perf_counter()
-            while True:
-                self._live_state = state
-                if on_window is not None or self.perf_log is not None:
-                    # queue rows are sorted: column 0 is each lane's min
-                    lane_next = np.asarray(
-                        lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
-                    )
-                    start = self._next_event_np(state)
-                    we_pred = min(
-                        start + self.current_runahead(), self.params.stop_time
-                    )
-                    active = int((lane_next < we_pred).sum())
-                    if self.params.stream_tiered:
-                        tq = state.stream.q
-                        tier_next = np.asarray(lanes.t_join(
-                            tq[lstr_mod.TQ_THI, :, 0],
-                            tq[lstr_mod.TQ_TLO, :, 0],
-                        ))
-                        active += int((tier_next < we_pred).sum())
-                state, done = round_fn(state)
-                if bool(done):
-                    break
-                if on_window is not None or self.perf_log is not None:
-                    window_end = int(
-                        (int(state.now_we_hi) << 31) | int(state.now_we_lo)
-                    )
-                    next_ev = self._next_event_np(state)
-                    if self.perf_log is not None:
-                        self.perf_log.window_agg(
-                            active, start, window_end,
-                            min(next_ev, self.params.stop_time),
-                        )
-                    if on_window is not None:
-                        on_window(start, window_end, next_ev)
+            state = self._drive_steps(round_fn, state, on_window, self.params)
             wall = wall_time.perf_counter() - t0
+        return self.collect(state, wall)
+
+    def _drive_steps(
+        self, round_fn, state: lanes.LaneState, on_window, p: lanes.LaneParams
+    ) -> lanes.LaneState:
+        """The step driver's round loop (one device call per round) up to
+        ``p.stop_time`` — shared by the plain run and every fault-epoch
+        segment.  Each round is timed under the stall watchdog when
+        ``faults.watchdog_timeout`` is configured."""
+        from ..faults.watchdog import RoundWatchdog
+
+        wd = (
+            RoundWatchdog(self._watchdog_timeout)
+            if self._watchdog_timeout is not None
+            else None
+        )
+        while True:
+            self._live_state = state
+            if on_window is not None or self.perf_log is not None:
+                # queue rows are sorted: column 0 is each lane's min
+                lane_next = np.asarray(
+                    lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
+                )
+                start = self._next_event_np(state)
+                we_pred = min(start + self.current_runahead(), p.stop_time)
+                active = int((lane_next < we_pred).sum())
+                if p.stream_tiered:
+                    tq = state.stream.q
+                    tier_next = np.asarray(lanes.t_join(
+                        tq[lstr_mod.TQ_THI, :, 0],
+                        tq[lstr_mod.TQ_TLO, :, 0],
+                    ))
+                    active += int((tier_next < we_pred).sum())
+            t_round = wall_time.perf_counter()
+            state, done = round_fn(state)
+            done = bool(done)  # forces the device sync the timing needs
+            if wd is not None:
+                wd.observe(wall_time.perf_counter() - t_round)
+            if done:
+                break
+            if on_window is not None or self.perf_log is not None:
+                window_end = int(
+                    (int(state.now_we_hi) << 31) | int(state.now_we_lo)
+                )
+                next_ev = self._next_event_np(state)
+                if self.perf_log is not None:
+                    self.perf_log.window_agg(
+                        active, start, window_end,
+                        min(next_ev, p.stop_time),
+                    )
+                if on_window is not None:
+                    on_window(start, window_end, next_ev)
+        return state
+
+    # -- fault-epoch segmentation ------------------------------------------
+
+    def _segment_tables(self, snap) -> lanes.LaneTables:
+        """Re-upload the versioned gather tables for a fault epoch: the
+        [G, G] latency/threshold tables plus the per-flow compactions the
+        stream tier gathers from them."""
+        import jax.numpy as _jnp
+
+        lat_np = np.asarray(snap.latency_ns)
+        thr_np = np.asarray(snap.loss_threshold)
+        kw = dict(
+            lat=_jnp.asarray(lat_np, dtype=_jnp.int32),
+            thresh_u32=_jnp.asarray(
+                (thr_np & 0xFFFFFFFF).astype(np.uint32)
+            ),
+            thresh_all=_jnp.asarray(thr_np >= (1 << 32)),
+        )
+        if self._s_flows:
+            e_nodes = np.asarray(self._node_idx)[self._el_np]
+            p_nodes = np.asarray(self._node_idx)[self._peer_np]
+            flow_lat = lat_np[e_nodes, p_nodes].astype(np.int32)
+            flow_thr = thr_np[e_nodes, p_nodes]
+            kw.update(
+                flow_lat=_jnp.asarray(flow_lat),
+                flow_thresh_u32=_jnp.asarray(
+                    (flow_thr & 0xFFFFFFFF).astype(np.uint32)
+                ),
+                flow_thresh_all=_jnp.asarray(flow_thr >= (1 << 32)),
+            )
+        return self.tables._replace(**kw)
+
+    def _run_faulted(self, mode: str, on_window=None) -> SimResult:
+        """Run the simulation segmented at fault epochs: each segment is
+        an ordinary (fused or step-wise) run whose stop time is the next
+        epoch, against that epoch's tables.  Windows therefore never
+        straddle a fault — the identical clamp law the CPU engine applies
+        — and the lane state (queues, buckets, RNG counters, flows)
+        carries across segments untouched."""
+        import dataclasses as _dc
+
+        from ..faults.watchdog import BackendStallError
+
+        ov = self._fault_overlay
+        stop = self.params.stop_time
+        bounds = [t for t in ov.epoch_times() if 0 < t < stop] + [stop]
+        state = self.initial_state()
+        self._iters_salt = 0
+        fns = getattr(self, "_seg_fns", None)
+        if fns is None:
+            fns = self._seg_fns = {}
+        t0 = wall_time.perf_counter()
+        seg_start = 0
+        for seg_end in bounds:
+            if seg_start > 0 and ov.stall_at(seg_start):
+                raise BackendStallError(
+                    f"injected backend stall at {seg_start} ns "
+                    "(fault schedule backend_stall event)"
+                )
+            snap = ov.snapshot_at(seg_start) if seg_start > 0 else None
+            tb = self.tables if snap is None else self._segment_tables(snap)
+            p = _dc.replace(self.params, stop_time=seg_end)
+            key = (seg_start, seg_end, mode)
+            fn = fns.get(key)
+            if mode == "device":
+                if fn is None:
+                    fn = fns[key] = lanes.make_run_fn(p, tb)
+                state = jax.block_until_ready(fn(state))
+            else:
+                if fn is None:
+                    fn = fns[key] = lanes.make_round_fn(p, tb)
+                state = self._drive_steps(fn, state, on_window, p)
+            seg_start = seg_end
+        wall = wall_time.perf_counter() - t0
         return self.collect(state, wall)
 
     def _write_pcaps(self, event_rows, pcap_rows) -> None:

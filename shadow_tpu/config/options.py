@@ -114,6 +114,35 @@ class ExperimentalOptions:
 
 
 @dataclasses.dataclass
+class FaultOptions:
+    """The ``faults:`` config section (shadow_tpu/faults/): a declarative
+    fault schedule plus the graceful-degradation knobs.
+
+    ``failover=None`` means auto: TPU->CPU failover is armed exactly when
+    a fault schedule exists.  Set it explicitly to arm failover for real
+    backend errors without scheduling any faults (``faults: {failover:
+    true}``) or to make injected failures fatal (``failover: false``).
+    """
+
+    failover: Optional[bool] = None
+    watchdog_timeout: Optional[float] = None  # wall seconds, tpu step driver
+    events: list = dataclasses.field(default_factory=list)  # raw event dicts
+
+    @property
+    def failover_enabled(self) -> bool:
+        if self.failover is not None:
+            return bool(self.failover)
+        return bool(self.events)
+
+    def schedule(self):
+        """Parse ``events`` into a validated FaultSchedule (raises
+        shadow_tpu.faults.FaultConfigError on malformed entries)."""
+        from ..faults.schedule import FaultSchedule
+
+        return FaultSchedule.parse(self.events)
+
+
+@dataclasses.dataclass
 class ProcessOptions:
     path: str = ""
     args: list[str] = dataclasses.field(default_factory=list)
@@ -150,6 +179,7 @@ class ConfigOptions:
     experimental: ExperimentalOptions = dataclasses.field(
         default_factory=ExperimentalOptions
     )
+    faults: FaultOptions = dataclasses.field(default_factory=FaultOptions)
     hosts: list[HostOptions] = dataclasses.field(default_factory=list)
 
     # -- parsing ----------------------------------------------------------
@@ -171,6 +201,7 @@ class ConfigOptions:
             "general",
             "network",
             "experimental",
+            "faults",
             "host_option_defaults",
             "hosts",
         }
@@ -241,6 +272,17 @@ class ConfigOptions:
         if exp_doc:
             raise ConfigError(f"unknown experimental options: {sorted(exp_doc)}")
 
+        f_doc = dict(doc.get("faults", {}) or {})
+        failover = f_doc.pop("failover", None)
+        wd = f_doc.pop("watchdog_timeout", None)
+        faults = FaultOptions(
+            failover=None if failover is None else bool(failover),
+            watchdog_timeout=None if wd is None else float(wd),
+            events=list(f_doc.pop("events", []) or []),
+        )
+        if f_doc:
+            raise ConfigError(f"unknown faults options: {sorted(f_doc)}")
+
         defaults = dict(doc.get("host_option_defaults", {}))
         hosts: list[HostOptions] = []
         hosts_doc = doc.get("hosts", {})
@@ -270,7 +312,13 @@ class ConfigOptions:
                         ],
                     )
                     hosts.append(hi)
-        return cls(general=general, network=network, experimental=experimental, hosts=hosts)
+        return cls(
+            general=general,
+            network=network,
+            experimental=experimental,
+            faults=faults,
+            hosts=hosts,
+        )
 
     # -- overrides (CLI layer) -------------------------------------------
 
@@ -338,6 +386,25 @@ class ConfigOptions:
                 "experimental.interface_qdisc must be fifo|round-robin, "
                 f"got {self.experimental.interface_qdisc!r}"
             )
+        if self.faults.watchdog_timeout is not None and (
+            self.faults.watchdog_timeout <= 0
+        ):
+            raise ConfigError("faults.watchdog_timeout must be > 0 (wall seconds)")
+        if self.faults.events:
+            from ..faults.schedule import FaultConfigError
+
+            try:
+                sched = self.faults.schedule()
+            except FaultConfigError as e:
+                raise ConfigError(f"faults.events: {e}")
+            for ev in sched.events:
+                if ev.at < self.general.bootstrap_end_time:
+                    raise ConfigError(
+                        f"faults.events: {ev.kind} at {ev.at} ns lies inside "
+                        "the loss-free bootstrap window "
+                        f"(bootstrap_end_time={self.general.bootstrap_end_time} "
+                        "ns); fault drops would be silently exempted"
+                    )
 
 
 def _require(doc: dict[str, Any], key: str, section: str) -> Any:

@@ -19,6 +19,23 @@ Command grammar (identical to the reference fork):
 - ``r``        restart from t=0 (in-process, deterministic)
 - ``rN``       restart and run to N simulated seconds, then pause
 
+Fault-injection extensions (shadow_tpu/faults/):
+
+- ``fault <verb> ...``  schedule a fault at the current window boundary
+  (cpu backend; see ``shadow_tpu.faults.schedule.parse_console_fault``
+  for the grammar: ``fault link_down 0 1``, ``fault loss 0 1 0.3``,
+  ``fault latency 0 1 20ms``, ``fault partition 0|1,2``, ``fault heal``,
+  ``fault crash HOST``, ``fault restart HOST``)
+- ``failover``          force a TPU->CPU degradation (tpu step driver):
+  unwinds a FailoverRequest to the simulation facade, which replays the
+  run deterministically on the cpu engine
+
+A step (``n``) or run-until (``cN``) pause that lands on a *terminal*
+boundary — the event queues are drained, no further window will come —
+prints a terminal status and lets the run complete instead of blocking
+on a window that never arrives.  An explicit ``p`` pause still blocks
+there: it is the last chance to inspect state or restart.
+
 Restart is delivered as a :class:`RestartRequest` raised out of the round
 loop and caught by the simulation facade, which rebuilds the engine from the
 same config (determinism makes the re-run bit-identical) — the analog of the
@@ -78,6 +95,9 @@ class RunControl:
         self._stdin_started = False
         # set by the engine before each boundary so s/info can answer
         self._describe: Optional[Callable[[], WindowInfo]] = None
+        # fault-injection seams (engine/sim.py wires these per backend)
+        self._fault_sink: Optional[Callable[[list[str]], str]] = None
+        self.failover_armed = False
 
     # -- command input -----------------------------------------------------
 
@@ -85,6 +105,11 @@ class RunControl:
         """Queue commands programmatically (the scripted stdin)."""
         for c in commands:
             self._cmds.put(c)
+
+    def set_fault_sink(self, sink: Callable[[list[str]], str]) -> None:
+        """Register the engine's fault-injection callback: ``sink(tokens)``
+        schedules the fault and returns a confirmation line."""
+        self._fault_sink = sink
 
     def start_stdin_thread(self) -> None:
         """Read commands from stdin on a daemon thread (interactive use)."""
@@ -106,12 +131,19 @@ class RunControl:
         window_end: int,
         next_event_time: int,
         describe: Optional[Callable[[], WindowInfo]] = None,
+        terminal: bool = False,
     ) -> None:
         """Apply pending requests; soft-pause (block) if asked.  Raises
-        :class:`RestartRequest` when a restart command arrives."""
+        :class:`RestartRequest` when a restart command arrives.
+
+        ``terminal=True`` marks a boundary after which no further window
+        can come (event queues drained, or nothing before stop_time): a
+        step/run-until pause landing here reports terminal status and
+        returns instead of blocking the console loop forever — only an
+        explicit ``p`` still pauses (to allow inspection or restart)."""
         self._describe = describe
         # pending step/run-until pauses take effect before new commands read
-        should_pause = self.pause_requested
+        should_pause = explicit = self.pause_requested
         if self.step_windows_remaining > 0:
             self.step_windows_remaining -= 1
             should_pause = should_pause or self.step_windows_remaining == 0
@@ -130,7 +162,7 @@ class RunControl:
                     break
                 self._apply(cmd)
                 if self.pause_requested:
-                    should_pause = True
+                    should_pause = explicit = True
                     break
                 if self.step_windows_remaining > 0:
                     self.step_windows_remaining -= 1
@@ -143,12 +175,23 @@ class RunControl:
         self.pause_requested = False
         if not should_pause:
             return
+        if terminal and not explicit:
+            # a step/run-until pause on a drained queue has no next window
+            # to pause before; blocking would hang the console loop
+            self.step_windows_remaining = 0
+            self.run_until_abs_ns = None
+            self._pending_run_for = None
+            self._print(
+                "[run-control] terminal: event queues drained at sim-time "
+                f"{stime.fmt(window_end)}; no further windows — run completes"
+            )
+            return
 
         self.pauses += 1
         self._print(
             f"[run-control] paused at window boundary: sim-time "
             f"{stime.fmt(window_end)} (next event {stime.fmt(next_event_time)}); "
-            "commands: c / cN / n / s / s:<pid> / r / rN"
+            "commands: c / cN / n / s / s:<pid> / r / rN / fault ... / failover"
         )
         self._print_info()
         # soft-wait: block until a resuming command arrives
@@ -205,6 +248,29 @@ class RunControl:
             raise RestartRequest(None)
         if cmd.startswith("r") and cmd[1:].strip().isdigit():
             raise RestartRequest(int(cmd[1:].strip()) * NANOS_PER_SEC)
+        if cmd == "failover":
+            if self.failover_armed:
+                from ..faults.watchdog import FailoverRequest
+
+                raise FailoverRequest("run-control failover command")
+            self._print(
+                "[run-control] failover is a tpu-backend command (this run "
+                "is already on the cpu engine)"
+            )
+            return False
+        if cmd == "fault" or cmd.startswith("fault "):
+            tokens = cmd.split()[1:]
+            if self._fault_sink is None:
+                self._print(
+                    "[run-control] fault injection is not available on this "
+                    "backend (cpu backend only)"
+                )
+                return False
+            try:
+                self._print(f"[run-control] {self._fault_sink(tokens)}")
+            except Exception as e:  # bad verb/args: report, stay paused
+                self._print(f"[run-control] fault rejected: {e}")
+            return False
         self._print(f"[run-control] unknown command {cmd!r}")
         return False
 

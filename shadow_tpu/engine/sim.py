@@ -47,6 +47,7 @@ class Simulation:
                 # alike; a stdin already drained for the config just EOFs
                 self.run_control.start_stdin_thread()
         self.restarts = 0
+        self.failovers = 0  # TPU->CPU graceful degradations this run
         self.engine = None  # the backend engine of the most recent run()
 
     # -- running -----------------------------------------------------------
@@ -90,7 +91,7 @@ class Simulation:
         while True:
             try:
                 if backend == "tpu":
-                    result = self._run_tpu()
+                    result = self._run_tpu_guarded()
                 else:
                     result = self._run_cpu()
                 break
@@ -129,6 +130,7 @@ class Simulation:
         if not heartbeat and rc is None:
             return None  # no consumer: keep the round loop free of the hook
         state = {"next_beat": heartbeat or 0, "rounds": 0}
+        stop_time = self.cfg.general.stop_time
 
         def on_window(window_start: int, window_end: int, next_ev: int) -> None:
             state["rounds"] += 1
@@ -153,13 +155,56 @@ class Simulation:
                     describe=(
                         (lambda: describe_source(until)) if describe_source else None
                     ),
+                    # drained queue / nothing before stop: a step or
+                    # run-until pause here would block on a window that
+                    # will never come — report terminal status instead
+                    terminal=next_ev >= stop_time,
                 )
                 rc.consume_run_for(window_end)
 
         return on_window
 
+    def _run_tpu_guarded(self) -> SimResult:
+        """The graceful-degradation boundary (docs/faults.md): when
+        ``faults.failover`` is enabled, any failure of the TPU path — an
+        injected ``backend_stall``, a watchdog-detected stall, a
+        run-control ``failover`` command, or a real backend error —
+        degrades to a **deterministic CPU replay from t=0**.  Replay is
+        exact recovery: the CPU engine executes the identical window
+        sequence and event order (the cross-backend parity contract), so
+        the failed run's prefix is reproduced bit-for-bit and the run
+        completes with the same event log an unfaulted CPU-only run of
+        the same config yields."""
+        from ..faults.watchdog import BackendStallError, FailoverRequest
+
+        try:
+            return self._run_tpu()
+        except RestartRequest:
+            raise
+        except (BackendStallError, FailoverRequest) as e:
+            if not self.cfg.faults.failover_enabled:
+                raise
+            reason: Exception = e
+        except Exception as e:
+            if not self.cfg.faults.failover_enabled:
+                raise
+            reason = e
+        self.failovers += 1
+        log.warning(
+            "tpu backend failed (%s: %s); degrading to the cpu engine "
+            "(deterministic replay from t=0)",
+            type(reason).__name__,
+            reason,
+        )
+        return self._run_cpu()
+
     def _run_cpu(self) -> SimResult:
         engine = self.engine = CpuEngine(self.cfg)
+        if self.run_control is not None:
+            # the `fault ...` console verb schedules faults at the next
+            # window boundary (cpu backend only: the device program's
+            # tables are baked per epoch and cannot take ad-hoc edits)
+            self.run_control.set_fault_sink(engine.console_fault_sink)
         if self.cfg.experimental.perf_logging:
             engine.perf_log = PerfLog()
         t0 = time.perf_counter()
@@ -174,9 +219,16 @@ class Simulation:
 
     def _run_tpu(self) -> SimResult:
         from ..backend.hybrid import HybridEngine, config_has_managed
-        from ..backend.tpu_engine import TpuEngine
+        from ..backend.tpu_engine import LaneCompatError, TpuEngine
 
         if config_has_managed(self.cfg):
+            if self.cfg.faults.events:
+                # the guarded caller degrades this to a CPU replay when
+                # failover is enabled — managed hosts run there natively
+                raise LaneCompatError(
+                    "fault schedules are not supported on the hybrid tpu "
+                    "backend; use the cpu backend"
+                )
             # the HYBRID backend: managed hosts' syscall plane on the host
             # CPU, the packet data plane (theirs included) on the device.
             # Run-control and perf-logging need the per-round step seam,
@@ -208,6 +260,12 @@ class Simulation:
         engine = self.engine = TpuEngine(self.cfg)
         mesh_shape = self.cfg.experimental.tpu_mesh_shape
         if mesh_shape is not None and len(mesh_shape) == 1 and mesh_shape[0] > 1:
+            if self.cfg.faults.events:
+                raise LaneCompatError(
+                    "fault schedules are not supported on the sharded-mesh "
+                    "driver (fused on-device loop); drop tpu_mesh_shape or "
+                    "use the cpu backend"
+                )
             import jax
 
             from .. import parallel
@@ -232,6 +290,10 @@ class Simulation:
             return engine.run(mode="device")
         t0 = time.perf_counter()
         on_window = self._make_on_window(None, engine.current_runahead, t0)
+        if self.run_control is not None:
+            # the `failover` console verb is live on the pausable tpu
+            # driver: it unwinds a FailoverRequest to the guarded caller
+            self.run_control.failover_armed = True
         if self.cfg.experimental.perf_logging:
             engine.perf_log = PerfLog()
         return engine.run(mode="step", on_window=on_window)
@@ -247,6 +309,7 @@ class Simulation:
             "sim_seconds_per_wall_second": result.sim_seconds_per_wall_second,
             "rounds": result.rounds,
             "restarts": self.restarts,
+            "failovers": self.failovers,
             "backend": self.cfg.experimental.network_backend,
             "num_hosts": len(self.cfg.hosts),
             "seed": self.cfg.general.seed,
@@ -271,6 +334,12 @@ class Simulation:
         for r in result.event_log:
             name = OUTCOME_NAMES.get(r.outcome, str(r.outcome))
             out[name] = out.get(name, 0) + 1
+        # flows the lTCP sender abandoned after MAX_RTO_BACKOFFS consecutive
+        # timeouts (net/ltcp.py): not a wire event, but an outcome operators
+        # need next to the drop counts when links stay dark
+        retry_drops = result.counters.get("stream_retry_drops", 0)
+        if retry_drops:
+            out["retry_drop"] = out.get("retry_drop", 0) + retry_drops
         return out
 
     def write_event_log(self, result: SimResult, path: Optional[Path] = None) -> Path:

@@ -1,0 +1,35 @@
+"""Deterministic fault injection and graceful backend degradation.
+
+The subsystem has three layers, mirroring how the reference fork stress-
+tests consensus networks inside the simulation rather than around it:
+
+- :mod:`shadow_tpu.faults.schedule` — the declarative, validated fault
+  schedule (the ``faults:`` config section): link down/up, per-edge
+  loss/latency changes, network bipartitions, host crash/restart, and
+  injected backend stalls, each pinned to a simulated time.
+- :mod:`shadow_tpu.faults.overlay` — the schedule compiled into versioned
+  routing tables: one ``(latency_ns, packet_loss, loss_threshold)``
+  snapshot per fault epoch, derived from the base
+  :class:`~shadow_tpu.net.graph.NetworkGraph` by re-running the
+  shortest-path compile over the surviving edges.  The CPU engine installs
+  snapshots in place at window boundaries; the TPU engine re-uploads them
+  as fresh gather tables at epoch boundaries.  Both backends clamp round
+  windows at fault epochs, so the window sequence — and therefore the
+  event log — is bit-identical across backends and across runs.
+- :mod:`shadow_tpu.faults.watchdog` — the graceful-degradation boundary:
+  a per-round stall watchdog for the TPU step driver and the
+  :class:`FailoverRequest`/:class:`BackendStallError` signals the
+  simulation facade converts into a deterministic CPU replay.
+"""
+
+from .schedule import FaultConfigError, FaultEvent, FaultSchedule
+from .watchdog import BackendStallError, FailoverRequest, RoundWatchdog
+
+__all__ = [
+    "FaultConfigError",
+    "FaultEvent",
+    "FaultSchedule",
+    "BackendStallError",
+    "FailoverRequest",
+    "RoundWatchdog",
+]

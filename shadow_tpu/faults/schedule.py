@@ -1,0 +1,244 @@
+"""Declarative fault schedules: parsing + validation.
+
+The ``faults.events`` config list is parsed into typed
+:class:`FaultEvent` records at config-validation time, so a typo'd kind
+or an out-of-range loss fails the config — never the run.  Event kinds:
+
+========================  =====================================================
+``link_down``             remove the GML edge ``source``/``target`` from
+                          routing (traffic reroutes if an alternative path
+                          exists; otherwise the pair drops every packet)
+``link_up``               restore the edge to its base properties (clears any
+                          loss/latency override too)
+``loss``                  set the edge's ``packet_loss`` to ``loss``
+``latency``               set the edge's ``latency`` to ``latency``
+``partition``             bipartition (or k-partition) the graph:
+                          ``groups: [[0], [1, 2]]`` lists graph node ids;
+                          pairs in *different* groups drop every packet;
+                          nodes not listed are unaffected.  A new partition
+                          replaces the previous one.
+``heal``                  clear the active partition
+``host_crash``            isolate ``host`` from the network entirely (every
+                          packet to or from it drops); the host's own graph
+                          node must not be shared with other hosts
+``host_restart``          undo a ``host_crash``
+``backend_stall``         inject a simulated backend failure: the TPU engine
+                          raises at this epoch (exercising CPU failover);
+                          the CPU engine — being the failover target —
+                          treats it as a window-boundary no-op
+========================  =====================================================
+
+Every event has an ``at:`` simulated time (unit string or bare seconds).
+All times become deterministic *window-clamp epochs* on both backends:
+no round window ever straddles a fault, which is what makes fault replay
+bit-identical (docs/faults.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+from ..config import units
+
+
+class FaultConfigError(ValueError):
+    pass
+
+
+LINK_KINDS = ("link_down", "link_up", "loss", "latency")
+HOST_KINDS = ("host_crash", "host_restart")
+KINDS = LINK_KINDS + HOST_KINDS + ("partition", "heal", "backend_stall")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One schedule entry.  Unused fields keep their neutral defaults so
+    the record stays a plain, hashable value object."""
+
+    at: int  # ns, > 0
+    kind: str
+    source: int = -1  # graph node id (link kinds)
+    target: int = -1
+    loss: float = -1.0  # [0,1] (kind == "loss")
+    latency_ns: int = 0  # > 0 (kind == "latency")
+    groups: tuple[tuple[int, ...], ...] = ()  # kind == "partition"
+    host: str = ""  # hostname (host kinds)
+
+
+def _parse_groups(v: Any) -> tuple[tuple[int, ...], ...]:
+    if not isinstance(v, (list, tuple)) or len(v) < 2:
+        raise FaultConfigError(
+            "partition 'groups' must list at least two groups of graph "
+            f"node ids, e.g. [[0], [1, 2]]; got {v!r}"
+        )
+    groups = []
+    seen: set[int] = set()
+    for g in v:
+        if not isinstance(g, (list, tuple)) or not g:
+            raise FaultConfigError(f"partition group must be a non-empty list, got {g!r}")
+        ids = tuple(int(x) for x in g)
+        dup = seen.intersection(ids)
+        if dup or len(set(ids)) != len(ids):
+            raise FaultConfigError(
+                f"partition groups must be disjoint (node {sorted(dup or set(ids))[0]} repeats)"
+            )
+        seen.update(ids)
+        groups.append(ids)
+    return tuple(groups)
+
+
+def parse_event(doc: dict[str, Any]) -> FaultEvent:
+    if not isinstance(doc, dict):
+        raise FaultConfigError(f"fault event must be a mapping, got {doc!r}")
+    doc = dict(doc)
+    if "at" not in doc:
+        raise FaultConfigError("fault event needs an 'at' time")
+    at = units.parse_time(doc.pop("at"))
+    if at <= 0:
+        raise FaultConfigError(
+            f"fault event 'at' must be > 0 (initial conditions belong in the "
+            f"graph itself), got {at} ns"
+        )
+    kind = str(doc.pop("kind", ""))
+    if kind not in KINDS:
+        raise FaultConfigError(
+            f"unknown fault kind {kind!r}; expected one of {sorted(KINDS)}"
+        )
+    ev = {"at": at, "kind": kind}
+    if kind in LINK_KINDS:
+        for k in ("source", "target"):
+            if k not in doc:
+                raise FaultConfigError(f"{kind} event needs '{k}' (a graph node id)")
+            ev[k] = int(doc.pop(k))
+        if kind == "loss":
+            if "loss" not in doc:
+                raise FaultConfigError("loss event needs a 'loss' value in [0, 1]")
+            loss = float(doc.pop("loss"))
+            if not math.isfinite(loss) or not (0.0 <= loss <= 1.0):
+                raise FaultConfigError(
+                    f"loss event: 'loss' must be a finite value in [0, 1], got {loss!r}"
+                )
+            ev["loss"] = loss
+        elif kind == "latency":
+            if "latency" not in doc:
+                raise FaultConfigError(
+                    'latency event needs a \'latency\' unit string like "20 ms"'
+                )
+            lat = units.parse_time(doc.pop("latency"))
+            if lat <= 0:
+                raise FaultConfigError("latency event: 'latency' must be > 0")
+            ev["latency_ns"] = lat
+    elif kind == "partition":
+        ev["groups"] = _parse_groups(doc.pop("groups", None))
+    elif kind in HOST_KINDS:
+        host = doc.pop("host", None)
+        if not host:
+            raise FaultConfigError(f"{kind} event needs a 'host' (hostname)")
+        ev["host"] = str(host)
+    # heal / backend_stall take no extra fields
+    if doc:
+        raise FaultConfigError(
+            f"unknown keys on {kind} fault event: {sorted(doc)}"
+        )
+    return FaultEvent(**ev)
+
+
+class FaultSchedule:
+    """An ordered, validated list of fault events.
+
+    Events are kept in ``(at, listed-order)`` order: same-instant events
+    apply in the order the config lists them, which makes the cumulative
+    fault state — and every table snapshot — deterministic.
+    """
+
+    def __init__(self, events: list[FaultEvent]) -> None:
+        self.events = sorted(
+            events, key=lambda e: e.at
+        )  # Python sort is stable: listed order breaks ties
+
+    @classmethod
+    def parse(cls, raw: list) -> "FaultSchedule":
+        if raw is None:
+            raw = []
+        if not isinstance(raw, (list, tuple)):
+            raise FaultConfigError(
+                f"faults.events must be a list of event mappings, got {raw!r}"
+            )
+        return cls([parse_event(e) for e in raw])
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def epoch_times(self) -> list[int]:
+        """Sorted unique event times — the window-clamp epochs."""
+        return sorted({e.at for e in self.events})
+
+    def events_at(self, t: int) -> list[FaultEvent]:
+        return [e for e in self.events if e.at == t]
+
+    def add(self, ev: FaultEvent) -> None:
+        """Insert a (console-injected) event, keeping the order invariant."""
+        self.events = sorted(self.events + [ev], key=lambda e: e.at)
+
+
+# -- run-control console grammar --------------------------------------------
+
+_CONSOLE_USAGE = (
+    "fault link_down S T | fault link_up S T | fault loss S T P | "
+    "fault latency S T DUR | fault partition A,B|C,... | fault heal | "
+    "fault crash HOST | fault restart HOST"
+)
+
+
+def parse_console_fault(tokens: list[str], at: int) -> FaultEvent:
+    """Parse a run-control ``fault ...`` command into an event effective at
+    ``at`` (the current window boundary).  Grammar::
+
+        fault link_down 0 1
+        fault link_up 0 1
+        fault loss 0 1 0.3
+        fault latency 0 1 20ms
+        fault partition 0|1,2
+        fault heal
+        fault crash relay1
+        fault restart relay1
+    """
+    if not tokens:
+        raise FaultConfigError(f"empty fault command; usage: {_CONSOLE_USAGE}")
+    verb, args = tokens[0], tokens[1:]
+    alias = {"crash": "host_crash", "restart": "host_restart"}
+    kind = alias.get(verb, verb)
+    # ``at`` arrives in ns; spell it out so parse_time's bare-seconds
+    # convention cannot misread it
+    doc: dict[str, Any] = {"at": f"{at} ns", "kind": kind}
+    try:
+        if kind in ("link_down", "link_up"):
+            doc["source"], doc["target"] = int(args[0]), int(args[1])
+        elif kind == "loss":
+            doc["source"], doc["target"] = int(args[0]), int(args[1])
+            doc["loss"] = float(args[2])
+        elif kind == "latency":
+            doc["source"], doc["target"] = int(args[0]), int(args[1])
+            doc["latency"] = args[2]
+        elif kind == "partition":
+            doc["groups"] = [
+                [int(x) for x in grp.split(",") if x] for grp in args[0].split("|")
+            ]
+        elif kind in ("host_crash", "host_restart"):
+            doc["host"] = args[0]
+        elif kind == "heal":
+            pass
+        else:
+            raise FaultConfigError(
+                f"unknown fault verb {verb!r}; usage: {_CONSOLE_USAGE}"
+            )
+    except (IndexError, ValueError) as e:
+        if isinstance(e, FaultConfigError):
+            raise
+        raise FaultConfigError(
+            f"bad arguments for 'fault {verb}': {' '.join(args)!r}; "
+            f"usage: {_CONSOLE_USAGE}"
+        )
+    return parse_event(doc)

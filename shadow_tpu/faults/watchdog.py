@@ -1,0 +1,68 @@
+"""Backend watchdog: the TPU->CPU graceful-degradation boundary.
+
+Two signals cross it:
+
+- :class:`BackendStallError` — the TPU engine detected (or was injected
+  with) a stalled/failed device round: a ``backend_stall`` schedule event
+  fired, or a step-mode round exceeded ``faults.watchdog_timeout`` wall
+  seconds.
+- :class:`FailoverRequest` — an explicit demand to degrade, raised by the
+  run-control ``failover`` verb from a window boundary.
+
+The simulation facade (engine/sim.py) catches both — plus any other
+exception escaping the TPU path while ``faults.failover`` is enabled —
+and **replays the run deterministically on the CPU engine from t=0**.
+Replay is the recovery mechanism because determinism makes it exact: the
+CPU run executes the identical window sequence and event order the TPU
+run would have produced (the cross-backend parity contract), so the
+failed run's prefix is reproduced bit-for-bit and the run completes with
+the event log an unfaulted CPU run of the same config yields.  No device
+state needs to survive the failure for the result to be correct.
+"""
+
+from __future__ import annotations
+
+import time as wall_time
+from typing import Optional
+
+
+class BackendStallError(RuntimeError):
+    """A TPU round stalled, failed, or was injected to fail."""
+
+
+class FailoverRequest(Exception):
+    """Unwound out of the round loop to force a CPU failover."""
+
+    def __init__(self, reason: str = "failover requested") -> None:
+        self.reason = reason
+        super().__init__(reason)
+
+
+class RoundWatchdog:
+    """Wall-clock stall detector for the step driver: feed it each round's
+    duration; it raises :class:`BackendStallError` when a single device
+    round exceeds the timeout.  (The fused device run is one opaque call —
+    a stall there surfaces as the device runtime's own error, which the
+    same failover boundary catches.)"""
+
+    def __init__(self, timeout_seconds: Optional[float]) -> None:
+        self.timeout = timeout_seconds
+        self.rounds = 0
+        self.worst = 0.0
+
+    def observe(self, elapsed_seconds: float) -> None:
+        self.rounds += 1
+        if elapsed_seconds > self.worst:
+            self.worst = elapsed_seconds
+        if self.timeout is not None and elapsed_seconds > self.timeout:
+            raise BackendStallError(
+                f"device round {self.rounds} took {elapsed_seconds:.3f}s "
+                f"(watchdog_timeout={self.timeout:.3f}s)"
+            )
+
+    def timed(self, fn, *args):
+        """Run ``fn(*args)``, observe its duration, return its result."""
+        t0 = wall_time.perf_counter()
+        out = fn(*args)
+        self.observe(wall_time.perf_counter() - t0)
+        return out

@@ -52,6 +52,11 @@ class _FlowDriver:
             api.schedule_at(api.now, self._pump_cb(fs, peer, client, conn))
         if em.arm_rto is not None:
             api.schedule_at(em.arm_rto, self._rto_cb(fs, peer, client, conn))
+        if em.aborted:
+            # the ltcp give-up law fired (MAX_RTO_BACKOFFS consecutive
+            # timeouts — a dead path); surfaced in sim-stats
+            # packet_outcomes as "retry_drop" (engine/sim.py)
+            api.count("stream_retry_drops")
         return em
 
     def _pump_cb(self, fs, peer, client, conn):

@@ -94,8 +94,22 @@ class NetworkGraph:
             raise GraphError("duplicate node ids")
         self.id_to_index = {nid: i for i, nid in enumerate(self.node_ids)}
         for e in edges:
+            # finiteness first: NaN slips through range comparisons (every
+            # NaN comparison is False, so ``0.0 <= nan <= 1.0`` rejects it
+            # only by accident of the chained form — be explicit), and an
+            # inf latency would poison the shortest-path accumulation
+            if isinstance(e.latency_ns, float) and not math.isfinite(e.latency_ns):
+                raise GraphError(
+                    f"edge {e.source}->{e.target}: latency must be a finite "
+                    f"value, got {e.latency_ns!r}"
+                )
             if e.latency_ns <= 0:
                 raise GraphError(f"edge {e.source}->{e.target}: latency must be > 0")
+            if not math.isfinite(e.packet_loss):
+                raise GraphError(
+                    f"edge {e.source}->{e.target}: packet_loss must be a "
+                    f"finite value, got {e.packet_loss!r}"
+                )
             if not (0.0 <= e.packet_loss <= 1.0):
                 raise GraphError(
                     f"edge {e.source}->{e.target}: packet_loss not in [0,1]"
@@ -282,6 +296,30 @@ class NetworkGraph:
         np.fill_diagonal(lat, np.diag(direct_lat))
         np.fill_diagonal(loss, np.diag(direct_loss))
         return lat, loss
+
+    def install_tables(
+        self,
+        latency_ns: np.ndarray,
+        packet_loss: np.ndarray,
+        loss_threshold: np.ndarray,
+    ) -> None:
+        """Swap the compiled pair tables in place — the fault-epoch seam
+        (shadow_tpu/faults/overlay.py): RoutingInfo reads these arrays on
+        every ``path()``, so installing a snapshot redirects all
+        subsequent sends without rebuilding hosts or routing."""
+        g = len(self.nodes)
+        for name, arr in (
+            ("latency_ns", latency_ns),
+            ("packet_loss", packet_loss),
+            ("loss_threshold", loss_threshold),
+        ):
+            if arr.shape != (g, g):
+                raise GraphError(
+                    f"install_tables: {name} has shape {arr.shape}, want {(g, g)}"
+                )
+        self.latency_ns = latency_ns
+        self.packet_loss = packet_loss
+        self.loss_threshold = loss_threshold
 
     # -- queries ----------------------------------------------------------
 

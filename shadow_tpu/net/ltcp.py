@@ -108,6 +108,16 @@ PUMP_BURST = RWND_SEGS
 RTO_INIT = 1_000_000_000  # 1 s
 RTO_MIN = 200_000_000  # 200 ms (Linux's floor)
 RTO_MAX = 60_000_000_000  # 60 s
+# Give-up bound (Linux's tcp_retries2 analog): after this many CONSECUTIVE
+# timeouts with no forward progress the flow aborts (state -> DONE,
+# Emit.aborted) instead of retransmitting forever into a dead link — the
+# fault-injection subsystem makes permanently-dark paths a first-class
+# scenario.  The backoff counter resets on any new-data ACK.  NOTE: the
+# vectorized lane twin (backend/lanes_stream.py) retains unbounded retries;
+# the laws diverge only after MAX_RTO_BACKOFFS consecutive timeouts (over
+# two minutes of cumulative RTO under the doubling law), far beyond the
+# lane backend's supported windows — documented in docs/faults.md.
+MAX_RTO_BACKOFFS = 8
 
 HDR_BYTES = 40  # IP (20) + TCP (20) wire overhead per segment
 
@@ -149,6 +159,7 @@ class FlowState:
     # retransmission timer
     rto_deadline: int = NEVER  # when the pending data times out
     rto_evt: int = NEVER  # time of the queued RTO event (dedup law)
+    backoffs: int = 0  # consecutive timeouts since the last new-data ACK
     # stats
     tx_segs: int = 0
     rx_segs: int = 0
@@ -167,6 +178,7 @@ class Emit:
     arm_pump: bool = False  # queue a pump event at the current time
     arm_rto: Optional[int] = None  # queue an RTO event at this time
     completed: bool = False  # flow reached DONE on this stimulus
+    aborted: bool = False  # gave up after MAX_RTO_BACKOFFS timeouts
 
     @property
     def send(self):  # first send (compat accessor for single-send paths)
@@ -406,7 +418,16 @@ def _on_rto_inner(fs: FlowState, now: int) -> Emit:
         fs.rto_evt = fs.rto_deadline
         em.arm_rto = fs.rto_deadline
         return em
-    # timeout: collapse the window, back off, go-back-N from the hole
+    # timeout: give up after MAX_RTO_BACKOFFS consecutive expiries (the
+    # path is dead — e.g. a fault-schedule link_down with no reroute);
+    # otherwise collapse the window, back off (the exponential growth is
+    # hard-capped at RTO_MAX), and go-back-N from the hole
+    fs.backoffs += 1
+    if fs.backoffs > MAX_RTO_BACKOFFS:
+        fs.state = DONE
+        fs.rto_deadline = NEVER
+        em.aborted = True
+        return em
     cc_on_loss(fs)
     fs.cwnd_fp = FP
     fs.dup_acks = 0
@@ -460,6 +481,7 @@ def _on_segment_inner(
         if ack > fs.snd_una:
             acked = ack - fs.snd_una
             fs.snd_una = ack
+            fs.backoffs = 0  # forward progress: the retry budget refills
             if fs.snd_nxt < fs.snd_una:
                 # a delayed ACK (sent before a spurious RTO's go-back-N
                 # rewind) may cover units above the rewound snd_nxt; clamp

@@ -34,11 +34,37 @@ hosts:
 """
 
 
+PHOLD_FAULTED = PHOLD + """
+faults:
+  events:
+    - {at: 100ms, kind: loss, source: 0, target: 1, loss: 0.5}
+    - {at: 200ms, kind: link_down, source: 0, target: 1}
+    - {at: 300ms, kind: link_up, source: 0, target: 1}
+"""
+
+
 def test_phold_cpu_run_twice_identical():
     report = determinism_check(ConfigOptions.from_yaml(PHOLD))
     assert report.identical, report.describe()
     assert report.records > 50
     assert "PASSED" in report.describe()
+
+
+@pytest.mark.faults
+def test_phold_faulted_cpu_run_twice_identical():
+    # same seed + same fault schedule -> bit-identical event logs: every
+    # fault epoch is a deterministic window-clamp boundary (docs/faults.md)
+    report = determinism_check(ConfigOptions.from_yaml(PHOLD_FAULTED))
+    assert report.identical, report.describe()
+    assert report.records > 20
+
+
+@pytest.mark.faults
+def test_phold_faulted_tpu_run_twice_identical():
+    cfg = ConfigOptions.from_yaml(PHOLD_FAULTED)
+    cfg.experimental.network_backend = "tpu"
+    report = determinism_check(cfg)
+    assert report.identical, report.describe()
 
 
 def test_phold_tpu_run_twice_identical():

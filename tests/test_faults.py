@@ -1,0 +1,461 @@
+"""Fault-injection subsystem (shadow_tpu/faults/): schedule parsing,
+versioned routing tables, deterministic replay, cross-backend parity of
+faulted runs, and the TPU->CPU graceful-degradation (failover) path.
+
+The determinism contract under test is docs/faults.md's: every fault
+event time is a window-clamp epoch on both backends, so the same config +
+seed always yields byte-identical event logs — across repeats AND across
+backends — and a failed TPU run recovers by deterministic CPU replay
+with the exact event log an unfaulted CPU-only run produces.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from shadow_tpu.backend.cpu_engine import DELIVERED, DROP_LOSS, CpuEngine
+from shadow_tpu.config.options import ConfigError, ConfigOptions
+from shadow_tpu.engine.determinism import determinism_check
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.faults.overlay import FULL_THRESHOLD, FaultOverlay, build_overlay
+from shadow_tpu.faults.schedule import (
+    FaultConfigError,
+    FaultSchedule,
+    parse_console_fault,
+    parse_event,
+)
+from shadow_tpu.faults.watchdog import BackendStallError, RoundWatchdog
+
+pytestmark = pytest.mark.faults
+
+REPO = Path(__file__).resolve().parents[1]
+
+TWO_NODE_GRAPH = """
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "2 ms" ]
+        edge [ source 0 target 1 latency "5 ms" ]
+        edge [ source 1 target 1 latency "2 ms" ]
+      ]
+"""
+
+BASE = f"""
+general: {{stop_time: 3s, seed: 13, heartbeat_interval: null}}
+network:
+  graph:
+    type: gml
+    inline: |
+{TWO_NODE_GRAPH}
+faults:
+  events:
+    - {{at: 1s, kind: partition, groups: [[0], [1]]}}
+    - {{at: 2s, kind: heal}}
+hosts:
+  a: {{network_node_id: 0, processes: [{{path: tgen-client, args: [--server, b, --interval, 50ms, --size, "600"]}}]}}
+  b: {{network_node_id: 1, processes: [{{path: tgen-server}}]}}
+"""
+
+
+def cfg_of(yaml: str, **overrides) -> ConfigOptions:
+    cfg = ConfigOptions.from_yaml(yaml)
+    cfg.apply_overrides(overrides)
+    return cfg
+
+
+# -- schedule parsing --------------------------------------------------------
+
+
+class TestScheduleParse:
+    def test_events_sorted_and_typed(self):
+        sched = FaultSchedule.parse(
+            [
+                {"at": "2s", "kind": "heal"},
+                {"at": "1s", "kind": "link_down", "source": 0, "target": 1},
+            ]
+        )
+        assert [e.kind for e in sched.events] == ["link_down", "heal"]
+        assert sched.epoch_times() == [10**9, 2 * 10**9]
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(FaultConfigError, match="unknown fault kind"):
+            parse_event({"at": "1s", "kind": "meteor_strike"})
+
+    def test_unknown_keys_rejected(self):
+        with pytest.raises(FaultConfigError, match="unknown keys"):
+            parse_event({"at": "1s", "kind": "heal", "bogus": 1})
+
+    def test_loss_must_be_finite_in_range(self):
+        for bad in (float("nan"), float("inf"), -0.1, 1.5):
+            with pytest.raises(FaultConfigError, match="finite value in"):
+                parse_event(
+                    {"at": "1s", "kind": "loss", "source": 0, "target": 1, "loss": bad}
+                )
+
+    def test_at_must_be_positive(self):
+        with pytest.raises(FaultConfigError, match="must be > 0"):
+            parse_event({"at": 0, "kind": "heal"})
+
+    def test_partition_groups_validated(self):
+        with pytest.raises(FaultConfigError, match="at least two groups"):
+            parse_event({"at": "1s", "kind": "partition", "groups": [[0, 1]]})
+        with pytest.raises(FaultConfigError, match="disjoint"):
+            parse_event({"at": "1s", "kind": "partition", "groups": [[0], [0, 1]]})
+
+    def test_config_validate_rejects_bad_schedule(self):
+        cfg = cfg_of(BASE)
+        cfg.faults.events = [{"at": "1s", "kind": "nope"}]
+        with pytest.raises(ConfigError, match="faults.events"):
+            cfg.validate()
+
+    def test_bootstrap_window_rejected(self):
+        cfg = cfg_of(BASE)
+        cfg.general.bootstrap_end_time = int(1.5e9)
+        with pytest.raises(ConfigError, match="bootstrap"):
+            cfg.validate()
+
+    def test_console_grammar(self):
+        ev = parse_console_fault(["link_down", "0", "1"], at=7)
+        assert (ev.kind, ev.source, ev.target, ev.at) == ("link_down", 0, 1, 7)
+        ev = parse_console_fault(["partition", "0|1,2"], at=7)
+        assert ev.groups == ((0,), (1, 2))
+        ev = parse_console_fault(["crash", "relay1"], at=7)
+        assert (ev.kind, ev.host) == ("host_crash", "relay1")
+        with pytest.raises(FaultConfigError, match="usage"):
+            parse_console_fault(["loss", "0"], at=7)
+
+
+# -- overlay table compilation ----------------------------------------------
+
+
+def make_overlay(events, yaml=BASE) -> FaultOverlay:
+    cfg = cfg_of(yaml)
+    cfg.faults.events = events
+    engine = CpuEngine(cfg)
+    return build_overlay(cfg, engine.graph, engine.routing)
+
+
+class TestOverlay:
+    def test_link_down_without_reroute_drops_pair(self):
+        ov = make_overlay([{"at": "1s", "kind": "link_down", "source": 0, "target": 1}])
+        snap = ov.snapshot_at(10**9)
+        assert snap is not None
+        # cross pair drops everything but keeps the base latency
+        assert snap.loss_threshold[0, 1] == FULL_THRESHOLD
+        assert snap.loss_threshold[1, 0] == FULL_THRESHOLD
+        assert snap.latency_ns[0, 1] == ov.base.latency_ns[0, 1]
+        # self-loops untouched
+        assert snap.loss_threshold[0, 0] == 0
+
+    def test_link_up_restores_base(self):
+        ov = make_overlay(
+            [
+                {"at": "1s", "kind": "link_down", "source": 0, "target": 1},
+                {"at": "2s", "kind": "link_up", "source": 0, "target": 1},
+            ]
+        )
+        snap = ov.snapshot_at(2 * 10**9)
+        assert (snap.loss_threshold == ov.base.loss_threshold).all()
+        assert (snap.latency_ns == ov.base.latency_ns).all()
+
+    def test_link_down_reroutes_when_alternative_exists(self):
+        yaml = BASE.replace(
+            'edge [ source 0 target 1 latency "5 ms" ]',
+            'edge [ source 0 target 1 latency "5 ms" ]\n'
+            '        node [ id 2 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]\n'
+            '        edge [ source 0 target 2 latency "30 ms" ]\n'
+            '        edge [ source 2 target 1 latency "30 ms" ]',
+        )
+        ov = make_overlay(
+            [{"at": "1s", "kind": "link_down", "source": 0, "target": 1}], yaml
+        )
+        snap = ov.snapshot_at(10**9)
+        # traffic reroutes over the 60 ms detour instead of dropping
+        assert snap.latency_ns[0, 1] == 60 * 10**6
+        assert snap.loss_threshold[0, 1] == 0
+
+    def test_latency_event_changes_pair(self):
+        ov = make_overlay(
+            [{"at": "1s", "kind": "latency", "source": 0, "target": 1,
+              "latency": "15 ms"}]
+        )
+        snap = ov.snapshot_at(10**9)
+        assert snap.latency_ns[0, 1] == 15 * 10**6
+
+    def test_unknown_edge_rejected(self):
+        with pytest.raises(FaultConfigError, match="no edge"):
+            make_overlay([{"at": "1s", "kind": "link_down", "source": 0, "target": 9}])
+
+    def test_crash_of_shared_node_rejected(self):
+        yaml = BASE.replace("b: {network_node_id: 1,", "b: {network_node_id: 0,")
+        with pytest.raises(FaultConfigError, match="shares graph node"):
+            make_overlay([{"at": "1s", "kind": "host_crash", "host": "a"}], yaml)
+
+    def test_crash_isolates_and_restart_heals(self):
+        ov = make_overlay(
+            [
+                {"at": "1s", "kind": "host_crash", "host": "a"},
+                {"at": "2s", "kind": "host_restart", "host": "a"},
+            ]
+        )
+        down = ov.snapshot_at(10**9)
+        assert (down.loss_threshold[0, :] == FULL_THRESHOLD).all()
+        assert (down.loss_threshold[:, 0] == FULL_THRESHOLD).all()
+        up = ov.snapshot_at(2 * 10**9)
+        assert (up.loss_threshold == ov.base.loss_threshold).all()
+
+
+# -- engine behavior ---------------------------------------------------------
+
+
+def outcomes_by_second(result):
+    out: dict[tuple[int, int], int] = {}
+    for r in result.event_log:
+        key = (r.time // 10**9, r.outcome)
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+class TestCpuFaultRuns:
+    def test_partition_drops_then_heals(self):
+        r = CpuEngine(cfg_of(BASE)).run()
+        by = outcomes_by_second(r)
+        assert by[(0, DELIVERED)] > 0
+        assert by[(1, DROP_LOSS)] > 0  # partitioned second: all drops
+        assert (1, DELIVERED) not in by
+        assert by[(2, DELIVERED)] > 0  # healed
+
+    def test_windows_clamp_at_epochs(self):
+        engine = CpuEngine(cfg_of(BASE))
+        bounds = []
+        engine.run(on_window=lambda s, e, n: bounds.append((s, e)))
+        # no window straddles a fault epoch
+        for s, e in bounds:
+            for t in (10**9, 2 * 10**9):
+                assert not (s < t < e), f"window [{s}, {e}) straddles epoch {t}"
+
+    def test_fault_run_deterministic(self):
+        report = determinism_check(cfg_of(BASE))
+        assert report.identical, report.describe()
+
+    def test_dead_path_aborts_stream_and_surfaces_retry_drop(self):
+        """A permanent link_down with no reroute mid-transfer: the lTCP
+        sender exhausts MAX_RTO_BACKOFFS and gives up; the abandonment is
+        surfaced as `retry_drop` next to the wire outcomes."""
+        yaml = """
+general: {stop_time: 300s, seed: 3, heartbeat_interval: null}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        node [ id 1 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 1 latency "10 ms" ]
+      ]
+faults:
+  events:
+    - {at: 50ms, kind: link_down, source: 0, target: 1}
+hosts:
+  c1: {network_node_id: 0, processes: [{path: stream-client, args: [--server, s1, --size, "5 MB"]}]}
+  s1: {network_node_id: 1, processes: [{path: stream-server}]}
+"""
+        sim = Simulation(cfg_of(yaml))
+        result = sim.run(write_data=False)
+        assert result.counters.get("stream_retry_drops", 0) > 0
+        assert result.counters.get("stream_complete", 0) == 0
+        out = sim._outcome_counts(result)
+        assert out["retry_drop"] == result.counters["stream_retry_drops"]
+
+    def test_example_partition_heal_deterministic(self):
+        cfg = ConfigOptions.from_yaml(
+            (REPO / "examples" / "partition-heal.yaml").read_text()
+        )
+        cfg.general.data_directory = "/tmp/shadow-tpu-test-faults.data"
+        report = determinism_check(cfg)
+        assert report.identical, report.describe()
+        assert report.records > 50
+
+
+class TestBackendParity:
+    """Same schedule, both backends: byte-identical event logs."""
+
+    @pytest.mark.parametrize("mode", ["step", "device"])
+    def test_partition_heal_parity(self, mode):
+        from shadow_tpu.backend.tpu_engine import TpuEngine
+
+        cpu = CpuEngine(cfg_of(BASE)).run()
+        tpu = TpuEngine(cfg_of(BASE)).run(mode=mode)
+        assert cpu.log_tuples() == tpu.log_tuples()
+        assert cpu.counters["tgen_recv_bytes"] == tpu.counters["tgen_recv_bytes"]
+
+    def test_crash_restart_parity(self):
+        from shadow_tpu.backend.tpu_engine import TpuEngine
+
+        yaml = BASE
+        events = [
+            {"at": "1s", "kind": "host_crash", "host": "a"},
+            {"at": "1400ms", "kind": "latency", "source": 0, "target": 1,
+             "latency": "15 ms"},
+            {"at": "2s", "kind": "host_restart", "host": "a"},
+        ]
+        c1, c2 = cfg_of(yaml), cfg_of(yaml)
+        c1.faults.events = events
+        c2.faults.events = list(events)
+        cpu = CpuEngine(c1).run()
+        tpu = TpuEngine(c2).run(mode="device")
+        assert cpu.log_tuples() == tpu.log_tuples()
+
+    def test_mid_flow_loss_ramp_stream_parity(self):
+        """Stream (lTCP) flows keep bit-parity through a loss ramp that
+        forces real retransmissions mid-transfer."""
+        from shadow_tpu.backend.tpu_engine import TpuEngine
+
+        yaml = f"""
+general: {{stop_time: 2s, seed: 5, heartbeat_interval: null}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        node [ id 1 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 1 latency "10 ms" ]
+      ]
+faults:
+  events:
+    - {{at: 20ms, kind: loss, source: 0, target: 1, loss: 0.25}}
+    - {{at: 60ms, kind: loss, source: 0, target: 1, loss: 0.0}}
+hosts:
+  c1: {{network_node_id: 0, processes: [{{path: stream-client, args: [--server, s1, --size, "300 kB"]}}]}}
+  s1: {{network_node_id: 1, processes: [{{path: stream-server}}]}}
+"""
+        cpu = CpuEngine(cfg_of(yaml)).run()
+        tpu = TpuEngine(cfg_of(yaml)).run(mode="device")
+        assert cpu.counters["stream_retransmits"] > 0  # the ramp bit
+        assert cpu.log_tuples() == tpu.log_tuples()
+        for k in ("stream_complete", "stream_rx_bytes", "stream_rx_segs",
+                  "stream_tx_segs", "stream_flows_done", "stream_retransmits"):
+            assert cpu.counters.get(k) == tpu.counters.get(k), k
+
+
+class TestFailover:
+    def test_injected_stall_fails_over_to_identical_cpu_run(self):
+        """The acceptance contract: a simulated TPU-round failure mid-run
+        triggers CPU failover that completes the run with the same final
+        event log as an unfaulted CPU-only run of the same schedule."""
+        yaml = BASE.replace(
+            "  events:",
+            "  events:\n    - {at: 1500ms, kind: backend_stall}",
+        )
+        sim = Simulation(cfg_of(yaml, **{"experimental.network_backend": "tpu"}))
+        r_tpu = sim.run(write_data=False)
+        assert sim.failovers == 1
+        r_cpu = Simulation(cfg_of(yaml)).run(write_data=False)
+        assert r_tpu.log_tuples() == r_cpu.log_tuples()
+        assert r_tpu.counters == r_cpu.counters
+
+    def test_failover_disabled_raises(self):
+        yaml = BASE.replace(
+            "  events:",
+            "  failover: false\n  events:\n    - {at: 1500ms, kind: backend_stall}",
+        )
+        sim = Simulation(cfg_of(yaml, **{"experimental.network_backend": "tpu"}))
+        with pytest.raises(BackendStallError):
+            sim.run(write_data=False)
+
+    def test_stall_event_is_noop_on_cpu(self):
+        """The CPU engine (the failover target) treats backend_stall as a
+        pure window-clamp epoch."""
+        yaml = BASE.replace(
+            "  events:",
+            "  events:\n    - {at: 1500ms, kind: backend_stall}",
+        )
+        with_stall = CpuEngine(cfg_of(yaml)).run()
+        without = CpuEngine(cfg_of(BASE)).run()
+        assert with_stall.log_tuples() == without.log_tuples()
+
+    def test_hybrid_with_faults_degrades_to_cpu(self, tmp_path):
+        """A managed-host (hybrid) config with a fault schedule cannot run
+        on the device; the failover boundary degrades it to the CPU engine,
+        where managed hosts run natively."""
+        build = REPO / "native" / "build"
+        if not (build / "pingpong").exists():
+            pytest.skip("native test binaries not built")
+        yaml = f"""
+general: {{stop_time: 2s, seed: 21, data_directory: {tmp_path / 'data'}, heartbeat_interval: null}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+experimental: {{network_backend: tpu}}
+faults:
+  events: [{{at: 1s, kind: heal}}]
+hosts:
+  cli:
+    network_node_id: 0
+    processes:
+      - path: {build / 'pingpong'}
+        args: [client, 11.0.0.2, "9000", "4", "100"]
+  srv:
+    network_node_id: 0
+    processes:
+      - path: {build / 'pingpong'}
+        args: [server, "9000", "4"]
+"""
+        sim = Simulation(ConfigOptions.from_yaml(yaml))
+        result = sim.run(write_data=False)
+        assert sim.failovers == 1
+        assert result.counters  # the cpu replay actually ran
+
+    def test_watchdog_raises_on_slow_round(self):
+        wd = RoundWatchdog(timeout_seconds=0.01)
+        wd.observe(0.005)
+        with pytest.raises(BackendStallError, match="watchdog_timeout"):
+            wd.observe(0.02)
+        assert wd.rounds == 2
+
+
+class TestRunControlFaults:
+    def test_console_fault_injection_drops_traffic(self):
+        """`fault link_down 0 1` at a pause kills cross traffic for the
+        rest of the run."""
+        import io
+
+        from shadow_tpu.engine.run_control import RunControl
+
+        out = io.StringIO()
+        rc = RunControl(out=out, poll_interval=0.01, max_wait=10)
+        rc.feed("c1", "fault link_down 0 1", "c")
+        result = Simulation(cfg_of(BASE.replace(
+            "faults:\n  events:\n    - {at: 1s, kind: partition, groups: [[0], [1]]}\n    - {at: 2s, kind: heal}\n",
+            "",
+        )), run_control=rc).run(write_data=False)
+        assert "fault link_down scheduled" in out.getvalue()
+        by = outcomes_by_second(result)
+        assert by[(0, DELIVERED)] > 0
+        assert by.get((2, DELIVERED), 0) == 0  # link stays dark
+        assert by[(2, DROP_LOSS)] > 0
+
+    def test_bad_console_fault_reports_not_crashes(self):
+        import io
+
+        from shadow_tpu.engine.run_control import RunControl
+
+        out = io.StringIO()
+        rc = RunControl(out=out, poll_interval=0.01, max_wait=10)
+        rc.feed("p", "fault link_down 0 9", "c")
+        Simulation(cfg_of(BASE), run_control=rc).run(write_data=False)
+        assert "fault rejected" in out.getvalue()
+
+    def test_failover_verb_on_cpu_reports(self):
+        import io
+
+        from shadow_tpu.engine.run_control import RunControl
+
+        out = io.StringIO()
+        rc = RunControl(out=out, poll_interval=0.01, max_wait=10)
+        rc.feed("p", "failover", "c")
+        Simulation(cfg_of(BASE), run_control=rc).run(write_data=False)
+        assert "already on the cpu engine" in out.getvalue()

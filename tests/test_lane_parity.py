@@ -77,6 +77,29 @@ def test_tgen_lossy_parity():
     assert cpu.counters["tgen_recv_bytes"] == tpu.counters["tgen_recv_bytes"]
 
 
+TGEN_FAULTED = TGEN_PAIR + """
+faults:
+  events:
+    - {at: 50ms, kind: latency, source: 0, target: 1, latency: "25 ms"}
+    - {at: 100ms, kind: link_down, source: 0, target: 1}
+    - {at: 200ms, kind: link_up, source: 0, target: 1}
+"""
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("mode", ["step", "device"])
+def test_fault_schedule_parity(mode):
+    """Fault epochs re-upload the device gather tables mid-run; the CPU
+    engine mutates its routing in place at the same window-clamp epochs —
+    delivered-event ordering must stay bit-identical (docs/faults.md)."""
+    cpu, tpu = both_logs(TGEN_FAULTED, mode=mode)
+    assert len(cpu.event_log) > 20
+    # the schedule actually bit: a latency shift and a dark window
+    assert any(r.outcome == 1 for r in cpu.event_log)
+    assert cpu.log_tuples() == tpu.log_tuples()
+    assert cpu.counters["tgen_recv_bytes"] == tpu.counters["tgen_recv_bytes"]
+
+
 MESH = """
 general: {stop_time: 200ms, seed: 11}
 network:

@@ -202,8 +202,11 @@ class TestLossRecovery:
         w = WireSim(size=2 * 1448, drop=drop).run()
         assert w.server.state == ltcp.DONE
 
-    def test_rto_exponential_backoff(self):
-        # kill every c2s data packet: RTO must keep doubling up to the cap
+    def test_rto_exponential_backoff_caps_then_gives_up(self):
+        # kill every c2s data packet: RTO doubles but never exceeds the
+        # hard cap, and after MAX_RTO_BACKOFFS consecutive timeouts the
+        # sender abandons the dead path (state -> DONE) instead of
+        # retransmitting forever
         w = WireSim(
             size=1448,
             drop=lambda d, f, s, a, n: d == "c2s" and bool(f & ltcp.F_DATA),
@@ -211,7 +214,21 @@ class TestLossRecovery:
         w.run(max_time=300_000 * MS)
         assert w.client.rto > ltcp.RTO_INIT
         assert w.client.rto <= ltcp.RTO_MAX
-        assert w.client.state != ltcp.DONE
+        assert w.client.backoffs > ltcp.MAX_RTO_BACKOFFS
+        assert w.client.state == ltcp.DONE  # gave up
+        assert w.client.rto_deadline == NEVER  # no timer left armed
+        assert w.server.rx_bytes == 0
+
+    def test_backoff_counter_resets_on_forward_progress(self):
+        # drop the first data transmission a few times, then let it
+        # through: the new-data ACK must refill the retry budget
+        w = WireSim(
+            size=3 * 1448,
+            drop=lambda d, f, s, a, n: d == "c2s" and bool(f & ltcp.F_DATA) and n <= 3,
+        ).run()
+        assert w.client.state == ltcp.DONE
+        assert w.server.rx_bytes == 3 * 1448  # completed, not aborted
+        assert w.client.backoffs == 0
 
     def test_heavy_random_loss_still_completes(self):
         import random

@@ -110,6 +110,29 @@ class TestSoftPause:
         assert rc.pauses == 1
         assert result.counters.get("ping_recv", 0) == 5
 
+    def test_step_past_drained_queue_terminates(self):
+        # more steps queued than windows exist: the step pause landing on
+        # the terminal boundary (event queues drained) must report and let
+        # the run complete instead of blocking on a window that never comes
+        out = io.StringIO()
+        rc = RunControl(out=out, poll_interval=0.01, max_wait=10)
+        rc.feed(*["n"] * 200)  # far more than the run has windows
+        sim = Simulation(make_cfg(), run_control=rc)
+        result = sim.run(write_data=False)
+        assert "terminal: event queues drained" in out.getvalue()
+        assert rc.step_windows_remaining == 0
+        assert result.counters.get("ping_recv", 0) == 5
+
+    def test_run_until_past_stop_terminates(self):
+        # c9 asks to pause at 9s but the run stops at 3s: the pending
+        # run-until must not leave the console blocked — the run completes
+        out = io.StringIO()
+        rc = RunControl(out=out, poll_interval=0.01, max_wait=10)
+        rc.feed("c9")
+        sim = Simulation(make_cfg(), run_control=rc)
+        result = sim.run(write_data=False)
+        assert result.counters.get("ping_recv", 0) == 5
+
     def test_info_prints_hosts(self):
         out = io.StringIO()
         rc = RunControl(out=out, poll_interval=0.01, max_wait=10)
