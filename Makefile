@@ -1,19 +1,34 @@
-# Repo-level targets.  `make gate` is the pre-snapshot ritual: the full
-# suite PLUS the 20x-repeat determinism stress gate (tests/test_stress.py)
-# that is otherwise env-gated off.  Mirrors the reference's determinism
+# Repo-level targets.  `make gate` is the pre-snapshot ritual: the static
+# determinism lint (shadowlint, both passes), the full suite, the
+# 20x-repeat determinism stress gate (tests/test_stress.py), the managed
+# scale gate (SHADOW_TPU_SCALE=1, 145 OS processes), and an examples/
+# end-to-end determinism smoke.  Mirrors the reference's determinism
 # CTest gate (src/test/determinism/CMakeLists.txt).
 
-.PHONY: test gate native smoke-faults
+.PHONY: test gate native smoke-faults smoke-examples lint-determinism
 
 test: native
 	python -m pytest tests/ -q
 
-gate: native
-	python -m pytest tests/ -q
+# the suite runs -m 'not slow': the only slow-marked test re-runs the
+# full two-pass shadowlint in a subprocess, which the lint-determinism
+# step above has just done — no point tracing six kernels twice
+gate: native lint-determinism
+	python -m pytest tests/ -q -m 'not slow'
 	SHADOW_TPU_STRESS=1 python -m pytest tests/test_stress.py -q
+	SHADOW_TPU_SCALE=1 python -m pytest tests/test_managed_scale.py -q
+	$(MAKE) smoke-examples
 
 native:
 	$(MAKE) -C native
+
+# Static determinism & lane-parity analysis (shadow_tpu/analysis/):
+# pass 1 lints the package AST for nondeterminism hazards, pass 2 traces
+# the lane/stream kernels and audits the jaxpr.  Exit 1 on any finding
+# not fixed, inline-suppressed, or justified in the versioned baseline
+# (shadow_tpu/analysis/baseline.json).  See docs/analysis.md.
+lint-determinism:
+	JAX_PLATFORMS=cpu python -m shadow_tpu.analysis
 
 # End-to-end fault-injection smoke: run the partition/heal example on the
 # cpu backend twice and require byte-identical event logs + counters (the
@@ -22,3 +37,9 @@ smoke-faults:
 	JAX_PLATFORMS=cpu python -m shadow_tpu examples/partition-heal.yaml \
 	  --determinism-check --data-directory /tmp/shadow-tpu-smoke-faults.data
 
+# Examples smoke for the gate: the phold classic, run twice with a
+# run-twice determinism diff (bit-identical event orderings + counters).
+smoke-examples:
+	JAX_PLATFORMS=cpu python -m shadow_tpu examples/phold.yaml \
+	  --determinism-check --stop-time 2s \
+	  --data-directory /tmp/shadow-tpu-smoke-examples.data

@@ -1,0 +1,41 @@
+"""shadowlint — static determinism & lane-parity analysis for shadow_tpu.
+
+The determinism contract (bit-identical event ordering across runs and
+across backends, PAPER.md) is enforced *dynamically* by
+:mod:`shadow_tpu.engine.determinism` — a run-twice diff that finds a
+wall-clock leak or an unstable iteration order hours after it lands and
+says nothing about *where*.  This package catches the hazards statically,
+on the diff, in CI:
+
+- **Pass 1** (:mod:`.astlint`) walks the package source flagging
+  nondeterminism hazards — wall-clock reads, unseeded global RNG,
+  unordered set iteration in ordering-sensitive modules, ``id()``-based
+  ordering, float accumulation outside the canonical reduction helpers,
+  and environment/filesystem reads inside engine step paths — each with
+  a rule ID and a precise location.
+- **Pass 2** (:mod:`.jaxpr_audit`) traces the lane/stream kernels with
+  ``jax.make_jaxpr`` and audits the jaxpr for parity hazards: f64 leaks,
+  weak-type promotion, unstable sorts, non-associative float reductions,
+  and host callbacks inside jitted regions.
+
+CLI: ``python -m shadow_tpu.analysis`` / ``make lint-determinism``
+(exit 0 = clean, 1 = findings, 2 = usage/internal error).  Pre-existing
+findings can be suppressed by the versioned baseline file
+(:mod:`.baseline`) or inline ``# shadowlint: disable=SLxxx`` comments.
+
+See ``docs/analysis.md`` for the rule catalog and how to add a rule.
+"""
+
+from .findings import Finding, RULES, rule_doc
+from .astlint import lint_paths, lint_source
+from .baseline import Baseline, load_baseline
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "rule_doc",
+    "lint_paths",
+    "lint_source",
+    "Baseline",
+    "load_baseline",
+]

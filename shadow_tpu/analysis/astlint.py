@@ -1,0 +1,530 @@
+"""Pass 1: AST determinism linter over the ``shadow_tpu`` source tree.
+
+Pure :mod:`ast` — no imports of the linted modules, so a syntax-valid
+file can always be linted even when its imports need a TPU runtime.
+
+Scope model (see ``docs/analysis.md``):
+
+- every module is checked for SL101/SL102/SL104/SL106-by-scope;
+- *ordering-sensitive* modules (``engine/``, ``backend/``, ``net/``,
+  ``faults/``, ``core/``) additionally get SL103 (unordered set
+  iteration) and SL105 (float accumulation);
+- *step-path* scope for SL106 is any function in ``engine/``/
+  ``backend/`` whose name — or an enclosing function's name — matches
+  ``STEP_NAME_RE`` (the round loop's vocabulary: step/iter/round/
+  window/advance/tick/pop/drive/body).
+
+Intent escapes, in order of preference:
+
+1. fix the hazard (sorted() wrapper, core.rng stream, wall_time alias);
+2. inline ``# shadowlint: disable=SLxxx`` on the offending line (or a
+   standalone comment on the line above) with a justifying comment;
+3. a justified entry in the versioned baseline file (:mod:`.baseline`).
+
+The ``import time as wall_time`` alias is the package's declared-intent
+convention for bench/metrics wall timing (it predates this linter —
+``backend/tpu_engine.py`` et al.); SL101 trusts any ``wall_*`` alias and
+flags the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .findings import Finding
+
+ORDERING_SENSITIVE = ("engine", "backend", "net", "faults", "core")
+STEP_PATH_DIRS = ("engine", "backend")
+STEP_NAME_RE = re.compile(
+    r"(step|iter|round|window|advance|tick|pop|drive|body)"
+)
+
+# wall-clock callables by canonical dotted name (after import resolution)
+WALL_CLOCK_FNS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.clock_gettime",
+    "time.clock_gettime_ns", "time.process_time", "time.process_time_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# global-draw functions on the stdlib `random` module and `numpy.random`
+GLOBAL_RNG_FNS = {
+    "seed", "random", "randint", "randrange", "randbytes", "getrandbits",
+    "choice", "choices", "shuffle", "sample", "uniform", "triangular",
+    "betavariate", "expovariate", "gammavariate", "gauss", "normalvariate",
+    "lognormvariate", "vonmisesvariate", "paretovariate", "weibullvariate",
+    "permutation", "rand", "randn", "standard_normal", "bytes",
+}
+
+SUPPRESS_RE = re.compile(r"#\s*shadowlint:\s*disable=([A-Z0-9,\s]+)")
+
+
+def _module_flags(relpath: str) -> tuple[bool, bool]:
+    parts = Path(relpath).parts
+    return (
+        any(p in ORDERING_SENSITIVE for p in parts),
+        any(p in STEP_PATH_DIRS for p in parts),
+    )
+
+
+def _suppressions(src: str) -> dict[int, set[str]]:
+    """line number -> rule ids disabled there.  A standalone suppression
+    comment also covers the next line."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if line.lstrip().startswith("#"):  # comment-only line: covers next
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+class _ImportMap:
+    """Local name -> canonical dotted prefix, from the module's imports."""
+
+    def __init__(self) -> None:
+        self.names: dict[str, str] = {}
+
+    def visit(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.names[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.names[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.expr) -> Optional[str]:
+        """Canonical dotted name of an attribute/name chain, or None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.names.get(node.id, node.id)
+        return ".".join([root] + list(reversed(parts)))
+
+    def alias_of(self, node: ast.expr) -> Optional[str]:
+        """The local root name of an attribute chain (the import alias)."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_setish(node: ast.expr, set_names: set[str]) -> bool:
+    """Conservatively: does this expression evaluate to a set?"""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in set_names
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in (
+            "union", "intersection", "difference", "symmetric_difference",
+        ):
+            return _is_setish(f.value, set_names)
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+    ):
+        return _is_setish(node.left, set_names) or _is_setish(
+            node.right, set_names
+        )
+    return False
+
+
+def _set_names_in_scope(scope: ast.AST) -> set[str]:
+    """Names whose visible assignments in this scope are all set-valued.
+    Nested function bodies are separate scopes and are skipped."""
+    assigns: dict[str, list[ast.expr]] = {}
+
+    def record(node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    assigns.setdefault(t.id, []).append(node.value)
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            ann = ast.unparse(node.annotation)
+            if ann.startswith(("set", "Set", "frozenset", "FrozenSet")):
+                assigns.setdefault(node.target.id, []).append(ast.Set(elts=[]))
+            elif node.value is not None:
+                assigns.setdefault(node.target.id, []).append(node.value)
+
+    def collect(node: ast.AST) -> None:
+        record(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # nested scope
+            collect(child)
+
+    for stmt in getattr(scope, "body", []):
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            collect(stmt)
+    # two classification passes so one-hop aliases (b = a) resolve
+    out: set[str] = set()
+    for _ in range(2):
+        for name, values in assigns.items():
+            if values and all(_is_setish(v, out) for v in values):
+                out.add(name)
+    return out
+
+
+def _contains_floatish(node: ast.expr) -> bool:
+    """Syntactic float signals: float literal, float() call, true division."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, float):
+            return True
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "float"
+        ):
+            return True
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Div):
+            return True
+    return False
+
+
+def _is_id_key(node: ast.expr) -> bool:
+    """key=id / key=hash / key=lambda x: id(x)-shaped argument."""
+    if isinstance(node, ast.Name) and node.id in ("id", "hash"):
+        return True
+    if isinstance(node, ast.Lambda):
+        for sub in ast.walk(node.body):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id in ("id", "hash")
+            ):
+                return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(
+        self,
+        path: str,
+        src: str,
+        ordering_sensitive: bool,
+        step_path_module: bool,
+    ) -> None:
+        self.path = path
+        self.lines = src.splitlines()
+        self.ordering_sensitive = ordering_sensitive
+        self.step_path_module = step_path_module
+        self.imports = _ImportMap()
+        self.findings: list[Finding] = []
+        self._scope_sets: list[set[str]] = []
+        self._func_stack: list[str] = []
+        # comprehensions consumed by an order-free reducer (all/any/...)
+        self._order_free: set[int] = set()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        snippet = (
+            self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        )
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=self.path,
+                line=line,
+                col=getattr(node, "col_offset", 0),
+                message=message,
+                detail=snippet,
+            )
+        )
+
+    def _set_names(self) -> set[str]:
+        names: set[str] = set()
+        for s in self._scope_sets:
+            names |= s
+        return names
+
+    def _in_step_path(self) -> bool:
+        return self.step_path_module and any(
+            STEP_NAME_RE.search(n) for n in self._func_stack
+        )
+
+    # -- scope tracking ----------------------------------------------------
+
+    def lint(self, tree: ast.Module) -> list[Finding]:
+        self.imports.visit(tree)
+        self._scope_sets.append(_set_names_in_scope(tree))
+        self.generic_visit(tree)
+        return self.findings
+
+    def _visit_func(self, node) -> None:
+        self._func_stack.append(node.name)
+        self._scope_sets.append(_set_names_in_scope(node))
+        self.generic_visit(node)
+        self._scope_sets.pop()
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- SL103: unordered iteration ---------------------------------------
+
+    def _check_iterable(self, it: ast.expr) -> None:
+        if self.ordering_sensitive and _is_setish(it, self._set_names()):
+            self._emit(
+                "SL103",
+                it,
+                "iteration over a set is hash-order dependent; wrap in "
+                "sorted(...)",
+            )
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iterable(node.iter)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node) -> None:
+        if id(node) not in self._order_free:
+            for gen in node.generators:
+                self._check_iterable(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+    def visit_SetComp(self, node: ast.SetComp) -> None:
+        # building a set FROM a set is order-free; don't descend the
+        # generators with the set-iteration check
+        self.generic_visit(node)
+
+    # -- SL104: id()/hash() ordering in comparisons ------------------------
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if any(isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE)) for op in node.ops):
+            for side in [node.left] + node.comparators:
+                if (
+                    isinstance(side, ast.Call)
+                    and isinstance(side.func, ast.Name)
+                    and side.func.id in ("id", "hash")
+                ):
+                    self._emit(
+                        "SL104",
+                        node,
+                        f"ordering by {side.func.id}() depends on allocator/"
+                        "hash-seed layout",
+                    )
+                    break
+        self.generic_visit(node)
+
+    # -- calls: SL101/SL102/SL103(list/tuple)/SL104(key=)/SL105/SL106 ------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self.imports.resolve(node.func)
+        alias = self.imports.alias_of(node.func)
+
+        # all()/any()/min()/max()/len(), set constructors, and sorted()
+        # consume their iterable order-independently — a set argument is
+        # not a hazard there (sorted() IS the prescribed remediation, in
+        # any spelling: sorted(s), sorted(x for x in s), sorted(list(s)))
+        if isinstance(node.func, ast.Name) and node.func.id in (
+            "all", "any", "min", "max", "len", "set", "frozenset", "sorted",
+        ):
+            for arg in node.args:
+                if isinstance(
+                    arg, (ast.GeneratorExp, ast.SetComp, ast.ListComp)
+                ):
+                    self._order_free.add(id(arg))
+                elif (
+                    node.func.id == "sorted"
+                    and isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id in ("list", "tuple", "iter")
+                ):
+                    self._order_free.add(id(arg))
+
+        if name in WALL_CLOCK_FNS and not (alias or "").startswith("wall_"):
+            self._emit(
+                "SL101",
+                node,
+                f"wall-clock read {name}() outside the wall_time alias "
+                "convention — sim code must use core.time; bench timing "
+                "must import `time as wall_time`",
+            )
+
+        if name is not None:
+            parts = name.split(".")
+            if (
+                parts[0] == "random"
+                and len(parts) == 2
+                and parts[1] in GLOBAL_RNG_FNS
+            ) or (
+                parts[0] in ("numpy", "np")
+                and len(parts) == 3
+                and parts[1] == "random"
+                and parts[2] in GLOBAL_RNG_FNS
+            ):
+                self._emit(
+                    "SL102",
+                    node,
+                    f"global RNG draw {name}() — use a seeded "
+                    "core.rng stream (or a local random.Random(seed))",
+                )
+            if (
+                parts[-2:] == ["random", "default_rng"]
+                and not node.args
+                and not node.keywords
+            ):
+                self._emit(
+                    "SL102",
+                    node,
+                    "np.random.default_rng() without a seed draws OS "
+                    "entropy",
+                )
+
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("list", "tuple", "enumerate", "iter")
+            and node.args
+            and id(node) not in self._order_free
+        ):
+            self._check_iterable(node.args[0])
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and node.args
+        ):
+            self._check_iterable(node.args[0])
+
+        is_sorted_call = (
+            isinstance(node.func, ast.Name) and node.func.id == "sorted"
+        ) or (isinstance(node.func, ast.Attribute) and node.func.attr == "sort")
+        if is_sorted_call:
+            for kw in node.keywords:
+                if kw.arg == "key" and _is_id_key(kw.value):
+                    self._emit(
+                        "SL104",
+                        node,
+                        "sort key uses id()/hash(): ordering depends on "
+                        "allocator/hash-seed layout",
+                    )
+
+        if (
+            self.ordering_sensitive
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "sum"
+            and node.args
+            and _contains_floatish(node.args[0])
+        ):
+            self._emit(
+                "SL105",
+                node,
+                "float accumulation with builtin sum() rounds per-step; "
+                "use core.reduce.fsum (exactly rounded) or keep it integral",
+            )
+
+        if self._in_step_path():
+            if name in ("os.getenv",) or (
+                isinstance(node.func, ast.Name) and node.func.id == "open"
+            ):
+                self._emit(
+                    "SL106",
+                    node,
+                    f"{name or 'open'}() inside an engine step path reads "
+                    "host state mid-round; hoist to setup",
+                )
+
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self._in_step_path():
+            name = self.imports.resolve(node)
+            if name == "os.environ":
+                self._emit(
+                    "SL106",
+                    node,
+                    "os.environ inside an engine step path reads host "
+                    "state mid-round; hoist to setup",
+                )
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        # the from-import spelling: `from os import environ` makes every
+        # later use a bare Name, never an os.environ Attribute chain
+        if (
+            self._in_step_path()
+            and self.imports.names.get(node.id) == "os.environ"
+        ):
+            self._emit(
+                "SL106",
+                node,
+                "os.environ inside an engine step path reads host "
+                "state mid-round; hoist to setup",
+            )
+        self.generic_visit(node)
+
+
+def lint_source(
+    src: str,
+    path: str = "<string>",
+    *,
+    ordering_sensitive: Optional[bool] = None,
+    step_path_module: Optional[bool] = None,
+) -> list[Finding]:
+    """Lint one module's source.  Scope flags default from ``path``."""
+    auto_os, auto_step = _module_flags(path)
+    if ordering_sensitive is None:
+        ordering_sensitive = auto_os
+    if step_path_module is None:
+        step_path_module = auto_step
+    tree = ast.parse(src, filename=path)
+    linter = _Linter(path, src, ordering_sensitive, step_path_module)
+    findings = linter.lint(tree)
+    # number textually identical hazards (same rule+detail) in line order
+    # so each gets a distinct fingerprint — a new duplicate of a
+    # baselined line must surface, not ride the existing entry.  Number
+    # BEFORE dropping inline-suppressed ones, with trailing comments
+    # stripped from the key, so suppressing the first duplicate (which
+    # edits that line's text) does not shift the survivors' fingerprints.
+    counts: dict[tuple[str, str], int] = {}
+    numbered = []
+    for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
+        code = (f.detail or f.message).split("#", 1)[0].strip()
+        key = (f.rule, code)
+        n = counts.get(key, 0)
+        counts[key] = n + 1
+        numbered.append(dataclasses.replace(f, occurrence=n) if n else f)
+    supp = _suppressions(src)
+    return [f for f in numbered if f.rule not in supp.get(f.line, ())]
+
+
+def module_paths(root: Path, rel_to: Optional[Path] = None) -> list[tuple[Path, str]]:
+    """(file, repo-relative path) for every ``*.py`` under ``root``."""
+    root = Path(root)
+    rel_to = Path(rel_to) if rel_to is not None else root.parent
+    files: Iterable[Path] = (
+        [root] if root.is_file() else sorted(root.rglob("*.py"))
+    )
+    return [(f, f.relative_to(rel_to).as_posix()) for f in files]
+
+
+def lint_paths(root: Path, rel_to: Optional[Path] = None) -> list[Finding]:
+    """Lint every ``*.py`` under ``root`` (a package dir or one file)."""
+    findings: list[Finding] = []
+    for f, rel in module_paths(root, rel_to):
+        findings.extend(lint_source(f.read_text(), rel))
+    return findings

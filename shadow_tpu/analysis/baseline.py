@@ -1,0 +1,178 @@
+"""Versioned baseline-suppression file for shadowlint.
+
+A baseline entry suppresses exactly one finding by fingerprint and MUST
+carry a human justification — the acceptance bar is "baseline file empty
+or justified per-entry", so an empty ``reason`` is a hard load error.
+The file is JSON so diffs review cleanly:
+
+.. code-block:: json
+
+    {
+      "version": 1,
+      "suppressions": [
+        {"fingerprint": "0123abcd0123abcd",
+         "rule": "SL205",
+         "path": "kernel:flagship/iter",
+         "reason": "one-hot histogram matmul: counts < 2**24, exact in f32"}
+      ]
+    }
+
+``--write-baseline`` regenerates the file from the current findings,
+with ``reason: "TODO: justify"`` placeholders for NEW entries only —
+existing justifications are preserved by fingerprint and out-of-scope
+entries are carried over verbatim.  The loader rejects TODO reasons, so
+a freshly written baseline fails CI until each entry is justified or
+the hazard is fixed.  Stale entries (fingerprints no longer reported)
+are flagged so the baseline only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Iterable
+
+from .findings import RULES, Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+TODO_REASON = "TODO: justify"
+
+
+class BaselineError(ValueError):
+    """Malformed or unjustified baseline file."""
+
+
+@dataclasses.dataclass
+class Baseline:
+    path: Path
+    suppressions: dict[str, dict]  # fingerprint -> entry
+    matched: set = dataclasses.field(default_factory=set)
+
+    def suppresses(self, f: Finding) -> bool:
+        entry = self.suppressions.get(f.fingerprint)
+        if entry is None or entry["rule"] != f.rule:
+            return False
+        self.matched.add(f.fingerprint)
+        return True
+
+    def stale_entries(self, audited_paths: Iterable[str] | None = None) -> list[dict]:
+        """Entries whose finding no longer exists — to be deleted.
+
+        ``audited_paths`` scopes the check to what this run actually
+        looked at (a ``--no-jaxpr`` run must not call kernel entries
+        stale, and a single-file lint must not condemn the rest)."""
+        audited = None if audited_paths is None else set(audited_paths)
+        return [
+            e
+            for fp, e in sorted(self.suppressions.items())
+            if fp not in self.matched
+            and (audited is None or e["path"] in audited)
+        ]
+
+
+def load_baseline(path: Path | None = None) -> Baseline:
+    path = Path(path) if path is not None else DEFAULT_BASELINE
+    if not path.exists():
+        return Baseline(path=path, suppressions={})
+    try:
+        data = json.loads(path.read_text())
+    except json.JSONDecodeError as e:
+        raise BaselineError(f"{path}: not valid JSON: {e}") from None
+    if data.get("version") != BASELINE_VERSION:
+        raise BaselineError(
+            f"{path}: unsupported baseline version {data.get('version')!r} "
+            f"(this tool writes version {BASELINE_VERSION})"
+        )
+    sup: dict[str, dict] = {}
+    for i, e in enumerate(data.get("suppressions", [])):
+        for key in ("fingerprint", "rule", "path", "reason"):
+            if not isinstance(e.get(key), str) or not e.get(key):
+                raise BaselineError(
+                    f"{path}: suppression #{i} missing/empty {key!r}"
+                )
+        if e["rule"] not in RULES:
+            raise BaselineError(
+                f"{path}: suppression #{i} names unknown rule {e['rule']!r}"
+            )
+        if e["reason"].strip() == TODO_REASON:
+            raise BaselineError(
+                f"{path}: suppression #{i} ({e['rule']} at {e['path']}) is "
+                "not justified — replace the TODO reason or fix the hazard"
+            )
+        if e["fingerprint"] in sup:
+            raise BaselineError(
+                f"{path}: duplicate fingerprint {e['fingerprint']}"
+            )
+        sup[e["fingerprint"]] = e
+    return Baseline(path=path, suppressions=sup)
+
+
+def write_baseline(
+    path: Path,
+    findings: Iterable[Finding],
+    audited_paths: Iterable[str] | None = None,
+) -> int:
+    """Serialize ``findings`` as a fresh baseline; returns the entry count.
+
+    Existing entries are never destroyed blindly: justifications are
+    preserved by fingerprint, and entries whose ``path`` was NOT audited
+    by this run (``audited_paths``, e.g. a ``--no-jaxpr`` or explicit-
+    path run never looked at the kernels) are carried over verbatim —
+    only entries the run actually re-checked can be dropped as fixed."""
+    old_entries: list[dict] = []
+    if Path(path).exists():
+        try:
+            data = json.loads(Path(path).read_text())
+            old_entries = [
+                e for e in data.get("suppressions", [])
+                if isinstance(e.get("fingerprint"), str)
+            ]
+        except (json.JSONDecodeError, AttributeError) as e:
+            # refusing beats silently replacing hand-written
+            # justifications with TODOs (load_baseline hard-errors on
+            # the same input; regeneration must not destroy more)
+            raise BaselineError(
+                f"{path}: existing baseline is unreadable ({e}); fix or "
+                "delete it before --write-baseline"
+            ) from None
+    old_reasons = {
+        e["fingerprint"]: e["reason"]
+        for e in old_entries
+        if isinstance(e.get("reason"), str)
+    }
+    audited = None if audited_paths is None else set(audited_paths)
+    entries = []
+    seen: set[str] = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        entries.append(
+            {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+                "detail": f.detail,
+                "reason": old_reasons.get(f.fingerprint, TODO_REASON),
+            }
+        )
+    # entries this run did not re-check survive verbatim: out-of-scope
+    # paths when a scope was given, ALL old entries when none was (a
+    # caller that never said what it audited may not drop anything)
+    for e in old_entries:
+        if e["fingerprint"] not in seen and (
+            audited is None or e.get("path") not in audited
+        ):
+            seen.add(e["fingerprint"])
+            entries.append(e)
+    Path(path).write_text(
+        json.dumps(
+            {"version": BASELINE_VERSION, "suppressions": entries}, indent=1
+        )
+        + "\n"
+    )
+    return len(entries)

@@ -1,0 +1,260 @@
+"""shadowlint CLI: ``python -m shadow_tpu.analysis``.
+
+Exit codes: 0 = clean (all findings fixed, suppressed inline, or
+baselined), 1 = findings (or stale baseline entries), 2 = usage or
+internal error.  ``make lint-determinism`` runs this over the package
+with both passes; ``make gate`` includes it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional
+
+from .astlint import lint_paths, module_paths
+from .baseline import (
+    DEFAULT_BASELINE,
+    BaselineError,
+    load_baseline,
+    write_baseline,
+)
+from .findings import RULES, Finding
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]  # shadow_tpu/
+
+
+def _rel_base(root: Path) -> Optional[Path]:
+    """Base for repo-relative finding paths of an explicit CLI path.
+
+    A path inside the repo keeps its repo-relative prefix
+    (``shadow_tpu/engine/foo.py``), so the scope-dependent rules
+    (SL103/SL105/SL106) and baseline fingerprints match the default
+    whole-package run exactly.  A path outside the repo falls back to
+    :func:`module_paths`' default (relative to the lint root's parent).
+    """
+    repo = PACKAGE_ROOT.parent
+    try:
+        root.resolve().relative_to(repo)
+    except ValueError:
+        return None
+    return repo
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu.analysis",
+        description="shadowlint: static determinism & lane-parity analysis "
+        "(pass 1: AST linter; pass 2: jaxpr parity auditor)",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: the shadow_tpu package)",
+    )
+    p.add_argument(
+        "--no-jaxpr",
+        action="store_true",
+        help="skip pass 2 (kernel tracing); AST pass only.  Pass 2 is "
+        "also skipped automatically when explicit paths are given "
+        "without --kernel (an on-the-diff lint)",
+    )
+    p.add_argument(
+        "--kernel",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="audit only this representative kernel (repeatable)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help=f"baseline suppression file (default: {DEFAULT_BASELINE.name} "
+        "next to the package)",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file with TODO "
+        "reasons (each must be justified before the gate passes) and exit",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="finding output format",
+    )
+    return p
+
+
+def collect_findings(
+    ns: argparse.Namespace,
+) -> tuple[list[Finding], set[str]]:
+    """Run the requested passes.  Returns (findings, audited paths) — the
+    latter scopes baseline staleness to what this run actually checked."""
+    # usage validation up front — none of these may pay for (or silently
+    # skip) any lint work: a typo'd path would check nothing and pass, a
+    # typo'd kernel is tool misuse, and --no-jaxpr --kernel contradicts
+    # itself (the requested audit would be skipped with a green result)
+    missing = [p for p in ns.paths if not Path(p).exists()]
+    if missing:
+        print(f"shadowlint: no such path(s): {missing}", file=sys.stderr)
+        raise SystemExit(2)
+    if ns.kernel and ns.no_jaxpr:
+        print(
+            "shadowlint: --kernel requests a pass-2 audit that --no-jaxpr "
+            "disables; drop one of the flags",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    run_jaxpr = not ns.no_jaxpr and (not ns.paths or bool(ns.kernel))
+    if ns.kernel:
+        # the name set is static and importable without jax
+        from .jaxpr_audit import KERNELS
+
+        unknown = [n for n in ns.kernel if n not in KERNELS]
+        if unknown:
+            print(
+                f"shadowlint: unknown kernel(s) {unknown}; "
+                f"have {sorted(KERNELS)}",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+
+    findings: list[Finding] = []
+    audited: set[str] = set()
+    roots = (
+        [(Path(p).resolve(), _rel_base(Path(p))) for p in ns.paths]
+        if ns.paths
+        else [(PACKAGE_ROOT, PACKAGE_ROOT.parent)]
+    )
+    for root, rel_to in roots:
+        for _f, rel in module_paths(root, rel_to):
+            audited.add(rel)
+        findings.extend(lint_paths(root, rel_to))
+    # pass 2 runs on the default whole-package gate or on explicit
+    # --kernel request; an on-the-diff lint of explicit AST paths should
+    # not pay for three engine builds + six kernel traces (the
+    # audited-paths staleness scoping keeps the baseline honest either way)
+    if run_jaxpr:
+        # tracing needs jax on a CPU backend; the container may pin a TPU
+        # plugin at interpreter start, so override before first use
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from .jaxpr_audit import KERNELS, audit_kernels
+
+        names = ns.kernel
+        for name in names if names else KERNELS:
+            audited.add(f"kernel:{name}/round")
+            audited.add(f"kernel:{name}/full_run")
+        findings.extend(audit_kernels(names))
+    return findings, audited
+
+
+def _augment_audited(
+    ns: argparse.Namespace, baseline, audited: set[str]
+) -> set[str]:
+    """Claim scope over baseline entries whose SUBJECT no longer exists.
+
+    A default (no explicit paths) run audits the whole package
+    namespace, so an entry for a since-deleted file is in scope and must
+    go stale — its path is absent from the enumerated file set only
+    because the file is gone.  Symmetrically, a full pass-2 run (no
+    --kernel filter) audits the whole KERNELS registry, so entries for
+    removed/renamed kernels must go stale too."""
+    audited = set(audited)
+    entry_paths = {e["path"] for e in baseline.suppressions.values()}
+    if not ns.paths:
+        audited |= {p for p in entry_paths if not p.startswith("kernel:")}
+        if not ns.no_jaxpr and not ns.kernel:
+            audited |= {p for p in entry_paths if p.startswith("kernel:")}
+    return audited
+
+
+def main(argv: list[str] | None = None) -> int:
+    ns = build_parser().parse_args(argv)
+    if ns.list_rules:
+        for rule in sorted(RULES):
+            title, rationale = RULES[rule]
+            print(f"{rule}  {title}\n       {rationale}")
+        return 0
+
+    baseline_path = Path(ns.baseline) if ns.baseline else DEFAULT_BASELINE
+    try:
+        findings, audited = collect_findings(ns)
+    except SystemExit:
+        raise
+    except Exception as e:  # tracing/config errors are tool errors, not lint
+        print(f"shadowlint: internal error: {e}", file=sys.stderr)
+        return 2
+
+    if ns.write_baseline:
+        try:
+            n = write_baseline(
+                baseline_path, findings, audited_paths=audited
+            )
+        except BaselineError as e:
+            print(f"shadowlint: {e}", file=sys.stderr)
+            return 2
+        print(
+            f"shadowlint: wrote {n} suppression(s) to {baseline_path}; "
+            "justify each reason before the gate will pass"
+        )
+        return 0
+
+    try:
+        baseline = load_baseline(baseline_path)
+    except BaselineError as e:
+        print(f"shadowlint: {e}", file=sys.stderr)
+        return 2
+
+    live = [f for f in findings if not baseline.suppresses(f)]
+    stale = baseline.stale_entries(_augment_audited(ns, baseline, audited))
+
+    if ns.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "rule": f.rule,
+                            "path": f.path,
+                            "line": f.line,
+                            "col": f.col,
+                            "message": f.message,
+                            "fingerprint": f.fingerprint,
+                        }
+                        for f in live
+                    ],
+                    "stale_baseline": stale,
+                },
+                indent=1,
+            )
+        )
+    else:
+        for f in sorted(live, key=lambda f: (f.path, f.line, f.rule)):
+            print(f.render())
+        for e in stale:
+            print(
+                f"{baseline_path.name}: stale suppression "
+                f"{e['fingerprint']} ({e['rule']} at {e['path']}) — "
+                "the finding is gone; delete the entry"
+            )
+        if not live and not stale:
+            n = len(findings)
+            suppressed = n - len(live)
+            print(
+                "shadowlint: clean "
+                f"({suppressed} baselined finding(s))"
+                if suppressed
+                else "shadowlint: clean"
+            )
+
+    return 1 if live or stale else 0

@@ -1,0 +1,125 @@
+"""Finding model and the shadowlint rule registry.
+
+Every hazard class has a stable rule ID.  SL1xx rules are the AST pass
+(:mod:`.astlint`), SL2xx rules are the jaxpr pass (:mod:`.jaxpr_audit`).
+The registry is the single source of truth: the CLI's ``--list-rules``,
+the baseline validator, and ``docs/analysis.md`` all derive from it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+# rule id -> (title, rationale).  The rationale states the determinism
+# contract the hazard breaks — shown by ``--list-rules`` and the docs.
+RULES: dict[str, tuple[str, str]] = {
+    "SL101": (
+        "wall-clock read",
+        "time.time/datetime.now/perf_counter feed wall time into code that "
+        "must depend only on sim time; bench/metrics timing must go through "
+        "the `import time as wall_time` alias (or a listed bench module) so "
+        "intent is explicit and reviewable.",
+    ),
+    "SL102": (
+        "unseeded global RNG",
+        "global random.*/np.random.* draws (and np.random.default_rng() "
+        "with no seed) are seeded from the OS; all simulation randomness "
+        "must come from the counter-based core.rng streams.",
+    ),
+    "SL103": (
+        "unordered set iteration",
+        "iterating a set (or building a list/tuple from one) in an "
+        "ordering-sensitive module lets hash-seed layout pick the event "
+        "order; wrap the iterable in sorted().",
+    ),
+    "SL104": (
+        "id()-based ordering",
+        "CPython id() is an address: sorting or comparing by it makes the "
+        "event order depend on allocator layout.",
+    ),
+    "SL105": (
+        "float accumulation outside the canonical reduction helpers",
+        "builtin sum() over floats rounds per-step, so the result depends "
+        "on accumulation order; route through core.reduce.fsum (exactly "
+        "rounded, order-independent) or keep the arithmetic integral.",
+    ),
+    "SL106": (
+        "environment/filesystem read in an engine step path",
+        "os.environ/os.getenv/open() inside the round loop imports host "
+        "state into the simulation; read configuration once at setup time "
+        "and thread it through.",
+    ),
+    "SL201": (
+        "float64 in a traced kernel",
+        "x64 mode is enabled for int64 sim time only; an f64 aval in the "
+        "lane program is almost always a leaked Python float and doubles "
+        "the HBM cost of whatever carries it.",
+    ),
+    "SL202": (
+        "weak-type float in a traced kernel",
+        "a weakly-typed float scalar promotes differently per backend "
+        "(host axis vs device) — pin the dtype at the literal.",
+    ),
+    "SL203": (
+        "unstable sort in a traced kernel",
+        "lax.sort(is_stable=False) may order equal keys differently across "
+        "backends/XLA versions; every kernel sort must be stable or use a "
+        "total key.",
+    ),
+    "SL204": (
+        "host callback inside a jitted region",
+        "io_callback/debug.callback/pure_callback execute host Python "
+        "mid-kernel with unordered effects — hoist to window boundaries.",
+    ),
+    "SL205": (
+        "non-associative float reduction off the fixed-order seam",
+        "a float reduce/cumsum/dot changes value with XLA's reduction "
+        "order unless the values are exactly representable (e.g. one-hot "
+        "counts in f32 below 2**24) — keep reductions integral, exact, or "
+        "on the fixed-order reduction seam, and baseline the proven-exact "
+        "ones per entry.",
+    ),
+}
+
+
+def rule_doc(rule: str) -> str:
+    title, rationale = RULES[rule]
+    return f"{rule} {title}: {rationale}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One hazard at one location.
+
+    ``path``/``line`` locate AST findings; jaxpr findings use the kernel
+    label as the path and line 0, with ``detail`` carrying the primitive
+    and aval signature.  ``fingerprint`` is stable across unrelated edits
+    (it hashes content, not line numbers) so baseline entries survive
+    rebases.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    detail: str = ""
+    # nth identical (rule, path, detail) hazard in the file, in line
+    # order — so a second textually identical hazard line gets its OWN
+    # fingerprint instead of riding an existing baseline entry.  0 is
+    # excluded from the hash so single-occurrence fingerprints (and the
+    # shipped baseline) are unchanged.
+    occurrence: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        parts = (self.rule, self.path, self.detail or self.message)
+        if self.occurrence:
+            parts += (str(self.occurrence),)
+        h = hashlib.sha256("\x1f".join(parts).encode())
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}" if self.line else self.path
+        return f"{loc}: {self.rule} {self.message}  [{self.fingerprint}]"

@@ -1,0 +1,18 @@
+"""Canonical order-independent float reduction (the host-side seam).
+
+Naive ``sum()`` over floats rounds after every addition, so the result
+depends on accumulation order — which is exactly the kind of hidden
+ordering dependence the determinism contract forbids (shadowlint SL105).
+The sanctioned spelling is :func:`fsum` (:func:`math.fsum`): exactly
+rounded, so ANY accumulation order produces the same bits — no
+canonical pre-sort is needed or useful.
+
+Device-side (jaxpr) reductions have their own seam: keep them integral
+or exactly representable (shadowlint SL205, docs/analysis.md).
+"""
+
+from __future__ import annotations
+
+import math
+
+fsum = math.fsum

@@ -9,7 +9,7 @@ from __future__ import annotations
 import ctypes
 import ctypes.util
 import mmap
-import time
+import time as wall_time  # native-process hang timeout; not simulated time
 
 SHIM_ABI_MAGIC = 0x53485457534D4833
 SHIM_PAYLOAD_MAX = 65536
@@ -227,7 +227,7 @@ class ShmChannel:
         job in the reference, utility/childpid_watcher.rs)."""
         msg = self.shm.to_shadow
         addr = ctypes.addressof(msg)  # 'turn' is the first field
-        deadline = time.monotonic() + timeout_s
+        deadline = wall_time.monotonic() + timeout_s
         while True:
             if msg.turn != 0:
                 msg.turn = 0
@@ -243,7 +243,7 @@ class ShmChannel:
                     msg.turn = 0
                     return
                 raise PluginDied("plugin exited without a farewell message")
-            if time.monotonic() > deadline:
+            if wall_time.monotonic() > deadline:
                 raise TimeoutError("plugin unresponsive (blocked outside the shim?)")
             futex_wait(addr, 0, 0.05)
 

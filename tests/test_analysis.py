@@ -1,0 +1,724 @@
+"""shadowlint (shadow_tpu.analysis): per-rule fixtures, jaxpr auditor on
+planted-hazard toy kernels, baseline semantics, and CLI exit codes.
+
+Each SL1xx rule gets a positive fixture (must flag) and a negative
+fixture (the sanctioned spelling must NOT flag) — the linter's contract
+is both halves.  The jaxpr tests plant deliberate hazards (an f64 leak,
+an unstable sort, a host callback, a float reduction) in toy kernels and
+assert the auditor sees them, plus a clean kernel as the negative.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from shadow_tpu.analysis import lint_source
+from shadow_tpu.analysis.baseline import (
+    Baseline,
+    BaselineError,
+    load_baseline,
+    write_baseline,
+)
+from shadow_tpu.analysis.cli import main as cli_main
+from shadow_tpu.analysis.findings import RULES, Finding
+from shadow_tpu.analysis.jaxpr_audit import audit_jaxpr
+
+pytestmark = pytest.mark.analysis
+
+ENGINE = "engine/mod.py"  # ordering-sensitive + step-path scope
+UTILS = "utils/mod.py"  # neither
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- SL101: wall-clock reads -------------------------------------------------
+
+
+def test_sl101_flags_time_time():
+    src = "import time\n\ndef f():\n    return time.time()\n"
+    assert rules_of(lint_source(src, UTILS)) == {"SL101"}
+
+
+def test_sl101_flags_from_import_and_datetime():
+    src = (
+        "from time import perf_counter\n"
+        "from datetime import datetime\n"
+        "def f():\n"
+        "    return perf_counter() + datetime.now().timestamp()\n"
+    )
+    found = lint_source(src, UTILS)
+    assert [f.rule for f in found] == ["SL101", "SL101"]
+
+
+def test_sl101_allows_wall_time_alias():
+    src = (
+        "import time as wall_time\n"
+        "def bench():\n"
+        "    return wall_time.perf_counter()\n"
+    )
+    assert lint_source(src, ENGINE) == []
+
+
+def test_sl101_allows_sim_time_module():
+    src = (
+        "from ..core import time as stime\n"
+        "def f():\n"
+        "    return stime.fmt(0)\n"
+    )
+    assert lint_source(src, ENGINE) == []
+
+
+# -- SL102: unseeded global RNG ---------------------------------------------
+
+
+def test_sl102_flags_global_random():
+    src = "import random\n\ndef f():\n    return random.randint(0, 9)\n"
+    assert rules_of(lint_source(src, UTILS)) == {"SL102"}
+
+
+def test_sl102_flags_np_random_and_unseeded_default_rng():
+    src = (
+        "import numpy as np\n"
+        "def f():\n"
+        "    np.random.seed(0)\n"
+        "    g = np.random.default_rng()\n"
+        "    return np.random.uniform()\n"
+    )
+    found = lint_source(src, UTILS)
+    assert [f.rule for f in found] == ["SL102"] * 3
+
+
+def test_sl102_allows_seeded_instances():
+    src = (
+        "import random\n"
+        "import numpy as np\n"
+        "def f(seed):\n"
+        "    r = random.Random(seed)\n"
+        "    g = np.random.default_rng(seed)\n"
+        "    return r.random() + g.uniform()\n"
+    )
+    assert lint_source(src, UTILS) == []
+
+
+# -- SL103: unordered set iteration -----------------------------------------
+
+
+def test_sl103_flags_set_iteration_in_ordering_sensitive_module():
+    src = "def f(xs):\n    s = set(xs)\n    for x in s:\n        yield x\n"
+    assert rules_of(lint_source(src, ENGINE)) == {"SL103"}
+
+
+def test_sl103_flags_list_of_set_and_comprehension():
+    src = (
+        "def f(a, b):\n"
+        "    out = list(a | set(b))\n"
+        "    return [x for x in frozenset(b)], out\n"
+    )
+    found = lint_source(src, ENGINE)
+    assert [f.rule for f in found] == ["SL103", "SL103"]
+
+
+def test_sl103_allows_sorted_wrapper_and_order_free_consumers():
+    src = (
+        "def f(xs, s):\n"
+        "    for x in sorted(set(xs)):\n"
+        "        yield x\n"
+        "    n = len(set(xs))\n"
+        "    ok = all(x > 0 for x in set(xs))\n"
+        "    lo = min(set(xs))\n"
+    )
+    assert lint_source(src, ENGINE) == []
+
+
+def test_sl103_not_applied_outside_ordering_sensitive_modules():
+    src = "def f(xs):\n    for x in set(xs):\n        yield x\n"
+    assert lint_source(src, UTILS) == []
+
+
+# -- SL104: id()/hash() ordering --------------------------------------------
+
+
+def test_sl104_flags_id_sort_key_and_comparison():
+    src = (
+        "def f(xs, a, b):\n"
+        "    xs.sort(key=id)\n"
+        "    ys = sorted(xs, key=lambda v: hash(v))\n"
+        "    return id(a) < id(b)\n"
+    )
+    found = lint_source(src, UTILS)
+    assert [f.rule for f in found] == ["SL104"] * 3
+
+
+def test_sl104_allows_value_keys():
+    src = "def f(xs):\n    return sorted(xs, key=lambda v: v.name)\n"
+    assert lint_source(src, UTILS) == []
+
+
+# -- SL105: float accumulation ----------------------------------------------
+
+
+def test_sl105_flags_float_sum_in_ordering_sensitive_module():
+    src = "def f(xs):\n    return sum(x / 2 for x in xs)\n"
+    assert rules_of(lint_source(src, ENGINE)) == {"SL105"}
+
+
+def test_sl105_allows_fsum_and_integer_sum():
+    src = (
+        "from ..core.reduce import fsum\n"
+        "def f(xs, ns):\n"
+        "    return fsum(x / 2 for x in xs) + sum(n for n in ns)\n"
+    )
+    assert lint_source(src, ENGINE) == []
+
+
+# -- SL106: env/filesystem in step paths ------------------------------------
+
+
+def test_sl106_flags_environ_and_open_in_step_path():
+    src = (
+        "import os\n"
+        "def run_window(self):\n"
+        "    mode = os.environ.get('MODE')\n"
+        "    data = open('f').read()\n"
+        "    return mode, data\n"
+    )
+    found = lint_source(src, ENGINE)
+    assert [f.rule for f in found] == ["SL106"] * 2
+
+
+def test_sl106_flags_from_import_environ_spelling():
+    """`from os import environ` makes every use a bare Name — the
+    attribute-chain check alone never sees it."""
+    src = (
+        "from os import environ\n"
+        "def run_window(self):\n"
+        "    a = environ.get('MODE')\n"
+        "    b = environ['MODE']\n"
+        "    return a, b\n"
+    )
+    found = lint_source(src, ENGINE)
+    assert [f.rule for f in found] == ["SL106"] * 2
+
+
+def test_sl106_allows_setup_scope_and_non_step_modules():
+    engine_setup = (
+        "import os\n"
+        "def __init__(self):\n"
+        "    self.mode = os.environ.get('MODE')\n"
+    )
+    assert lint_source(engine_setup, ENGINE) == []
+    step_named_elsewhere = (
+        "import os\n"
+        "def run_window(self):\n"
+        "    return os.environ.get('MODE')\n"
+    )
+    assert lint_source(step_named_elsewhere, UTILS) == []
+
+
+# -- inline suppressions -----------------------------------------------------
+
+
+def test_inline_suppression_same_line_and_line_above():
+    src = (
+        "import time\n"
+        "def f():\n"
+        "    a = time.time()  # shadowlint: disable=SL101\n"
+        "    # wall deadline for hung children, not sim time\n"
+        "    # shadowlint: disable=SL101\n"
+        "    b = time.time()\n"
+        "    c = time.time()\n"
+        "    return a + b + c\n"
+    )
+    found = lint_source(src, UTILS)
+    assert [f.line for f in found] == [7]
+
+
+def test_suppression_is_rule_specific():
+    src = (
+        "import time\n"
+        "def f():\n"
+        "    return time.time()  # shadowlint: disable=SL102\n"
+    )
+    assert rules_of(lint_source(src, UTILS)) == {"SL101"}
+
+
+# -- jaxpr auditor on planted-hazard toy kernels -----------------------------
+
+
+def _jaxpr_of(fn, *args):
+    import jax
+
+    return jax.make_jaxpr(fn)(*args)
+
+
+def test_jaxpr_flags_planted_f64_leak():
+    import jax.numpy as jnp
+
+    def kernel(x):  # x: i64 lane clock — 0.5 leaks a weak f64 in x64 mode
+        return x * 0.5
+
+    found = audit_jaxpr(
+        _jaxpr_of(kernel, jnp.arange(8, dtype=jnp.int64)), "kernel:toy/f64"
+    )
+    assert "SL201" in rules_of(found)
+
+
+def test_jaxpr_flags_unstable_sort_and_accepts_stable():
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = jnp.arange(8, dtype=jnp.int32)
+
+    def unstable(x):
+        return lax.sort((x, x), dimension=0, num_keys=1, is_stable=False)
+
+    def stable(x):
+        return lax.sort((x, x), dimension=0, num_keys=1, is_stable=True)
+
+    assert "SL203" in rules_of(audit_jaxpr(_jaxpr_of(unstable, x), "k:u"))
+    assert "SL203" not in rules_of(audit_jaxpr(_jaxpr_of(stable, x), "k:s"))
+
+
+def test_jaxpr_flags_host_callback():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        jax.debug.callback(lambda v: None, x)
+        return x + 1
+
+    found = audit_jaxpr(
+        _jaxpr_of(kernel, jnp.int32(1)), "kernel:toy/callback"
+    )
+    assert "SL204" in rules_of(found)
+
+
+def test_jaxpr_flags_float_reduction_not_integer():
+    import jax.numpy as jnp
+
+    def float_red(x):
+        return jnp.cumsum(x)
+
+    fx = jnp.zeros(8, dtype=jnp.float32)
+    ix = jnp.zeros(8, dtype=jnp.int32)
+    assert "SL205" in rules_of(audit_jaxpr(_jaxpr_of(float_red, fx), "k:f"))
+    assert "SL205" not in rules_of(audit_jaxpr(_jaxpr_of(float_red, ix), "k:i"))
+
+
+def test_jaxpr_duplicate_signatures_get_distinct_fingerprints():
+    """Mirrors the AST pass's occurrence numbering: a SECOND equation
+    with an identical primitive/signature is its own hazard and may not
+    ride the first one's baseline entry."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def kernel(x):
+        a = lax.sort(x, is_stable=False)
+        return lax.sort(a + 1, is_stable=False)
+
+    found = audit_jaxpr(
+        _jaxpr_of(kernel, jnp.zeros(8, jnp.int32)), "kernel:toy/dup"
+    )
+    sl203 = [f for f in found if f.rule == "SL203"]
+    assert len(sl203) == 2
+    assert sl203[0].fingerprint != sl203[1].fingerprint
+
+
+def test_jaxpr_descends_into_while_and_cond():
+    import jax.numpy as jnp
+    from jax import lax
+
+    def kernel(x):
+        def body(c):
+            return c * 0.5  # f64 leak inside the while body
+
+        return lax.while_loop(lambda c: c > 1, body, x * 1.0)
+
+    found = audit_jaxpr(
+        _jaxpr_of(kernel, jnp.int64(64)), "kernel:toy/while"
+    )
+    assert "SL201" in rules_of(found)
+
+
+# -- baseline semantics ------------------------------------------------------
+
+
+def _finding(rule="SL101", path="m.py", detail="x = time.time()"):
+    return Finding(rule=rule, path=path, line=3, col=0,
+                   message="msg", detail=detail)
+
+
+def test_baseline_roundtrip_requires_justification(tmp_path):
+    bl = tmp_path / "baseline.json"
+    write_baseline(bl, [_finding()])
+    with pytest.raises(BaselineError, match="not justified"):
+        load_baseline(bl)
+    data = json.loads(bl.read_text())
+    data["suppressions"][0]["reason"] = "wall deadline, not sim time"
+    bl.write_text(json.dumps(data))
+    baseline = load_baseline(bl)
+    assert baseline.suppresses(_finding())
+    assert baseline.stale_entries() == []
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    bl = tmp_path / "baseline.json"
+    write_baseline(bl, [_finding(detail="gone()")])
+    data = json.loads(bl.read_text())
+    data["suppressions"][0]["reason"] = "justified"
+    bl.write_text(json.dumps(data))
+    baseline = load_baseline(bl)
+    assert not baseline.suppresses(_finding(detail="still here"))
+    assert len(baseline.stale_entries()) == 1
+
+
+def test_baseline_fingerprint_survives_line_moves():
+    a = _finding()
+    b = Finding(rule="SL101", path="m.py", line=99, col=4,
+                message="msg", detail="x = time.time()")
+    assert a.fingerprint == b.fingerprint
+
+
+def test_baseline_rejects_unknown_rule_and_bad_version(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"version": 99, "suppressions": []}))
+    with pytest.raises(BaselineError, match="version"):
+        load_baseline(bl)
+    bl.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "suppressions": [
+                    {"fingerprint": "ab", "rule": "SL999",
+                     "path": "x", "reason": "r"}
+                ],
+            }
+        )
+    )
+    with pytest.raises(BaselineError, match="unknown rule"):
+        load_baseline(bl)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _write_pkg(tmp_path, body):
+    mod = tmp_path / "engine"
+    mod.mkdir()
+    f = mod / "step.py"
+    f.write_text(body)
+    return mod
+
+
+def test_cli_exit_codes(tmp_path):
+    dirty = _write_pkg(tmp_path, "import time\nt = time.time()\n")
+    empty_bl = tmp_path / "bl.json"
+    # findings -> 1
+    assert cli_main(
+        [str(dirty), "--no-jaxpr", "--baseline", str(empty_bl)]
+    ) == 1
+    # clean tree -> 0
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "ok.py").write_text("x = 1\n")
+    assert cli_main(
+        [str(clean), "--no-jaxpr", "--baseline", str(empty_bl)]
+    ) == 0
+    # malformed baseline -> 2
+    bad_bl = tmp_path / "bad.json"
+    bad_bl.write_text("{nope")
+    assert cli_main(
+        [str(clean), "--no-jaxpr", "--baseline", str(bad_bl)]
+    ) == 2
+
+
+def test_cli_list_rules_covers_registry(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+def test_cli_json_format(tmp_path):
+    dirty = _write_pkg(tmp_path, "import time\nt = time.time()\n")
+    bl = tmp_path / "bl.json"
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(
+            [str(dirty), "--no-jaxpr", "--baseline", str(bl),
+             "--format", "json"]
+        )
+    assert rc == 1
+    data = json.loads(buf.getvalue())
+    assert data["findings"][0]["rule"] == "SL101"
+
+
+def test_write_baseline_preserves_existing_justifications(tmp_path):
+    """Regenerating the baseline to add a finding must not reset the
+    hand-written reasons of existing entries to TODO."""
+    bl = tmp_path / "baseline.json"
+    old = _finding(detail="x = time.time()")
+    write_baseline(bl, [old])
+    data = json.loads(bl.read_text())
+    data["suppressions"][0]["reason"] = "bench wall deadline, not sim time"
+    bl.write_text(json.dumps(data))
+    new = _finding(rule="SL104", detail="sorted(xs, key=id)")
+    write_baseline(bl, [old, new])
+    reasons = {
+        e["fingerprint"]: e["reason"]
+        for e in json.loads(bl.read_text())["suppressions"]
+    }
+    assert reasons[old.fingerprint] == "bench wall deadline, not sim time"
+    assert reasons[new.fingerprint] == "TODO: justify"
+
+
+def test_sl103_allows_every_sorted_spelling():
+    """sorted() is the prescribed remediation — none of its spellings
+    may themselves be flagged."""
+    src = (
+        "def f(xs):\n"
+        "    s = set(xs)\n"
+        "    a = sorted(s)\n"
+        "    b = sorted(x for x in s)\n"
+        "    c = sorted(list(s))\n"
+        "    d = sorted([x for x in s])\n"
+        "    return a, b, c, d\n"
+    )
+    assert rules_of(lint_source(src, "engine/x.py")) == set()
+    # the fixture is live: the unwrapped spellings DO fire
+    bad = "def f(xs):\n    s = set(xs)\n    return [x for x in s]\n"
+    assert "SL103" in rules_of(lint_source(bad, "engine/x.py"))
+
+
+def test_cli_unknown_kernel_is_usage_error(tmp_path, capsys, monkeypatch):
+    """Exit-code contract: 1 is reserved for findings; a typo'd --kernel
+    is tool misuse and must exit 2 — before paying for the AST walk."""
+    import shadow_tpu.analysis.cli as cli_mod
+
+    def boom(*a, **k):
+        raise AssertionError("AST walk ran before --kernel validation")
+
+    monkeypatch.setattr(cli_mod, "lint_paths", boom)
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["--kernel", "nope", "--baseline",
+                  str(tmp_path / "bl.json")])
+    assert exc.value.code == 2
+    assert "unknown kernel" in capsys.readouterr().err
+
+
+def test_duplicate_identical_hazard_lines_get_distinct_fingerprints():
+    """A second textually identical hazard line must get its own
+    fingerprint, so it cannot ride an existing baseline entry through
+    the gate."""
+    src = (
+        "import time\n"
+        "def f():\n"
+        "    t = time.time()\n"
+        "    return t\n"
+        "def g():\n"
+        "    t = time.time()\n"
+        "    return t\n"
+    )
+    found = lint_source(src, "m.py")
+    assert [f.rule for f in found] == ["SL101", "SL101"]
+    assert found[0].fingerprint != found[1].fingerprint
+    bl = Baseline(path=Path("x"), suppressions={
+        found[0].fingerprint: {"rule": "SL101"},
+    })
+    assert bl.suppresses(found[0])
+    assert not bl.suppresses(found[1])
+
+
+def test_write_baseline_scoped_run_keeps_out_of_scope_entries(tmp_path):
+    """A --no-jaxpr / explicit-path --write-baseline never audited the
+    kernels, so their justified entries must survive verbatim."""
+    bl = tmp_path / "baseline.json"
+    kernel = Finding(rule="SL203", path="kernel:phold/round", line=0,
+                     col=0, message="unstable sort", detail="sort(...)")
+    write_baseline(bl, [kernel])
+    data = json.loads(bl.read_text())
+    data["suppressions"][0]["reason"] = "4-word total event key"
+    bl.write_text(json.dumps(data))
+    ast_f = _finding()
+    write_baseline(bl, [ast_f], audited_paths={"m.py"})
+    entries = {e["fingerprint"]: e
+               for e in json.loads(bl.read_text())["suppressions"]}
+    assert entries[kernel.fingerprint]["reason"] == "4-word total event key"
+    assert entries[ast_f.fingerprint]["reason"] == "TODO: justify"
+
+
+def test_cli_explicit_paths_skip_kernel_traces(tmp_path, monkeypatch):
+    """An on-the-diff lint of explicit paths must not pay for the
+    engine builds + kernel traces of pass 2 (unless --kernel asks)."""
+    import shadow_tpu.analysis.jaxpr_audit as ja
+
+    def boom(*a, **k):  # pass 2 entry — must not be reached
+        raise AssertionError("kernel tracing ran for an explicit-path lint")
+
+    monkeypatch.setattr(ja, "audit_kernels", boom)
+    clean = tmp_path / "ok.py"
+    clean.write_text("x = 1\n")
+    assert cli_main([str(clean), "--baseline", str(tmp_path / "bl.json")]) == 0
+
+
+def test_cli_missing_path_and_conflicting_flags_are_usage_errors(
+    tmp_path, capsys
+):
+    """A typo'd path would lint nothing and pass green; --no-jaxpr with
+    --kernel would silently skip the requested audit.  Both are usage
+    errors (exit 2), reported before any lint work."""
+    bl = str(tmp_path / "bl.json")
+    with pytest.raises(SystemExit) as exc:
+        cli_main([str(tmp_path / "nope.py"), "--baseline", bl])
+    assert exc.value.code == 2
+    assert "no such path" in capsys.readouterr().err
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["--no-jaxpr", "--kernel", "phold", "--baseline", bl])
+    assert exc.value.code == 2
+    assert "--no-jaxpr" in capsys.readouterr().err
+
+
+def test_write_baseline_refuses_unreadable_existing_file(tmp_path):
+    """Regenerating over a mangled baseline (merge-conflict markers,
+    truncation) must refuse, not silently replace the hand-written
+    justifications with TODOs."""
+    bl = tmp_path / "baseline.json"
+    bl.write_text('{"version": 1, <<<<<<< HEAD')
+    with pytest.raises(BaselineError, match="unreadable"):
+        write_baseline(bl, [_finding()])
+    assert bl.read_text() == '{"version": 1, <<<<<<< HEAD'  # untouched
+
+
+def test_write_baseline_without_scope_never_drops_old_entries(tmp_path):
+    """A caller that doesn't say what it audited may not drop anything —
+    old entries it didn't re-find survive verbatim."""
+    bl = tmp_path / "baseline.json"
+    kernel = Finding(rule="SL203", path="kernel:phold/round", line=0,
+                     col=0, message="unstable sort", detail="sort(...)")
+    write_baseline(bl, [kernel])
+    data = json.loads(bl.read_text())
+    data["suppressions"][0]["reason"] = "total event key"
+    bl.write_text(json.dumps(data))
+    write_baseline(bl, [_finding()])  # no audited_paths
+    entries = {e["fingerprint"]: e
+               for e in json.loads(bl.read_text())["suppressions"]}
+    assert entries[kernel.fingerprint]["reason"] == "total event key"
+
+
+def test_stale_scope_covers_deleted_files_and_removed_kernels(tmp_path):
+    """_augment_audited: a full run claims scope over every baseline
+    entry — deleted files always, kernel:* entries when pass 2 ran
+    unfiltered — so orphaned suppressions go stale instead of living
+    forever.  Scoped runs claim nothing extra."""
+    import argparse
+
+    from shadow_tpu.analysis.cli import _augment_audited
+
+    entries = {
+        "aa": {"path": "shadow_tpu/engine/__deleted__.py"},
+        "bb": {"path": "kernel:ghost/round"},
+    }
+    bl = Baseline(path=Path("x"), suppressions=entries)
+
+    def ns(paths=(), no_jaxpr=False, kernel=None):
+        return argparse.Namespace(
+            paths=list(paths), no_jaxpr=no_jaxpr, kernel=kernel
+        )
+
+    full = _augment_audited(ns(), bl, {"kernel:phold/round"})
+    assert "shadow_tpu/engine/__deleted__.py" in full
+    assert "kernel:ghost/round" in full
+    ast_only = _augment_audited(ns(no_jaxpr=True), bl, set())
+    assert "shadow_tpu/engine/__deleted__.py" in ast_only
+    assert "kernel:ghost/round" not in ast_only  # kernels not audited
+    scoped = _augment_audited(ns(paths=["shadow_tpu/engine"]), bl, set())
+    assert scoped == set()  # explicit paths claim nothing extra
+
+
+def test_inline_suppressing_first_duplicate_keeps_second_fingerprint():
+    """Occurrence numbering runs before inline-suppression filtering:
+    suppressing the first of two identical hazard lines must not shift
+    the survivor's fingerprint (its baseline entry stays valid)."""
+    line = "    t = time.time()\n"
+    src = "import time\ndef f():\n" + line + "def g():\n" + line
+    both = lint_source(src, "m.py")
+    assert len(both) == 2
+    suppressed_first = src.replace(
+        line, "    t = time.time()  # shadowlint: disable=SL101\n", 1
+    )
+    [survivor] = lint_source(suppressed_first, "m.py")
+    assert survivor.fingerprint == both[1].fingerprint
+
+
+def test_cli_default_run_flags_baseline_entry_for_deleted_file(
+    tmp_path, capsys
+):
+    """A default whole-package run audits the whole namespace: a
+    baseline entry for a since-deleted file must be reported stale, not
+    silently skipped because the file no longer enumerates."""
+    bl = tmp_path / "bl.json"
+    gone = Finding(rule="SL101", path="shadow_tpu/engine/__deleted__.py",
+                   line=3, col=0, message="m", detail="t = time.time()")
+    write_baseline(bl, [gone])
+    data = json.loads(bl.read_text())
+    data["suppressions"][0]["reason"] = "was justified once"
+    bl.write_text(json.dumps(data))
+    assert cli_main(["--no-jaxpr", "--baseline", str(bl)]) == 1
+    assert "stale suppression" in capsys.readouterr().out
+
+
+def test_cli_in_repo_paths_keep_repo_relative_scope(tmp_path):
+    """An explicit CLI path inside the repo must keep its repo-relative
+    prefix, so scope-dependent rules (SL103/SL105/SL106) and baseline
+    fingerprints match the default whole-package run (regression: a bare
+    `shadow_tpu/engine/foo.py` argument used to lint as `foo.py`,
+    silently dropping the ordering-sensitive scope)."""
+    from shadow_tpu.analysis.astlint import _module_flags, module_paths
+    from shadow_tpu.analysis.cli import PACKAGE_ROOT, _rel_base
+
+    eng = PACKAGE_ROOT / "engine"
+    base = _rel_base(eng)
+    assert base == PACKAGE_ROOT.parent
+    rels = [rel for _, rel in module_paths(eng.resolve(), base)]
+    assert rels and all(r.startswith("shadow_tpu/engine/") for r in rels)
+    assert all(_module_flags(r) == (True, True) for r in rels)
+    # a single in-repo FILE keeps the prefix too
+    one = PACKAGE_ROOT / "engine" / "sim.py"
+    [(_, rel)] = module_paths(one.resolve(), _rel_base(one))
+    assert rel == "shadow_tpu/engine/sim.py"
+    # outside the repo there is no repo-relative prefix: fall back to the
+    # lint root's parent (directory context is still honored)
+    out = tmp_path / "engine"
+    out.mkdir()
+    (out / "bad.py").write_text("pass\n")
+    assert _rel_base(out) is None
+    [(_, rel)] = module_paths(out)
+    assert rel == "engine/bad.py"
+
+
+@pytest.mark.slow
+def test_repo_lint_is_clean():
+    """The acceptance gate: the shipped tree + baseline runs clean,
+    including the kernel traces (the module-invocation path of
+    ``make lint-determinism``)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis"],
+        cwd=Path(__file__).resolve().parents[1],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_repo_ast_pass_is_clean():
+    """Fast tier-1 slice of the gate: the AST pass alone must be clean."""
+    assert cli_main(["--no-jaxpr"]) == 0
