@@ -5,7 +5,8 @@
 # end-to-end determinism smoke.  Mirrors the reference's determinism
 # CTest gate (src/test/determinism/CMakeLists.txt).
 
-.PHONY: test gate native smoke-faults smoke-examples lint-determinism
+.PHONY: test gate native smoke-faults smoke-examples lint-determinism \
+	bench-hybrid
 
 test: native
 	python -m pytest tests/ -q
@@ -17,7 +18,21 @@ gate: native lint-determinism
 	python -m pytest tests/ -q -m 'not slow'
 	SHADOW_TPU_STRESS=1 python -m pytest tests/test_stress.py -q
 	SHADOW_TPU_SCALE=1 python -m pytest tests/test_managed_scale.py -q
+	SHADOW_TPU_SCALE=1 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_hybrid_mp.py -q
 	$(MAKE) smoke-examples
+
+# The hybrid backend's short deterministic benchmark (one JSON line):
+# the relay-chain scenario scaled down to CI size, syscall plane on 2
+# worker processes, packet plane on the CPU-JAX lane kernel — no TPU
+# time needed.  The full-scale run is bench.py's hybrid_* keys.
+bench-hybrid: native
+	JAX_PLATFORMS=cpu SHADOW_TPU_BENCH_HYBRID_ONLY=1 \
+	  SHADOW_TPU_BENCH_HYBRID_LANES=100 \
+	  SHADOW_TPU_BENCH_HYBRID_CHAINS=4 \
+	  SHADOW_TPU_BENCH_HYBRID_SIM_SECONDS=5 \
+	  SHADOW_TPU_BENCH_HYBRID_WORKERS=2 \
+	  python bench.py
 
 native:
 	$(MAKE) -C native

@@ -15,7 +15,17 @@ keys record:
 - ``managed_sim_s_per_wall_s``: the MANAGED-process path — relay chains
   of real OS binaries (tcpecho/relay under the shim) with model
   background traffic (config/scenarios.py), the workload class the
-  reference's 6.38x was measured on (MyTest/SUMMARY.md);
+  reference's 6.38x was measured on (MyTest/SUMMARY.md) — serviced by
+  the parallel MpCpuEngine (``managed_cpu_workers`` reports the actual
+  post-clamp worker count of the engine that ran);
+- ``hybrid_sim_s_per_wall_s`` (+ ``hybrid_*``): the HYBRID backend at
+  the reference's own scale point — 151 managed OS processes in relay
+  chains whose syscall plane runs across ``hybrid_workers`` spawned
+  workers while every packet (theirs + 1000 tgen lane hosts) rides the
+  TPU lane data plane (backend/hybrid.py, ROADMAP open item 1).  The
+  ``hybrid_sync`` sub-dict is the host<->device sync-cost breakdown
+  (device-sync vs syscall-service wall, per-turn transfer counts/bytes)
+  that docs/hybrid.md's analysis is reproduced from;
 - ``configs``: the full BASELINE.md evaluation ladder — (1) 2-host
   transfer, (2) 100-host UDP star, (3) 1k mixed mesh, (4) the 10k mixed
   mesh above, (5) the managed relay-chain scenario — each as
@@ -31,6 +41,14 @@ Env knobs (for local runs; the driver uses the defaults):
   SHADOW_TPU_BENCH_CPU_SIM_SECONDS  cpu-side duration (default 1; 0 skips)
   SHADOW_TPU_BENCH_LADDER        1 = run the config ladder (default 1)
   SHADOW_TPU_BENCH_MANAGED       1 = run the managed scenario (default 1)
+  SHADOW_TPU_BENCH_MANAGED_WORKERS  managed syscall workers (default: cores)
+  SHADOW_TPU_BENCH_HYBRID        1 = run the hybrid scenario (default 1)
+  SHADOW_TPU_BENCH_HYBRID_ONLY   1 = run ONLY the hybrid scenario (make
+                                 bench-hybrid; default 0)
+  SHADOW_TPU_BENCH_HYBRID_LANES  hybrid lane (tgen peer) hosts (default 1000)
+  SHADOW_TPU_BENCH_HYBRID_CHAINS hybrid relay chains (default 25 -> 151 procs)
+  SHADOW_TPU_BENCH_HYBRID_SIM_SECONDS  hybrid simulated duration (default 10)
+  SHADOW_TPU_BENCH_HYBRID_WORKERS  hybrid syscall workers (default 0 = cores)
 """
 
 import json
@@ -61,6 +79,17 @@ MIXED_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_MIXED_HOSTS", "10000"))
 CPU_SIM_SECONDS = int(os.environ.get("SHADOW_TPU_BENCH_CPU_SIM_SECONDS", "1"))
 LADDER = os.environ.get("SHADOW_TPU_BENCH_LADDER", "1") == "1"
 MANAGED = os.environ.get("SHADOW_TPU_BENCH_MANAGED", "1") == "1"
+MANAGED_WORKERS = int(os.environ.get(
+    "SHADOW_TPU_BENCH_MANAGED_WORKERS", str(os.cpu_count() or 1)
+))
+HYBRID = os.environ.get("SHADOW_TPU_BENCH_HYBRID", "1") == "1"
+HYBRID_ONLY = os.environ.get("SHADOW_TPU_BENCH_HYBRID_ONLY", "0") == "1"
+HYBRID_LANES = int(os.environ.get("SHADOW_TPU_BENCH_HYBRID_LANES", "1000"))
+HYBRID_CHAINS = int(os.environ.get("SHADOW_TPU_BENCH_HYBRID_CHAINS", "25"))
+HYBRID_SIM_SECONDS = int(os.environ.get(
+    "SHADOW_TPU_BENCH_HYBRID_SIM_SECONDS", "10"
+))
+HYBRID_WORKERS = int(os.environ.get("SHADOW_TPU_BENCH_HYBRID_WORKERS", "0"))
 
 
 # the tunneled runtime caches EXECUTIONS across processes keyed on
@@ -95,18 +124,25 @@ def _best_device_rate(cfg, salt0, repeats=None):
     return best
 
 
+def _build_native() -> None:
+    repo = os.path.dirname(os.path.abspath(__file__))
+    subprocess.run(["make", "-C", os.path.join(repo, "native")],
+                   check=True, capture_output=True)
+
+
 def _managed_rate():
     """The managed-process scenario (relay chains of real binaries) on
-    the CPU engine, timed end-to-end as sim-s/wall-s."""
+    the PARALLEL CPU engine (MpCpuEngine: one spawned syscall worker per
+    core, the reference's thread-per-core analog), timed end-to-end as
+    sim-s/wall-s.  ``managed_cpu_workers`` is read from the engine that
+    actually ran (post-clamp), never assumed."""
+    from shadow_tpu.backend.cpu_mp import MpCpuEngine
     from shadow_tpu.config.scenarios import (
         managed_chain_config,
         managed_proc_count,
     )
-    from shadow_tpu.engine.sim import Simulation
 
-    repo = os.path.dirname(os.path.abspath(__file__))
-    subprocess.run(["make", "-C", os.path.join(repo, "native")],
-                   check=True, capture_output=True)
+    _build_native()
     chains, cpc, peers, sim_s = 8, 2, 40, 30
     tmp = tempfile.mkdtemp(prefix="shadow_bench_managed_")
     try:
@@ -114,21 +150,91 @@ def _managed_rate():
             os.path.join(tmp, "data"), chains=chains,
             clients_per_chain=cpc, peers=peers, sim_seconds=sim_s,
         )
+        engine = MpCpuEngine(cfg, workers=MANAGED_WORKERS)
         t0 = time.perf_counter()
-        result = Simulation(cfg).run()
+        result = engine.run()
         wall = time.perf_counter() - t0
         ok = not result.process_errors
         return {
             "managed_sim_s_per_wall_s": round(sim_s / wall, 4),
             "managed_hosts": len(cfg.hosts),
             "managed_procs": managed_proc_count(chains, cpc),
+            "managed_cpu_workers": engine.workers,
             "managed_ok": bool(ok),
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _hybrid_rate():
+    """The HYBRID flagship (ROADMAP open item 1): 151 managed OS
+    processes over 1000+ lane hosts — syscall plane across N worker
+    processes, every packet on the TPU lane data plane.  Reports the
+    steady-state rate (the engine's run loop), the end-to-end wall
+    (construction + compile included), flow-completion counters, and the
+    host<->device sync-cost breakdown the analysis doc is built from."""
+    from shadow_tpu.backend.hybrid import MpHybridEngine
+    from shadow_tpu.config.scenarios import (
+        managed_proc_count,
+        managed_relay_chains_large,
+    )
+
+    _build_native()
+    tmp = tempfile.mkdtemp(prefix="shadow_bench_hybrid_")
+    try:
+        cfg = managed_relay_chains_large(
+            os.path.join(tmp, "data"), chains=HYBRID_CHAINS,
+            peers=HYBRID_LANES, sim_seconds=HYBRID_SIM_SECONDS,
+            hybrid_workers=HYBRID_WORKERS,
+        )
+        # engine built directly: log_capacity=0 skips the device event
+        # log (1000 lanes x 20 sends/s overflow the 200k default, and a
+        # bench diffs counters, not logs) — the Simulation facade path is
+        # what the parity/determinism tests exercise
+        eng = MpHybridEngine(cfg, workers=HYBRID_WORKERS, log_capacity=0)
+        t0 = time.perf_counter()
+        result = eng.run()
+        total = time.perf_counter() - t0
+        sync = {
+            k: (round(v, 3) if isinstance(v, float) else int(v))
+            for k, v in getattr(eng, "sync_stats", {}).items()
+        }
+        return {
+            "hybrid_sim_s_per_wall_s": round(
+                result.sim_seconds_per_wall_second, 4
+            ),
+            "hybrid_total_wall_s": round(total, 2),
+            "hybrid_hosts": len(cfg.hosts),
+            "hybrid_lane_hosts": HYBRID_LANES,
+            "hybrid_procs": managed_proc_count(HYBRID_CHAINS, 3),
+            "hybrid_workers": getattr(eng, "workers", 1),
+            "hybrid_ok": not result.process_errors,
+            "hybrid_managed_exits_clean": int(
+                result.counters.get("managed_exit_clean", 0)
+            ),
+            "hybrid_tcp_rx_bytes": int(
+                result.counters.get("managed_tcp_rx_bytes", 0)
+            ),
+            "hybrid_tgen_recv_bytes": int(
+                result.counters.get("tgen_recv_bytes", 0)
+            ),
+            "hybrid_rounds": int(result.rounds),
+            "hybrid_sync": sync,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
+    if HYBRID_ONLY:
+        # make bench-hybrid: the hybrid scenario alone, one JSON line
+        out = {"metric": "hybrid_sim_s_per_wall_s", "unit": "sim_s/wall_s"}
+        out.update(_hybrid_rate())
+        out["value"] = out["hybrid_sim_s_per_wall_s"]
+        out["vs_baseline"] = round(out["value"] / REFERENCE_SPEEDUP, 4)
+        print(json.dumps(out))
+        return
+
     result = _best_device_rate(_pure_cfg(SIM_SECONDS), _SALT + 1)
     value = result.sim_seconds_per_wall_second
 
@@ -181,6 +287,16 @@ def main() -> None:
         m = _managed_rate()
         out.update(m)
         configs["managed_relay_chains"] = m["managed_sim_s_per_wall_s"]
+
+    # the HYBRID backend on the large relay-chain scenario: the managed
+    # workload class at the reference's scale point, syscall plane across
+    # worker processes + packet plane on the lanes
+    if HYBRID:
+        h = _hybrid_rate()
+        out.update(h)
+        configs["managed_relay_chains_large_hybrid"] = h[
+            "hybrid_sim_s_per_wall_s"
+        ]
 
     out["configs"] = configs
 

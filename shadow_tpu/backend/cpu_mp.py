@@ -45,6 +45,37 @@ def _partition(n_hosts: int, workers: int) -> list[list[int]]:
     return [list(range(w, n_hosts, workers)) for w in range(workers)]
 
 
+def spawn_cpu_workers(target, arg_tuples):
+    """Spawn one daemon worker per arg tuple (``target(*args, conn)``)
+    with a dedicated pipe, via the SPAWN start method (forking a process
+    whose runtime threads may hold locks is a documented deadlock, and
+    the parent has usually initialized JAX by now).  Children import
+    shadow_tpu (which imports jax) at spawn: JAX_PLATFORMS is pinned to
+    the CPU platform around the spawns so no worker dials a device
+    tunnel.  Shared by MpCpuEngine and backend.hybrid.MpHybridEngine.
+    Returns ``(conns, procs)``."""
+    ctx = mp.get_context("spawn")
+    conns, procs = [], []
+    saved_platform = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        for args in arg_tuples:
+            parent_conn, child_conn = ctx.Pipe()
+            p = ctx.Process(
+                target=target, args=(*args, child_conn), daemon=True
+            )
+            p.start()
+            child_conn.close()
+            conns.append(parent_conn)
+            procs.append(p)
+    finally:
+        if saved_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = saved_platform
+    return conns, procs
+
+
 def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
     # spawn start method: each worker REBUILDS its world replica from the
     # config — deterministic construction makes every replica identical,
@@ -142,28 +173,9 @@ class MpCpuEngine:
         parts = _partition(n, self.workers)
         owner_of = [hid % self.workers for hid in range(n)]
 
-        ctx = mp.get_context("spawn")
-        conns, procs = [], []
-        # children import shadow_tpu (which imports jax) at spawn: pin
-        # them to the CPU platform so no worker dials a device tunnel
-        saved_platform = os.environ.get("JAX_PLATFORMS")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            for w, owned in enumerate(parts):
-                parent_conn, child_conn = ctx.Pipe()
-                p = ctx.Process(
-                    target=_worker_main, args=(self.cfg, owned, child_conn),
-                    daemon=True,
-                )
-                p.start()
-                child_conn.close()
-                conns.append(parent_conn)
-                procs.append(p)
-        finally:
-            if saved_platform is None:
-                os.environ.pop("JAX_PLATFORMS", None)
-            else:
-                os.environ["JAX_PLATFORMS"] = saved_platform
+        conns, procs = spawn_cpu_workers(
+            _worker_main, [(self.cfg, owned) for owned in parts]
+        )
 
         t0 = wall_time.perf_counter()
         try:

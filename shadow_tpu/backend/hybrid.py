@@ -26,13 +26,38 @@ CoDel, and every lane-model host — runs on the device
   events in, and returns after completing any window the host
   participates in — one device call per host sync instead of per round.
 
-Event logs diff bit-identical against ``CpuEngine`` on the same config
-(tests/test_hybrid.py), which is the determinism contract the reference's
-determinism suite checks (src/test/determinism/).
+Two engines drive that seam:
+
+- :class:`HybridEngine` — the serial driver: one process services every
+  managed host's syscall plane (threads only help managed futex waits);
+- :class:`MpHybridEngine` — PARALLEL syscall servicing: N spawned worker
+  processes each own a partition of the external hosts (the analog of the
+  reference's thread-per-core syscall workers, thread_per_core.rs:17-50,
+  which its 6.38x headline used at parallelism 16) and run their syscall
+  plane concurrently, while the parent owns the device and the window
+  law.  Staged sends and egressed deliveries ride the worker pipes at
+  round barriers, so the host<->device boundary stays one injection
+  block + one egress drain per device turn regardless of worker count.
+
+Event ordering is worker-count-invariant by construction: event queues
+order by the total (time, kind, src, seq) key, injection decomposition is
+order-invariant (the device queue merge sorts on the full key), and logs
+and counters merge at barriers in deterministic (worker-id, host-id)
+order.  Event logs diff bit-identical against ``CpuEngine`` on the same
+config at any worker count (tests/test_hybrid.py, tests/test_hybrid_mp.py)
+— the determinism contract the reference's determinism suite checks
+(src/test/determinism/).
+
+The host<->device sync-cost accounting (``sync_stats``: per-turn transfer
+counts/bytes, blocking device-sync seconds, syscall-service seconds) is
+always on — the counters are a handful of Python ints per window — and is
+surfaced per window through the perf-log plumbing when
+``experimental.perf_logging`` is set (docs/hybrid.md).
 """
 
 from __future__ import annotations
 
+import os
 import time as wall_time
 from typing import Optional
 
@@ -59,20 +84,17 @@ def config_has_managed(cfg: ConfigOptions) -> bool:
     )
 
 
-class HybridEngine(CpuEngine):
-    """CpuEngine for the external (managed) hosts; TPU lanes for the rest.
+class _HostSideHybrid(CpuEngine):
+    """The host-side half of the hybrid seam, shared by the serial engine
+    and the multiprocess syscall workers: external-host bookkeeping, the
+    staging send sink, and the delivery-application law.  Construction
+    reuses ``CpuEngine.__init__`` wholesale (hosts, apps, pcap, hosts
+    file, routing — one source of truth); ``_hybrid_host_init`` then
+    strips the lane-covered hosts' host-side state."""
 
-    Construction reuses ``CpuEngine.__init__`` wholesale (hosts, apps,
-    pcap, hosts file, routing — one source of truth), then strips the
-    lane-covered hosts' host-side state and builds the device engine with
-    those hosts marked external."""
-
-    def __init__(
-        self, cfg: ConfigOptions, log_capacity: Optional[int] = None
-    ) -> None:
-        super().__init__(cfg)
+    def _hybrid_host_init(self) -> None:
         from ..native.process import ManagedApp
-        from .tpu_engine import LaneCompatError, TpuEngine
+        from .tpu_engine import LaneCompatError
 
         ext = np.array(
             [any(isinstance(a, ManagedApp) for a in h.apps) for h in self.hosts],
@@ -96,14 +118,11 @@ class HybridEngine(CpuEngine):
                 h.apps = []
                 h.queue = EventQueue()
                 h.pcap = None
-        self.device = TpuEngine(
-            cfg, log_capacity=log_capacity, external=ext, world=self.world
-        )
-        # parked payloads for in-flight packets, keyed (src_host, seq) —
-        # popped when the device egresses the delivery
-        self._parked: dict = {}
+        # hosts whose queues feed next_event_time() and whose buffers the
+        # barrier sweeps: every external host for the serial engine; a
+        # worker narrows this to its owned partition
+        self._next_hosts: list[Host] = self.external_hosts
         self._staged_merged: list = []
-        self._dev_min_used: Optional[int] = None
         self.host_rounds = 0
 
     # -- host-side packet source half (the law IS CpuEngine's) -------------
@@ -122,10 +141,9 @@ class HybridEngine(CpuEngine):
         seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload)
         if arr is None:
             return seq
-        s = src_host.host_id
-        if payload is not None:
-            self._parked[(s, seq)] = payload
-        src_host.staged.append((arr, s, seq, size_bytes, dst))
+        src_host.staged.append(
+            (arr, src_host.host_id, seq, size_bytes, dst, payload)
+        )
         return seq
 
     def inbound(self, dst_host, ev):  # pragma: no cover - defensive
@@ -138,12 +156,12 @@ class HybridEngine(CpuEngine):
 
     def next_event_time(self) -> int:
         return min(
-            (h.queue.next_time() for h in self.external_hosts), default=NEVER
+            (h.queue.next_time() for h in self._next_hosts), default=NEVER
         )
 
     def _barrier_merge(self) -> None:
         staged = self._staged_merged
-        for h in self.external_hosts:
+        for h in self._next_hosts:
             if h.staged:
                 staged.extend(h.staged)
                 h.staged = []
@@ -154,6 +172,148 @@ class HybridEngine(CpuEngine):
                 if self._min_used_lat is None or h.min_used_lat < self._min_used_lat:
                     self._min_used_lat = h.min_used_lat
                 h.min_used_lat = None
+
+    # -- delivery application ----------------------------------------------
+
+    def _apply_delivery_row(self, t, src, dst, seq, size, payload) -> None:
+        """Queue one device-egressed delivery as a host-side DELIVERY
+        event at its exact t_deliver (down bucket + CoDel already applied
+        on device; the DELIVERED/DROP_CODEL log records live in the
+        device log).  Mirrors the oracle's passive-delivery elision: an
+        external host whose apps are all passive consumes the delivery
+        inline."""
+        h = self.hosts[dst]
+        if h.pcap is not None:  # inbound capture at delivery
+            h.pcap.capture(
+                stime.sim_to_emu(t), self.ips.by_host[src],
+                self.ips.by_host[dst], size, payload,
+                key=(0, src, dst, seq),
+            )
+        if payload is None and h.passive_delivery:
+            h.now = t
+            for app in h.apps:
+                h._current_app = app
+                app.on_delivery(h, t, src, seq, size, payload=None)
+            return
+        h.queue.push(
+            Event(
+                t, EventKind.DELIVERY, src_host=src, seq=seq,
+                data=Delivery(src, seq, size, payload),
+            )
+        )
+
+
+class _HybridWorker(_HostSideHybrid):
+    """A syscall-servicing worker's world replica: the host-side hybrid
+    half restricted to an owned partition of the external hosts.  Spawned
+    by :class:`MpHybridEngine`; deterministic construction makes every
+    replica identical, and a managed OS process launches only when its
+    host's start task executes — which happens in exactly one worker."""
+
+    def __init__(self, cfg: ConfigOptions, owned: list[int]) -> None:
+        super().__init__(cfg)
+        self._hybrid_host_init()
+        owned_set = set(owned)
+        self.owned_hosts = [
+            h for h in self.external_hosts if h.host_id in owned_set
+        ]
+        self._next_hosts = self.owned_hosts
+
+
+def _hybrid_worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
+    """Worker loop: apply shipped deliveries, execute the owned hosts'
+    window (syscall servicing — the parallel hot path), sweep staged
+    sends back to the parent.  Protocol mirrors cpu_mp._worker_main."""
+    engine = _HybridWorker(cfg, owned)
+    finished = False
+    try:
+        while True:
+            msg = conn.recv()
+            if msg[0] == "round":
+                _, window_end, rows = msg
+                engine.window_end = window_end
+                for t, src, dst, seq, size, payload in rows:
+                    engine._apply_delivery_row(t, src, dst, seq, size, payload)
+                for h in engine.owned_hosts:
+                    h.execute(window_end)
+                engine._barrier_merge()
+                staged = engine._staged_merged
+                engine._staged_merged = []
+                conn.send(
+                    (engine.next_event_time(), staged, engine._min_used_lat)
+                )
+            elif msg[0] == "finish":
+                engine.finalize()
+                finished = True
+                counters: dict[str, int] = {}
+                for h in engine.owned_hosts:
+                    for k, v in h.counters.items():
+                        counters[k] = counters.get(k, 0) + v
+                conn.send((
+                    engine.event_log,
+                    counters,
+                    {h.host_id: dict(h.counters) for h in engine.owned_hosts},
+                    list(getattr(engine, "process_errors", [])),
+                ))
+                return
+            else:  # pragma: no cover - protocol error
+                return
+    finally:
+        if not finished:
+            # abnormal teardown (parent died / raised): still reap the
+            # managed OS processes this worker launched — no orphans
+            try:
+                engine.finalize()
+            except Exception:
+                pass
+        conn.close()
+
+
+class HybridEngine(_HostSideHybrid):
+    """CpuEngine for the external (managed) hosts; TPU lanes for the rest.
+
+    Owns the device, the window law, and the batched host<->device
+    boundary: one injection block in, one packed scalar vector + one
+    egress drain out per device turn (``sync_stats`` records the exact
+    transfer counts/bytes)."""
+
+    def __init__(
+        self, cfg: ConfigOptions, log_capacity: Optional[int] = None
+    ) -> None:
+        super().__init__(cfg)
+        from .tpu_engine import TpuEngine
+
+        self._hybrid_host_init()
+        self.device = TpuEngine(
+            cfg, log_capacity=log_capacity, external=self.external_mask,
+            world=self.world,
+        )
+        # parked payloads for in-flight packets, keyed (src_host, seq) —
+        # popped when the device egresses the delivery
+        self._parked: dict = {}
+        self._dev_min_used: Optional[int] = None
+        # reused host-side injection staging buffers (allocated once) and
+        # the cached device-resident empty block: turns that stage nothing
+        # (mid-window egress-drain retries) transfer nothing
+        self._inj_np = None
+        self._empty_inj = None
+        # host<->device sync-cost accounting (docs/hybrid.md): cheap
+        # Python counters, always on; perf_logging surfaces them per
+        # window through PerfLog.hybrid_agg
+        self.sync_stats: dict = {
+            "device_turns": 0,      # hybrid_fn calls (windows batched per)
+            "device_sync_s": 0.0,   # blocking scalar-readback wall time
+            "syscall_service_s": 0.0,  # host-side window execution wall
+            "scalar_reads": 0,      # D2H transfers: packed scalar vectors
+            "inject_blocks": 0,     # H2D transfers: injection blocks
+            "inject_rows": 0,       # staged sends carried by those blocks
+            "inject_bytes": 0,      # H2D bytes (7 arrays x B rows)
+            "egress_reads": 0,      # D2H transfers: egress buffer slices
+            "egress_rows": 0,       # delivery rows carried by those reads
+            "egress_bytes": 0,      # D2H bytes (padded [span, 6] int64)
+        }
+
+    # -- dynamic runahead ---------------------------------------------------
 
     def current_runahead(self) -> int:
         """The global dynamic-runahead law: min over BOTH sides' smallest
@@ -172,69 +332,79 @@ class HybridEngine(CpuEngine):
     # -- egress application -------------------------------------------------
 
     def _apply_egress(self, rows) -> None:
-        """Queue device-egressed deliveries as host-side DELIVERY events
-        at their exact t_deliver (down bucket + CoDel already applied on
-        device; the DELIVERED/DROP_CODEL log records live in the device
-        log).  Mirrors the oracle's passive-delivery elision: an external
-        host whose apps are all passive consumes the delivery inline."""
         for t, src, dst, seq, size, outcome in rows:
             t, src, dst, seq, size = int(t), int(src), int(dst), int(seq), int(size)
-            h = self.hosts[dst]
             payload = self._parked.pop((src, seq), None)
             if int(outcome) != DELIVERED:
                 continue  # device-side drop: payload released, no event
-            if h.pcap is not None:  # inbound capture at delivery
-                h.pcap.capture(
-                    stime.sim_to_emu(t), self.ips.by_host[src],
-                    self.ips.by_host[dst], size, payload,
-                    key=(0, src, dst, seq),
-                )
-            if payload is None and h.passive_delivery:
-                h.now = t
-                for app in h.apps:
-                    h._current_app = app
-                    app.on_delivery(h, t, src, seq, size, payload=None)
-                continue
-            h.queue.push(
-                Event(
-                    t, EventKind.DELIVERY, src_host=src, seq=seq,
-                    data=Delivery(src, seq, size, payload),
-                )
-            )
+            self._route_delivery(t, src, dst, seq, size, payload)
+
+    def _route_delivery(self, t, src, dst, seq, size, payload) -> None:
+        self._apply_delivery_row(t, src, dst, seq, size, payload)
 
     # -- device turn --------------------------------------------------------
 
     def _inj_block(self, staged, b: int):
-        """Pack staged sends into the fixed-size injection block."""
+        """Pack staged sends into the fixed-size injection block, reusing
+        the host-side staging arrays across turns (one H2D transfer per
+        block; payloads are parked here, keyed (src, seq))."""
         import jax.numpy as jnp
 
-        valid = np.zeros(b, dtype=bool)
-        dst = np.zeros(b, dtype=np.int32)
-        thi = np.full(b, lanes.NEVER32, dtype=np.int32)
-        tlo = np.full(b, lanes.NEVER32, dtype=np.int32)
-        auxh = np.zeros(b, dtype=np.int32)
-        auxl = np.zeros(b, dtype=np.int32)
-        size = np.zeros(b, dtype=np.int32)
-        for i, (arr, src, seq, sz, d) in enumerate(staged):
-            valid[i] = True
-            dst[i] = d
-            thi[i] = arr >> 31
-            tlo[i] = arr & lanes.MASK31
-            auxh[i] = (lanes.PACKET << lanes.AUX_KIND_SHIFT) | (
+        if self._inj_np is None:
+            self._inj_np = {
+                "valid": np.zeros(b, dtype=bool),
+                "dst": np.zeros(b, dtype=np.int32),
+                "thi": np.full(b, lanes.NEVER32, dtype=np.int32),
+                "tlo": np.full(b, lanes.NEVER32, dtype=np.int32),
+                "auxh": np.zeros(b, dtype=np.int32),
+                "auxl": np.zeros(b, dtype=np.int32),
+                "size": np.zeros(b, dtype=np.int32),
+            }
+        buf = self._inj_np
+        buf["valid"][:] = False
+        buf["thi"][:] = lanes.NEVER32
+        buf["tlo"][:] = lanes.NEVER32
+        for i, (arr, src, seq, sz, d, payload) in enumerate(staged):
+            if payload is not None:
+                self._parked[(src, seq)] = payload
+            buf["valid"][i] = True
+            buf["dst"][i] = d
+            buf["thi"][i] = arr >> 31
+            buf["tlo"][i] = arr & lanes.MASK31
+            buf["auxh"][i] = (lanes.PACKET << lanes.AUX_KIND_SHIFT) | (
                 src << lanes.AUX_SRC_SHIFT
             )
-            auxl[i] = seq
-            size[i] = sz
-        return {
-            "valid": jnp.asarray(valid), "dst": jnp.asarray(dst),
-            "thi": jnp.asarray(thi), "tlo": jnp.asarray(tlo),
-            "auxh": jnp.asarray(auxh), "auxl": jnp.asarray(auxl),
-            "size": jnp.asarray(size),
-        }
+            buf["auxl"][i] = seq
+            buf["size"][i] = sz
+        st = self.sync_stats
+        st["inject_blocks"] += 1
+        st["inject_rows"] += len(staged)
+        st["inject_bytes"] += b * (1 + 6 * 4)
+        # jnp.array COPIES (asarray may zero-copy-alias the numpy buffer
+        # on the CPU backend, and the overflow path repacks these same
+        # buffers while the previous block's dispatch is still in flight)
+        return {k: jnp.array(v) for k, v in buf.items()}
 
-    def _read_egress(self, state) -> list:
-        count = int(state.egress_count)
-        if int(state.egress_lost):
+    def _empty_block(self):
+        """The no-op injection block, built on device ONCE: egress-drain
+        retries and zero-staged turns re-use it without any H2D hop."""
+        if self._empty_inj is None:
+            import jax.numpy as jnp
+
+            b = self.device.params.inject_batch
+            self._empty_inj = {
+                "valid": jnp.zeros(b, dtype=bool),
+                "dst": jnp.zeros(b, dtype=jnp.int32),
+                "thi": jnp.full(b, lanes.NEVER32, dtype=jnp.int32),
+                "tlo": jnp.full(b, lanes.NEVER32, dtype=jnp.int32),
+                "auxh": jnp.zeros(b, dtype=jnp.int32),
+                "auxl": jnp.zeros(b, dtype=jnp.int32),
+                "size": jnp.zeros(b, dtype=jnp.int32),
+            }
+        return self._empty_inj
+
+    def _read_egress(self, state, count: int, lost: int) -> list:
+        if lost:
             raise RuntimeError(
                 "hybrid egress buffer overflowed despite the headroom "
                 "guard (device invariant violation)"
@@ -248,47 +418,79 @@ class HybridEngine(CpuEngine):
         while span < count:
             span <<= 1
         span = min(span, cap)
+        st = self.sync_stats
+        st["egress_reads"] += 1
+        st["egress_rows"] += count
+        st["egress_bytes"] += span * 6 * 8
         return np.asarray(state.egress[:span])[:count].tolist()
 
-    def _device_turn(self, state, hybrid_fn, inject_fn, host_next):
+    def _device_turn(self, state, hybrid_fn, inject_fn, next_host_fn):
         """Inject staged sends, run the device free-run loop, and apply
         egress — retrying while the device paused mid-window to drain a
-        low egress buffer."""
+        low egress buffer.  Per completed turn the boundary costs exactly
+        one injection block H2D (zero when nothing staged), one packed
+        scalar D2H, and one egress slice D2H (zero when nothing
+        egressed)."""
         p = self.device.params
         b = p.inject_batch
+        st = self.sync_stats
         staged = self._staged_merged
         self._staged_merged = []
+        # oversized staging: overflow blocks dispatch eagerly — JAX's
+        # async dispatch overlaps their H2D + queue merge with the
+        # host-side packing of the next block
         while len(staged) > b:
             state = inject_fn(state, self._inj_block(staged[:b], b))
             staged = staged[b:]
-        inj = self._inj_block(staged, b)
+        inj = self._inj_block(staged, b) if staged else self._empty_block()
         ext_used = (
             lanes.NEVER32 if self._min_used_lat is None else self._min_used_lat
         )
+        host_next = next_host_fn()
         while True:
             eh, el = (
                 (lanes.NEVER32, lanes.NEVER32)
                 if host_next >= NEVER
                 else (host_next >> 31, host_next & lanes.MASK31)
             )
-            state, lane_min = hybrid_fn(state, eh, el, ext_used, inj)
-            state = jax.block_until_ready(state)
-            lane_min = int(lane_min)
-            we_hi, we_lo, dev_used = jax.device_get(
-                (state.now_we_hi, state.now_we_lo, state.min_used_lat)
-            )
-            dev_we = (int(we_hi) << 31) | int(we_lo)
+            t0 = wall_time.perf_counter()
+            state, scalars = hybrid_fn(state, eh, el, ext_used, inj)
+            sc = jax.device_get(scalars)  # the one blocking readback
+            st["device_sync_s"] += wall_time.perf_counter() - t0
+            st["device_turns"] += 1
+            st["scalar_reads"] += 1
+            lane_min = int(sc[lanes.HYB_LANE_MIN])
+            dev_we = int(sc[lanes.HYB_DEV_WE])
+            dev_used = int(sc[lanes.HYB_MIN_USED])
             self._dev_min_used = (
-                None if int(dev_used) >= lanes.NEVER32 else int(dev_used)
+                None if dev_used >= lanes.NEVER32 else dev_used
             )
-            self._apply_egress(self._read_egress(state))
+            self._apply_egress(self._read_egress(
+                state, int(sc[lanes.HYB_EGRESS_COUNT]),
+                int(sc[lanes.HYB_EGRESS_LOST]),
+            ))
+            if self.perf_log is not None:
+                self.perf_log.hybrid_agg(
+                    "device", dev_we, self.sync_stats
+                )
             if lane_min >= dev_we:
                 return state, lane_min, dev_we
-            # mid-window pause (egress headroom): drain and resume
-            inj = self._inj_block([], b)
-            host_next = self.next_event_time()
+            # mid-window pause (egress headroom): drain and resume —
+            # the cached empty block keeps the retry transfer-free
+            inj = self._empty_block()
+            host_next = next_host_fn()
 
     # -- the hybrid round loop ----------------------------------------------
+
+    def _service_round(self, scheduler, until: int) -> None:
+        """One host-side syscall-service round + barrier, timed into
+        sync_stats (and per-window through the perf log)."""
+        t0 = wall_time.perf_counter()
+        scheduler.run_round(until)
+        self._barrier_merge()
+        self.sync_stats["syscall_service_s"] += wall_time.perf_counter() - t0
+        if self.perf_log is not None:
+            self.perf_log.hybrid_agg("host", until, self.sync_stats)
 
     def run(self, on_window=None) -> SimResult:
         from ..engine.scheduler import HostScheduler
@@ -313,7 +515,11 @@ class HybridEngine(CpuEngine):
             self.finalize()
             raise
 
-    def _hybrid_loop(self, scheduler, on_window, t0) -> SimResult:
+    def _window_loop(self, run_round, on_window):
+        """The hybrid window law, shared verbatim by the serial engine
+        and the multiprocess controller: only the round executor differs
+        (``run_round(until)`` = threaded scheduler round vs worker-pipe
+        round).  Returns the final device state for collection."""
         dev = self.device
         state = dev.initial_state()
         hybrid_fn = lanes.make_hybrid_fn(dev.params, dev.tables)
@@ -329,30 +535,32 @@ class HybridEngine(CpuEngine):
             dev_eff = min(dev_next, staged_min)
             start = min(host_next, dev_eff)
             if start >= self.stop_time or start == NEVER:
-                break
+                return state
             end = min(start + self.current_runahead(), self.stop_time)
             if self._staged_merged or dev_eff < end:
                 # device turn: complete every window up to (and including)
                 # the first one the host participates in
                 state, dev_next, dev_we = self._device_turn(
-                    state, hybrid_fn, inject_fn, host_next
+                    state, hybrid_fn, inject_fn, self.next_event_time
                 )
-                next_host = self.next_event_time()
-                if next_host < dev_we:
+                if self.next_event_time() < dev_we:
                     # host part of the device-completed window
                     self.window_end = dev_we
-                    scheduler.run_round(dev_we)
-                    self._barrier_merge()
+                    run_round(dev_we)
                     if on_window is not None:
                         on_window(start, dev_we, self.next_event_time())
                 continue
             # host-only window (device idle beyond it, nothing staged)
             self.window_end = end
-            scheduler.run_round(end)
-            self._barrier_merge()
+            run_round(end)
             self.host_rounds += 1
             if on_window is not None:
                 on_window(start, end, self.next_event_time())
+
+    def _hybrid_loop(self, scheduler, on_window, t0) -> SimResult:
+        state = self._window_loop(
+            lambda until: self._service_round(scheduler, until), on_window
+        )
         self.finalize()
         wall = wall_time.perf_counter() - t0
 
@@ -369,4 +577,161 @@ class HybridEngine(CpuEngine):
             counters=counters,
             per_host_counters=[dict(h.counters) for h in self.hosts],
             process_errors=list(getattr(self, "process_errors", [])),
+        )
+
+
+class MpHybridEngine(HybridEngine):
+    """Hybrid backend with PARALLEL syscall servicing: N spawned worker
+    processes own disjoint partitions of the external (managed) hosts and
+    execute their syscall plane concurrently (real OS-process parallelism,
+    no GIL), while the parent owns the device and the window law.
+
+    The parent is the Controller: it folds the workers' next-event times
+    (plus in-flight egressed deliveries), computes every window, ships
+    delivery rows to the owners and collects staged sends at each round
+    barrier — one pipe message per worker per round, so the host<->device
+    boundary stays as batched as the serial engine's.  Determinism is
+    worker-count-invariant (see the module docstring); ``workers=1``
+    degenerates to the serial engine (no pipe overhead, same results)."""
+
+    def __init__(
+        self, cfg: ConfigOptions, workers: int = 0,
+        log_capacity: Optional[int] = None,
+    ) -> None:
+        for hopt in cfg.hosts:
+            if hopt.pcap_enabled:
+                raise ValueError(
+                    "MpHybridEngine does not support pcap capture (every "
+                    "worker replica would open the capture files); use "
+                    "the serial hybrid engine"
+                )
+        super().__init__(cfg, log_capacity=log_capacity)
+        n_ext = len(self.external_hosts)
+        self.workers = workers if workers > 0 else (os.cpu_count() or 1)
+        self.workers = max(1, min(self.workers, n_ext))
+        self._eff_next: Optional[list[int]] = None
+        self._pending_rows: Optional[list[list]] = None
+        self._owner_of: dict[int, int] = {}
+
+    # -- controller-side bookkeeping ---------------------------------------
+
+    def next_event_time(self) -> int:
+        if self._eff_next is not None:
+            return min(self._eff_next, default=NEVER)
+        return super().next_event_time()
+
+    def _route_delivery(self, t, src, dst, seq, size, payload) -> None:
+        """Ship the delivery to the worker owning ``dst`` at the next
+        round message; fold its time into the owner's effective next-event
+        time unless the replica consumes it inline (passive elision makes
+        no queue event — the parent's replica knows which hosts are
+        passive, construction being deterministic)."""
+        if self._eff_next is None:
+            # workers==1 degenerate run: the serial loop executes hosts
+            # in-process, so deliveries apply directly
+            super()._route_delivery(t, src, dst, seq, size, payload)
+            return
+        w = self._owner_of[dst]
+        self._pending_rows[w].append((t, src, dst, seq, size, payload))
+        if not (payload is None and self.hosts[dst].passive_delivery):
+            if t < self._eff_next[w]:
+                self._eff_next[w] = t
+
+    def _mp_round(self, window_end: int) -> None:
+        """One parallel syscall-service round: ship (window_end, delivery
+        rows) to every worker, collect (next_t, staged sends, min-used
+        latency) — a single pipe message each way per worker.  Workers
+        execute concurrently between the two loops; staged sends merge in
+        (worker-id, host-id) order, which the device queue merge's total
+        key makes order-invariant anyway."""
+        t0 = wall_time.perf_counter()
+        conns, _procs = self._mp
+        for w, conn in enumerate(conns):
+            conn.send(("round", window_end, self._pending_rows[w]))
+            self._pending_rows[w] = []
+        staged = self._staged_merged
+        for w, conn in enumerate(conns):
+            next_t, out, mul = conn.recv()
+            self._eff_next[w] = next_t
+            if mul is not None and (
+                self._min_used_lat is None or mul < self._min_used_lat
+            ):
+                self._min_used_lat = mul
+            staged.extend(out)
+        self.sync_stats["syscall_service_s"] += wall_time.perf_counter() - t0
+        if self.perf_log is not None:
+            self.perf_log.hybrid_agg("host", window_end, self.sync_stats)
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, on_window=None) -> SimResult:
+        if self.workers == 1:
+            # degenerate case (single-core box): spawning one worker only
+            # adds pipe overhead — run in-process, same results
+            return super().run(on_window=on_window)
+        from .cpu_mp import _partition, spawn_cpu_workers
+
+        ext_ids = [h.host_id for h in self.external_hosts]
+        parts = [
+            [ext_ids[i] for i in p]
+            for p in _partition(len(ext_ids), self.workers)
+        ]
+        self._owner_of = {
+            hid: w for w, part in enumerate(parts) for hid in part
+        }
+        conns, procs = spawn_cpu_workers(
+            _hybrid_worker_main, [(self.cfg, owned) for owned in parts]
+        )
+        self._mp = (conns, procs)
+        self._pending_rows = [[] for _ in range(self.workers)]
+        # initial next-event times from the parent replica (identical
+        # deterministic construction — no startup round trip needed)
+        self._eff_next = [
+            min((self.hosts[i].queue.next_time() for i in owned),
+                default=NEVER)
+            for owned in parts
+        ]
+        t0 = wall_time.perf_counter()
+        try:
+            return self._mp_loop(on_window, t0)
+        finally:
+            self._eff_next = None
+            for conn in conns:
+                conn.close()
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+
+    def _mp_loop(self, on_window, t0) -> SimResult:
+        conns, _procs = self._mp
+        state = self._window_loop(self._mp_round, on_window)
+
+        event_log: list = []
+        counters: dict[str, int] = {}
+        per_host: list[dict] = [{} for _ in range(len(self.hosts))]
+        process_errors: list[str] = []
+        for conn in conns:
+            conn.send(("finish",))
+        for conn in conns:
+            log, cnt, per, errs = conn.recv()
+            event_log.extend(log)
+            for k, v in cnt.items():
+                counters[k] = counters.get(k, 0) + v
+            for hid, c in per.items():
+                per_host[hid] = c
+            process_errors.extend(errs)
+        wall = wall_time.perf_counter() - t0
+
+        dev_result = self.device.collect(state, wall)
+        for k, v in dev_result.counters.items():
+            counters[k] = counters.get(k, 0) + v
+        return SimResult(
+            sim_time_ns=self.stop_time,
+            wall_seconds=wall,
+            rounds=dev_result.rounds + self.host_rounds,
+            event_log=dev_result.event_log + event_log,
+            counters=counters,
+            per_host_counters=per_host,
+            process_errors=process_errors,
         )

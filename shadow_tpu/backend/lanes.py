@@ -3084,14 +3084,40 @@ def _build_hybrid_run(p: LaneParams, tb: LaneTables):
 
         s = unpack_state(lax.while_loop(cond, body, pack_state(s)))
         lane_min = t_join(*_queue_min(p, s))
-        return s, lane_min
+        # ONE packed scalar vector per device turn: every host-side
+        # decision input (lane_min, completed window end, dynamic-runahead
+        # fold, egress fill/overflow) rides a single [5] int64 transfer —
+        # the host issues one readback per turn instead of six (the
+        # tunneled runtime charges per transfer, not per byte, at this
+        # size; docs/hybrid.md quantifies the before/after)
+        scalars = jnp.stack(
+            [
+                lane_min,
+                t_join(s.now_we_hi, s.now_we_lo),
+                (s.min_used_lat if p.dynamic_runahead
+                 else jnp.int32(NEVER32)).astype(jnp.int64),
+                s.egress_count.astype(jnp.int64),
+                s.egress_lost.astype(jnp.int64),
+            ]
+        )
+        return s, scalars
 
     return hybrid_run
 
 
+# indices into the packed scalar vector returned by make_hybrid_fn
+HYB_LANE_MIN = 0
+HYB_DEV_WE = 1
+HYB_MIN_USED = 2
+HYB_EGRESS_COUNT = 3
+HYB_EGRESS_LOST = 4
+
+
 def make_hybrid_fn(p: LaneParams, tb: LaneTables):
     """Jitted hybrid device call: (state, ext_min_hi, ext_min_lo,
-    ext_used_lat, inject_block) -> (state, lane_min)."""
+    ext_used_lat, inject_block) -> (state, scalars[5] int64) where
+    scalars = (lane_min, dev_window_end, min_used_lat, egress_count,
+    egress_lost) — see the HYB_* indices."""
     return jax.jit(_build_hybrid_run(p, tb))
 
 

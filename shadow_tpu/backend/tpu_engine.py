@@ -54,7 +54,7 @@ class TpuEngine:
         log_capacity: Optional[int] = None,
         strict_capacity: bool = True,
         external=None,
-        inject_batch: int = 512,
+        inject_batch: Optional[int] = None,
         world=None,
     ) -> None:
         """``external``: optional [N] bool mask — marked hosts are
@@ -69,6 +69,8 @@ class TpuEngine:
         cfg.validate()
         self.cfg = cfg
         self.strict_capacity = strict_capacity
+        if inject_batch is None:
+            inject_batch = cfg.experimental.tpu_inject_batch
         n = len(cfg.hosts)
         ext_mask = (
             np.zeros(n, dtype=bool) if external is None

@@ -111,6 +111,15 @@ class ExperimentalOptions:
     tpu_stream_tiered: bool = True
     tpu_stream_events_per_round: int = 8  # tier pops per iteration (K_s)
     tpu_stream_queue_capacity: int = 64  # tier queue width (C2)
+    # HYBRID backend (backend/hybrid.py): syscall-servicing worker
+    # processes for the managed hosts while their packets ride the TPU
+    # lanes.  1 = serial in-process servicing; 0 = one worker per core;
+    # N > 1 = exactly N spawned workers.  Results are bit-identical at
+    # any worker count (tests/test_hybrid_mp.py).
+    hybrid_workers: int = 1
+    # injection block rows per device turn (B): staged managed-host sends
+    # coalesce into blocks of this size for the host->device hop
+    tpu_inject_batch: int = 512
 
 
 @dataclasses.dataclass

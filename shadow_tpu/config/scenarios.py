@@ -40,10 +40,17 @@ def managed_chain_config(
     gap_ms: int = 50,
     seed: int = 42,
     parallelism: int = 1,
+    backend: str = "cpu",
+    hybrid_workers: int = 1,
 ) -> ConfigOptions:
     """Relay-chain scenario config.  Managed process count =
     ``1 + 3*chains + chains*clients_per_chain``; host count adds
-    ``peers`` model hosts."""
+    ``peers`` model hosts.
+
+    ``backend="tpu"`` selects the HYBRID engine (managed hosts' syscall
+    plane on host CPU, every packet on the TPU lanes);
+    ``hybrid_workers`` then picks the syscall-servicing parallelism
+    (1 = serial, 0 = one worker per core, N = exactly N workers)."""
     n_clients = chains * clients_per_chain
     hosts = [
         f"""
@@ -106,6 +113,9 @@ general:
   data_directory: {data_dir}
   heartbeat_interval: null
   parallelism: {parallelism}
+experimental:
+  network_backend: {backend}
+  hybrid_workers: {hybrid_workers}
 network:
   graph:
     type: gml
@@ -131,3 +141,59 @@ hosts:
 
 def managed_proc_count(chains: int, clients_per_chain: int) -> int:
     return 1 + 3 * chains + chains * clients_per_chain
+
+
+def managed_relay_chains_large(
+    data_dir: str | Path,
+    chains: int = 25,
+    clients_per_chain: int = 3,
+    peers: int = 1000,
+    sim_seconds: int = 10,
+    rounds: int = 8,
+    size: int = 2048,
+    hybrid_workers: int = 0,
+    seed: int = 42,
+) -> ConfigOptions:
+    """The HYBRID flagship scenario (BENCH_r06 `hybrid_*` keys, ROADMAP
+    open item 1): 100+ managed OS processes (default 151 = 25 three-relay
+    chains + 75 clients + origin) whose syscall plane runs across
+    ``hybrid_workers`` processes, over 1k+ lane hosts (default 1000 tgen
+    peers) whose data plane — and every managed packet — rides the TPU
+    lanes.  This is the workload class the reference's 6.38x headline was
+    measured on, at the reference's own scale point."""
+    return managed_chain_config(
+        data_dir,
+        chains=chains,
+        clients_per_chain=clients_per_chain,
+        peers=peers,
+        sim_seconds=sim_seconds,
+        rounds=rounds,
+        size=size,
+        seed=seed,
+        backend="tpu",
+        hybrid_workers=hybrid_workers,
+    )
+
+
+def managed_relay_chains_gate(
+    data_dir: str | Path,
+    hybrid_workers: int = 2,
+    sim_seconds: int = 8,
+    backend: str = "tpu",
+) -> ConfigOptions:
+    """The SHADOW_TPU_SCALE-gated small sibling of
+    :func:`managed_relay_chains_large`: the same shape at 16 managed
+    processes over 60 lane hosts, sized so the gate exercises the full
+    hybrid seam (parallel syscall servicing included) on the CPU JAX
+    platform — no TPU time needed (tests/test_hybrid_mp.py)."""
+    return managed_chain_config(
+        data_dir,
+        chains=3,
+        clients_per_chain=2,
+        peers=60,
+        sim_seconds=sim_seconds,
+        rounds=3,
+        size=1024,
+        backend=backend,
+        hybrid_workers=hybrid_workers,
+    )

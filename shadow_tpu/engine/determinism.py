@@ -53,8 +53,21 @@ def _run_once(cfg: ConfigOptions):
     # mutable state (host lists, process args) across runs
     cfg = copy.deepcopy(cfg)
     if cfg.experimental.network_backend == "tpu":
+        from ..backend.hybrid import (
+            HybridEngine,
+            MpHybridEngine,
+            config_has_managed,
+        )
         from ..backend.tpu_engine import TpuEngine
 
+        if config_has_managed(cfg):
+            # managed binaries: the HYBRID engine owns this config (same
+            # backend selection as engine.sim), including the parallel
+            # syscall-servicing path — run-twice checks cover it too
+            hw = cfg.experimental.hybrid_workers
+            if hw != 1:
+                return MpHybridEngine(cfg, workers=hw).run()
+            return HybridEngine(cfg).run()
         return TpuEngine(cfg).run(mode="device")
     from ..backend.cpu_engine import CpuEngine
 

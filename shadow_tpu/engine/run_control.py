@@ -360,5 +360,29 @@ class PerfLog:
                 flush=True,
             )
 
+    def hybrid_agg(self, kind: str, window_end: int, sync_stats: dict) -> None:
+        """``[hybrid-agg]`` telemetry (hybrid backend, docs/hybrid.md):
+        one line per host round (kind=host) / device turn (kind=device)
+        carrying the CUMULATIVE host<->device sync-cost counters, so the
+        per-window deltas — transfer counts, bytes, blocking device-sync
+        and syscall-service wall time — are reproducible from a flag
+        instead of ad-hoc prints."""
+        s = sync_stats
+        print(
+            f"[hybrid-agg] kind={kind} window_end_ns={window_end} "
+            f"device_turns={s['device_turns']} "
+            f"device_sync_ns={int(s['device_sync_s'] * 1e9)} "
+            f"syscall_service_ns={int(s['syscall_service_s'] * 1e9)} "
+            f"scalar_reads={s['scalar_reads']} "
+            f"inject_blocks={s['inject_blocks']} "
+            f"inject_rows={s['inject_rows']} "
+            f"inject_bytes={s['inject_bytes']} "
+            f"egress_reads={s['egress_reads']} "
+            f"egress_rows={s['egress_rows']} "
+            f"egress_bytes={s['egress_bytes']}",
+            file=self._sink,
+            flush=True,
+        )
+
     def timer(self) -> float:
         return wall_time.perf_counter_ns()

@@ -231,26 +231,33 @@ class Simulation:
                 )
             # the HYBRID backend: managed hosts' syscall plane on the host
             # CPU, the packet data plane (theirs included) on the device.
-            # Run-control and perf-logging need the per-round step seam,
-            # which the device free-run deliberately elides — both are
-            # disabled here (use the cpu backend for console debugging).
+            # Run-control needs the per-round pause seam, which the device
+            # free-run deliberately elides — it is disabled here (use the
+            # cpu backend for console debugging).  Perf-logging IS
+            # supported: [hybrid-agg] sync-cost lines per window.
             if self.run_control is not None:
                 log.warning(
                     "run-control is not supported on the hybrid tpu "
                     "backend; running without it"
                 )
                 self.run_control = None
-            if self.cfg.experimental.perf_logging:
-                log.warning(
-                    "perf-logging is not supported on the hybrid tpu "
-                    "backend; running without it"
-                )
             if self.cfg.experimental.tpu_mesh_shape is not None:
                 log.warning(
                     "tpu_mesh_shape is not supported on the hybrid tpu "
                     "backend; running single-device"
                 )
-            engine = self.engine = HybridEngine(self.cfg)
+            # parallel syscall servicing: hybrid_workers != 1 spawns the
+            # multiprocess engine (0 = one worker per core); results are
+            # bit-identical at any worker count
+            hw = self.cfg.experimental.hybrid_workers
+            if hw != 1:
+                from ..backend.hybrid import MpHybridEngine
+
+                engine = self.engine = MpHybridEngine(self.cfg, workers=hw)
+            else:
+                engine = self.engine = HybridEngine(self.cfg)
+            if self.cfg.experimental.perf_logging:
+                engine.perf_log = PerfLog()
             t0 = wall_time.perf_counter()
             on_window = self._make_on_window(
                 engine.describe_next_window, engine.current_runahead, t0

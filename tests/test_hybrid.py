@@ -15,6 +15,8 @@ import pytest
 from shadow_tpu.config.options import ConfigOptions
 from shadow_tpu.engine.sim import Simulation
 
+pytestmark = pytest.mark.hybrid
+
 REPO = Path(__file__).resolve().parents[1]
 BUILD = REPO / "native" / "build"
 
