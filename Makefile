@@ -6,7 +6,7 @@
 # CTest gate (src/test/determinism/CMakeLists.txt).
 
 .PHONY: test gate native smoke-faults smoke-examples lint-determinism \
-	bench-hybrid
+	bench-hybrid obs-smoke bench-report
 
 test: native
 	python -m pytest tests/ -q
@@ -21,6 +21,7 @@ gate: native lint-determinism
 	SHADOW_TPU_SCALE=1 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_hybrid_mp.py -q
 	$(MAKE) smoke-examples
+	$(MAKE) obs-smoke
 
 # The hybrid backend's short deterministic benchmark (one JSON line):
 # the relay-chain scenario scaled down to CI size, syscall plane on 2
@@ -51,6 +52,17 @@ lint-determinism:
 smoke-faults:
 	JAX_PLATFORMS=cpu python -m shadow_tpu examples/partition-heal.yaml \
 	  --determinism-check --data-directory /tmp/shadow-tpu-smoke-faults.data
+
+# Observability smoke for the gate: a metrics+trace-enabled phold run
+# asserting a valid METRICS_*.json artifact, a Perfetto-loadable Chrome
+# trace whose per-phase span sums match the report, and a parseable
+# JSONL stream (docs/observability.md).
+obs-smoke:
+	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+# Regenerate docs/bench-trajectory.md from the BENCH_r0N.json artifacts.
+bench-report:
+	python scripts/bench_report.py --write docs/bench-trajectory.md
 
 # Examples smoke for the gate: the phold classic, run twice with a
 # run-twice determinism diff (bit-identical event orderings + counters).

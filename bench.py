@@ -171,13 +171,17 @@ def _hybrid_rate():
     processes over 1000+ lane hosts — syscall plane across N worker
     processes, every packet on the TPU lane data plane.  Reports the
     steady-state rate (the engine's run loop), the end-to-end wall
-    (construction + compile included), flow-completion counters, and the
-    host<->device sync-cost breakdown the analysis doc is built from."""
+    (construction + compile included), flow-completion counters, the
+    host<->device sync-cost breakdown the analysis doc is built from,
+    and the obs-measured per-phase wall attribution
+    (``hybrid_phase_wall_s``, docs/observability.md) that BENCH_r07+
+    record."""
     from shadow_tpu.backend.hybrid import MpHybridEngine
     from shadow_tpu.config.scenarios import (
         managed_proc_count,
         managed_relay_chains_large,
     )
+    from shadow_tpu.obs import Recorder
 
     _build_native()
     tmp = tempfile.mkdtemp(prefix="shadow_bench_hybrid_")
@@ -192,12 +196,17 @@ def _hybrid_rate():
         # bench diffs counters, not logs) — the Simulation facade path is
         # what the parity/determinism tests exercise
         eng = MpHybridEngine(cfg, workers=HYBRID_WORKERS, log_capacity=0)
+        eng.obs = Recorder(run_id="bench-hybrid")
         t0 = time.perf_counter()
         result = eng.run()
         total = time.perf_counter() - t0
         sync = {
             k: (round(v, 3) if isinstance(v, float) else int(v))
             for k, v in getattr(eng, "sync_stats", {}).items()
+        }
+        phase_wall = {
+            k: round(v, 3)
+            for k, v in sorted(eng.obs.metrics.phase_wall_s().items())
         }
         return {
             "hybrid_sim_s_per_wall_s": round(
@@ -220,6 +229,7 @@ def _hybrid_rate():
             ),
             "hybrid_rounds": int(result.rounds),
             "hybrid_sync": sync,
+            "hybrid_phase_wall_s": phase_wall,
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""End-to-end obs smoke (``make obs-smoke``, wired into ``make gate``).
+
+Runs the examples/phold.yaml classic with metrics + tracing fully
+enabled and asserts the run produced:
+
+1. a valid ``METRICS_*.json`` artifact (schema keys, nonzero windows,
+   per-phase wall totals);
+2. a loadable Chrome-trace JSON whose complete events cover the phases
+   the METRICS report attributes — and whose summed span wall per phase
+   matches the report's ``phase_wall_s`` totals (the same cross-check
+   the acceptance criterion makes on hybrid runs);
+3. a JSONL metric stream with one parseable record per line.
+
+Exit 0 = all assertions hold; any failure raises (nonzero exit).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    from shadow_tpu.__main__ import main as cli_main
+
+    tmp = Path(tempfile.mkdtemp(prefix="shadow_obs_smoke_"))
+    data = tmp / "data"
+    try:
+        rc = cli_main([
+            str(REPO / "examples" / "phold.yaml"),
+            "--stop-time", "2s",
+            "--data-directory", str(data),
+            "--obs-metrics",
+            "--obs-trace",
+            "--set", "experimental.obs_jsonl=true",
+        ])
+        assert rc == 0, f"simulation exited {rc}"
+
+        metrics = sorted(data.glob("METRICS_*.json"))
+        assert metrics, f"no METRICS_*.json in {data}"
+        rep = json.loads(metrics[0].read_text())
+        for key in ("schema", "run_id", "phase_wall_s", "phases",
+                    "counters", "histograms", "sim_counters"):
+            assert key in rep, f"METRICS report missing {key!r}"
+        assert rep["counters"].get("windows", 0) > 0, "no windows recorded"
+        assert rep["phase_wall_s"], "no phase wall attribution"
+        assert all(v >= 0 for v in rep["phase_wall_s"].values())
+
+        traces = sorted(data.glob("trace_*.json"))
+        assert traces, f"no trace_*.json in {data}"
+        doc = json.loads(traces[0].read_text())
+        events = doc.get("traceEvents")
+        assert isinstance(events, list) and events, "empty traceEvents"
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert spans, "no complete (ph=X) span events"
+        for e in spans:
+            for key in ("name", "cat", "ts", "dur", "pid", "tid"):
+                assert key in e, f"span missing {key!r}: {e}"
+        summed: dict[str, float] = {}
+        for e in spans:
+            summed[e["cat"]] = summed.get(e["cat"], 0.0) + e["dur"] / 1e6
+        for phase, wall in rep["phase_wall_s"].items():
+            got = summed.get(phase, 0.0)
+            assert abs(got - wall) <= max(1e-6, 1e-6 * wall), (
+                f"phase {phase}: trace spans sum to {got}, METRICS says {wall}"
+            )
+
+        jsonl = sorted(data.glob("metrics_*.jsonl"))
+        assert jsonl, f"no metrics_*.jsonl in {data}"
+        n = 0
+        with open(jsonl[0]) as f:
+            for line in f:
+                json.loads(line)
+                n += 1
+        assert n > 0, "empty JSONL stream"
+
+        print(
+            f"obs-smoke OK: {rep['counters']['windows']} windows, "
+            f"{len(spans)} spans over {sorted(summed)} "
+            f"(METRICS/trace/JSONL artifacts all valid)"
+        )
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

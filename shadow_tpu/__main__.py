@@ -58,6 +58,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="print [window-agg]/[host-exec-agg] parallelism telemetry",
     )
     p.add_argument(
+        "--obs-metrics",
+        action="store_true",
+        help="record per-phase wall metrics and write a METRICS_*.json "
+        "run report (shadow_tpu/obs/, docs/observability.md)",
+    )
+    p.add_argument(
+        "--obs-trace",
+        action="store_true",
+        help="record phase spans and export a Chrome-trace/Perfetto JSON "
+        "(implies --obs-metrics)",
+    )
+    p.add_argument(
         "--set",
         dest="overrides",
         action="append",
@@ -108,6 +120,10 @@ def main(argv: list[str] | None = None) -> int:
             overrides["experimental.run_control"] = True
         if ns.perf_logging:
             overrides["experimental.perf_logging"] = True
+        if ns.obs_metrics:
+            overrides["experimental.obs_metrics"] = True
+        if ns.obs_trace:
+            overrides["experimental.obs_trace"] = True
         cfg.apply_overrides(overrides)
         cfg.validate()
     except (ConfigError, OSError, KeyError) as e:

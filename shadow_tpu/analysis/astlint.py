@@ -7,12 +7,13 @@ Scope model (see ``docs/analysis.md``):
 
 - every module is checked for SL101/SL102/SL104/SL106-by-scope;
 - *ordering-sensitive* modules (``engine/``, ``backend/``, ``net/``,
-  ``faults/``, ``core/``) additionally get SL103 (unordered set
-  iteration) and SL105 (float accumulation);
+  ``faults/``, ``core/``, ``obs/``) additionally get SL103 (unordered
+  set iteration) and SL105 (float accumulation);
 - *step-path* scope for SL106 is any function in ``engine/``/
-  ``backend/`` whose name — or an enclosing function's name — matches
-  ``STEP_NAME_RE`` (the round loop's vocabulary: step/iter/round/
-  window/advance/tick/pop/drive/body).
+  ``backend/``/``obs/`` whose name — or an enclosing function's name —
+  matches ``STEP_NAME_RE`` (the round loop's vocabulary: step/iter/
+  round/window/advance/tick/pop/drive/body; ``obs/`` is in scope
+  because its emit paths run inside those rounds).
 
 Intent escapes, in order of preference:
 
@@ -37,8 +38,8 @@ from typing import Iterable, Optional
 
 from .findings import Finding
 
-ORDERING_SENSITIVE = ("engine", "backend", "net", "faults", "core")
-STEP_PATH_DIRS = ("engine", "backend")
+ORDERING_SENSITIVE = ("engine", "backend", "net", "faults", "core", "obs")
+STEP_PATH_DIRS = ("engine", "backend", "obs")
 STEP_NAME_RE = re.compile(
     r"(step|iter|round|window|advance|tick|pop|drive|body)"
 )

@@ -340,6 +340,9 @@ class CpuEngine:
         # [window-agg]/[host-exec-agg] telemetry sink (set by the facade
         # when experimental.perf_logging is on; None = zero overhead)
         self.perf_log = None
+        # obs Recorder (shadow_tpu/obs/): phase spans + metrics, set by
+        # the facade when experimental.obs_* is on; None = zero overhead
+        self.obs = None
 
         # fault schedule (shadow_tpu/faults/): versioned routing tables
         # installed in place at window boundaries; every event time is a
@@ -615,6 +618,7 @@ class CpuEngine:
             raise
 
     def _round_loop(self, scheduler, on_window, t0) -> "SimResult":
+        obs = self.obs
         while True:
             start = self.next_event_time()
             if start >= self.stop_time or start == stime.NEVER:
@@ -625,20 +629,36 @@ class CpuEngine:
                 # t >= epoch see the new tables, earlier sends never do —
                 # the identical law the TPU engine's epoch segmentation
                 # enforces, so windows (and logs) stay bit-identical
-                self.faults.advance_to(start)
+                if obs is None:
+                    self.faults.advance_to(start)
+                else:
+                    with obs.phase("fault_swap", window_start=start):
+                        self.faults.advance_to(start)
             self.window_end = min(start + self.current_runahead(), self.stop_time)
             if self.faults is not None:
                 self.window_end = min(
                     self.window_end, self.faults.window_bound(start)
                 )
             pl = self.perf_log
-            if pl is not None:
+            if pl is not None or obs is not None:
                 active = sum(
                     1 for h in self.hosts if h.queue.next_time() < self.window_end
                 )
-            scheduler.run_round(self.window_end)
-            self._barrier_merge()
+            if obs is None:
+                scheduler.run_round(self.window_end)
+                self._barrier_merge()
+            else:
+                with obs.phase(
+                    "window_compute", window_end=self.window_end, active=active
+                ):
+                    scheduler.run_round(self.window_end)
+                    self._barrier_merge()
             self.rounds += 1
+            if obs is not None:
+                m = obs.metrics
+                m.count("windows")
+                m.observe("window_active_hosts", active)
+                m.observe("window_span_ns", self.window_end - start)
             if pl is not None or on_window is not None:
                 next_ev = self.next_event_time()
                 if pl is not None:

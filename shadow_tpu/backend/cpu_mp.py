@@ -83,6 +83,12 @@ def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
     # runtime threads may hold locks is a documented deadlock, and the
     # parent has usually initialized a device backend by now)
     engine = CpuEngine(cfg)
+    if cfg.experimental.perf_logging:
+        # worker perf lines buffer locally and ride the round reply to
+        # the parent's locked sink (engine/run_control.BufferedPerfLog)
+        from ..engine.run_control import BufferedPerfLog
+
+        engine.perf_log = BufferedPerfLog()
     owned_hosts = [engine.hosts[i] for i in owned]
     owned_set = set(owned)
     try:
@@ -116,7 +122,11 @@ def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                     default=stime.NEVER,
                 )
                 mul = engine._min_used_lat
-                conn.send((next_t, outbound, mul))
+                conn.send((
+                    next_t, outbound, mul,
+                    engine.perf_log.drain()
+                    if engine.perf_log is not None else (),
+                ))
             elif msg[0] == "finish":
                 engine.finalize()
                 counters: dict[str, int] = {}
@@ -148,6 +158,11 @@ class MpCpuEngine:
                     "worker replica would open the capture files); use "
                     "CpuEngine"
                 )
+        # obs Recorder + perf sink: attach before run() (the facade
+        # pattern); perf_logging in the config makes run() build the
+        # default stderr sink itself so worker lines have somewhere to go
+        self.obs = None
+        self.perf_log = None
         # Managed (native-shim) hosts are supported: every worker replica
         # instantiates all ManagedApp objects, but a process LAUNCHES only
         # when its host's start task executes — and workers execute owned
@@ -160,10 +175,17 @@ class MpCpuEngine:
         self.workers = max(1, min(self.workers, len(cfg.hosts)))
 
     def run(self) -> SimResult:
+        if self.cfg.experimental.perf_logging and self.perf_log is None:
+            from ..engine.run_control import PerfLog
+
+            self.perf_log = PerfLog()
         if self.workers == 1:
             # degenerate case (single-core box): forking one worker only
             # adds pipe overhead — run in-process, same results
-            return CpuEngine(self.cfg).run()
+            eng = CpuEngine(self.cfg)
+            eng.perf_log = self.perf_log
+            eng.obs = self.obs
+            return eng.run()
         # the parent's replica serves the Controller role: initial
         # next-event times, runahead, stop time (no host ever executes
         # here)
@@ -187,6 +209,7 @@ class MpCpuEngine:
             pending: list[list] = [[] for _ in range(self.workers)]
             min_used_lat = None
             rounds = 0
+            obs = self.obs
             while True:
                 start = min(next_times)
                 if start >= stop or start == stime.NEVER:
@@ -195,11 +218,14 @@ class MpCpuEngine:
                 # latency into the serial engine's own formula
                 ctl._min_used_lat = min_used_lat
                 window_end = min(start + ctl.current_runahead(), stop)
+                t_round = wall_time.perf_counter() if obs is not None else 0.0
                 for w, conn in enumerate(conns):
                     conn.send(("round", window_end, pending[w]))
                     pending[w] = []
+                t_ship = wall_time.perf_counter() if obs is not None else 0.0
+                perf_lines: list[str] = []
                 for w, conn in enumerate(conns):
-                    next_t, outbound, mul = conn.recv()
+                    next_t, outbound, mul, wlines = conn.recv()
                     next_times[w] = next_t
                     if mul is not None and (
                         min_used_lat is None or mul < min_used_lat
@@ -207,6 +233,8 @@ class MpCpuEngine:
                         min_used_lat = mul
                     for pkt in outbound:
                         pending[owner_of[pkt[0]]].append(pkt)
+                    if wlines:
+                        perf_lines.extend(wlines)
                 # in-flight cross-partition packets lower the owners'
                 # next-event times before the next window is computed
                 for w in range(self.workers):
@@ -214,6 +242,22 @@ class MpCpuEngine:
                         if pkt[1] < next_times[w]:
                             next_times[w] = pkt[1]
                 rounds += 1
+                if obs is not None:
+                    # the collect leg IS the workers' window execution as
+                    # seen from the controller; the ship leg is pure pipe
+                    t1 = wall_time.perf_counter()
+                    obs.record("worker_pipe", "pipe_ship", t_round,
+                               t_ship - t_round)
+                    obs.record("window_compute", "mp_round", t_ship,
+                               t1 - t_ship, window_end=window_end)
+                    m = obs.metrics
+                    m.count("windows")
+                    m.count("pipe_messages", 2 * self.workers)
+                    m.observe("window_span_ns", window_end - start)
+                # worker perf lines route through the parent's locked
+                # sink, in (round, worker-id) order — one coherent stream
+                if perf_lines and self.perf_log is not None:
+                    self.perf_log.emit_many(perf_lines)
 
             event_log: list = []
             counters: dict[str, int] = {}

@@ -223,8 +223,14 @@ class _HybridWorker(_HostSideHybrid):
 def _hybrid_worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
     """Worker loop: apply shipped deliveries, execute the owned hosts'
     window (syscall servicing — the parallel hot path), sweep staged
-    sends back to the parent.  Protocol mirrors cpu_mp._worker_main."""
+    sends back to the parent.  Protocol mirrors cpu_mp._worker_main.
+    Perf-log lines buffer locally and ride the round reply to the
+    parent's locked sink (one coherent stream per run)."""
     engine = _HybridWorker(cfg, owned)
+    if cfg.experimental.perf_logging:
+        from ..engine.run_control import BufferedPerfLog
+
+        engine.perf_log = BufferedPerfLog()
     finished = False
     try:
         while True:
@@ -239,9 +245,13 @@ def _hybrid_worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                 engine._barrier_merge()
                 staged = engine._staged_merged
                 engine._staged_merged = []
-                conn.send(
-                    (engine.next_event_time(), staged, engine._min_used_lat)
-                )
+                conn.send((
+                    engine.next_event_time(),
+                    staged,
+                    engine._min_used_lat,
+                    engine.perf_log.drain()
+                    if engine.perf_log is not None else (),
+                ))
             elif msg[0] == "finish":
                 engine.finalize()
                 finished = True
@@ -434,15 +444,24 @@ class HybridEngine(_HostSideHybrid):
         p = self.device.params
         b = p.inject_batch
         st = self.sync_stats
+        obs = self.obs
         staged = self._staged_merged
         self._staged_merged = []
         # oversized staging: overflow blocks dispatch eagerly — JAX's
         # async dispatch overlaps their H2D + queue merge with the
-        # host-side packing of the next block
+        # host-side packing of the next block.  The injection span covers
+        # packing + dispatch; the transfer itself overlaps the device call
+        t_inj = wall_time.perf_counter() if obs is not None else 0.0
+        n_staged = len(staged)
         while len(staged) > b:
             state = inject_fn(state, self._inj_block(staged[:b], b))
             staged = staged[b:]
         inj = self._inj_block(staged, b) if staged else self._empty_block()
+        if obs is not None and n_staged:
+            obs.record(
+                "injection", None, t_inj,
+                wall_time.perf_counter() - t_inj, rows=n_staged,
+            )
         ext_used = (
             lanes.NEVER32 if self._min_used_lat is None else self._min_used_lat
         )
@@ -456,7 +475,8 @@ class HybridEngine(_HostSideHybrid):
             t0 = wall_time.perf_counter()
             state, scalars = hybrid_fn(state, eh, el, ext_used, inj)
             sc = jax.device_get(scalars)  # the one blocking readback
-            st["device_sync_s"] += wall_time.perf_counter() - t0
+            t1 = wall_time.perf_counter()
+            st["device_sync_s"] += t1 - t0
             st["device_turns"] += 1
             st["scalar_reads"] += 1
             lane_min = int(sc[lanes.HYB_LANE_MIN])
@@ -465,10 +485,24 @@ class HybridEngine(_HostSideHybrid):
             self._dev_min_used = (
                 None if dev_used >= lanes.NEVER32 else dev_used
             )
-            self._apply_egress(self._read_egress(
-                state, int(sc[lanes.HYB_EGRESS_COUNT]),
-                int(sc[lanes.HYB_EGRESS_LOST]),
-            ))
+            if obs is not None:
+                obs.record(
+                    "device_turn", None, t0, t1 - t0, window_end=dev_we
+                )
+                obs.metrics.count("device_turns")
+            egress_count = int(sc[lanes.HYB_EGRESS_COUNT])
+            if obs is None or egress_count == 0:
+                # empty egress is a no-op read: no span (symmetric with
+                # the injection record, and no tracer-capacity burn)
+                self._apply_egress(self._read_egress(
+                    state, egress_count, int(sc[lanes.HYB_EGRESS_LOST]),
+                ))
+            else:
+                with obs.phase("egress", rows=egress_count):
+                    self._apply_egress(self._read_egress(
+                        state, egress_count, int(sc[lanes.HYB_EGRESS_LOST]),
+                    ))
+                obs.metrics.count("egress_rows", egress_count)
             if self.perf_log is not None:
                 self.perf_log.hybrid_agg(
                     "device", dev_we, self.sync_stats
@@ -484,11 +518,16 @@ class HybridEngine(_HostSideHybrid):
 
     def _service_round(self, scheduler, until: int) -> None:
         """One host-side syscall-service round + barrier, timed into
-        sync_stats (and per-window through the perf log)."""
+        sync_stats (and per-window through the perf log / obs spans)."""
         t0 = wall_time.perf_counter()
         scheduler.run_round(until)
         self._barrier_merge()
-        self.sync_stats["syscall_service_s"] += wall_time.perf_counter() - t0
+        t1 = wall_time.perf_counter()
+        self.sync_stats["syscall_service_s"] += t1 - t0
+        if self.obs is not None:
+            self.obs.record(
+                "syscall_service", None, t0, t1 - t0, window_end=until
+            )
         if self.perf_log is not None:
             self.perf_log.hybrid_agg("host", until, self.sync_stats)
 
@@ -645,20 +684,43 @@ class MpHybridEngine(HybridEngine):
         (worker-id, host-id) order, which the device queue merge's total
         key makes order-invariant anyway."""
         t0 = wall_time.perf_counter()
+        obs = self.obs
         conns, _procs = self._mp
         for w, conn in enumerate(conns):
             conn.send(("round", window_end, self._pending_rows[w]))
             self._pending_rows[w] = []
+        t_ship = wall_time.perf_counter()
         staged = self._staged_merged
+        perf_lines: list[str] = []
         for w, conn in enumerate(conns):
-            next_t, out, mul = conn.recv()
+            next_t, out, mul, wlines = conn.recv()
             self._eff_next[w] = next_t
             if mul is not None and (
                 self._min_used_lat is None or mul < self._min_used_lat
             ):
                 self._min_used_lat = mul
             staged.extend(out)
-        self.sync_stats["syscall_service_s"] += wall_time.perf_counter() - t0
+            if wlines:
+                perf_lines.extend(wlines)
+        t1 = wall_time.perf_counter()
+        self.sync_stats["syscall_service_s"] += t1 - t0
+        if obs is not None:
+            # disjoint attribution (same law as cpu_mp): worker_pipe is
+            # the ship leg, syscall_service the collect leg — the barrier
+            # wait that IS the workers' syscall execution wall.  The two
+            # tile the round exactly, so phase sums never double-count
+            # (sync_stats' syscall_service_s keeps covering the whole
+            # round, ship included — the legacy [hybrid-agg] counter)
+            obs.record("worker_pipe", "pipe_ship", t0, t_ship - t0)
+            obs.record(
+                "syscall_service", None, t_ship, t1 - t_ship,
+                window_end=window_end,
+            )
+            obs.metrics.count("pipe_messages", 2 * len(conns))
+        # worker-process perf lines route through the parent's locked
+        # sink, in (round, worker-id) order — one coherent stream
+        if perf_lines and self.perf_log is not None:
+            self.perf_log.emit_many(perf_lines)
         if self.perf_log is not None:
             self.perf_log.hybrid_agg("host", window_end, self.sync_stats)
 

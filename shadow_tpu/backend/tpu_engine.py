@@ -544,6 +544,9 @@ class TpuEngine:
         self._interval = lanes.DEFAULT_INTERVAL_NS
         # [window-agg] telemetry sink (step mode only; set by the facade)
         self.perf_log = None
+        # obs Recorder (shadow_tpu/obs/): device_turn spans per round in
+        # step mode, one fused span in device mode; None = zero overhead
+        self.obs = None
 
     def _resolve(self, hostname: str, n: int) -> int:
         return self.dns.resolve(hostname)
@@ -793,7 +796,14 @@ class TpuEngine:
             if getattr(self, "_compiled", None) is not None:
                 run_fn = self._compiled
             t0 = wall_time.perf_counter()
-            state = jax.block_until_ready(run_fn(state))
+            if self.obs is None:
+                state = jax.block_until_ready(run_fn(state))
+            else:
+                # the fused loop is one opaque device call: attribute it
+                # as a single device_turn span (per-window spans need the
+                # step driver — run-control/perf-logging select it)
+                with self.obs.phase("device_turn", name="device_free_run"):
+                    state = jax.block_until_ready(run_fn(state))
             wall = wall_time.perf_counter() - t0
         else:
             round_fn = lanes.make_round_fn(self.params, self.tables)
@@ -816,9 +826,10 @@ class TpuEngine:
             if self._watchdog_timeout is not None
             else None
         )
+        obs = self.obs
         while True:
             self._live_state = state
-            if on_window is not None or self.perf_log is not None:
+            if on_window is not None or self.perf_log is not None or obs is not None:
                 # queue rows are sorted: column 0 is each lane's min
                 lane_next = np.asarray(
                     lanes.t_join(state.q_thi[:, 0], state.q_tlo[:, 0])
@@ -836,15 +847,27 @@ class TpuEngine:
             t_round = wall_time.perf_counter()
             state, done = round_fn(state)
             done = bool(done)  # forces the device sync the timing needs
+            t_done = wall_time.perf_counter()
             if wd is not None:
-                wd.observe(wall_time.perf_counter() - t_round)
+                wd.observe(t_done - t_round)
+            if obs is not None:
+                obs.record(
+                    "device_turn", "device_round", t_round, t_done - t_round,
+                    active=active,
+                )
+                m = obs.metrics
+                m.count("device_turns")
+                m.observe("window_active_hosts", active)
             if done:
                 break
-            if on_window is not None or self.perf_log is not None:
+            if on_window is not None or self.perf_log is not None or obs is not None:
                 window_end = int(
                     (int(state.now_we_hi) << 31) | int(state.now_we_lo)
                 )
                 next_ev = self._next_event_np(state)
+                if obs is not None:
+                    obs.metrics.count("windows")
+                    obs.metrics.observe("window_span_ns", window_end - start)
                 if self.perf_log is not None:
                     self.perf_log.window_agg(
                         active, start, window_end,

@@ -95,6 +95,16 @@ class ExperimentalOptions:
     # window boundaries) and [window-agg]/[host-exec-agg] telemetry
     run_control: bool = False
     perf_logging: bool = False
+    # observability (shadow_tpu/obs/, docs/observability.md): per-phase
+    # wall metrics -> METRICS_*.json, span tracing -> Chrome-trace JSON,
+    # optional JSONL event stream and jax.profiler annotation
+    # pass-through.  All default off = zero overhead; event ordering is
+    # bit-identical with everything on (docs/determinism.md)
+    obs_metrics: bool = False
+    obs_trace: bool = False
+    obs_jsonl: bool = False
+    obs_jax_annotations: bool = False
+    obs_dir: Optional[str] = None  # None = general.data_directory
     # --- TPU-native extensions -------------------------------------------
     network_backend: str = "cpu"  # "cpu" | "tpu"
     tpu_lane_queue_capacity: int = 64  # per-host in-flight packet slots

@@ -19,6 +19,13 @@ Command grammar (identical to the reference fork):
 - ``r``        restart from t=0 (in-process, deterministic)
 - ``rN``       restart and run to N simulated seconds, then pause
 
+Observability extensions (shadow_tpu/obs/, docs/observability.md):
+
+- ``stats``          print a live metrics snapshot (phase walls,
+  counters, gauges) at the current window boundary
+- ``trace``          tracer status; ``trace on|off`` toggles recording;
+  ``trace dump [path]`` exports the Chrome trace collected so far
+
 Fault-injection extensions (shadow_tpu/faults/):
 
 - ``fault <verb> ...``  schedule a fault at the current window boundary
@@ -98,6 +105,9 @@ class RunControl:
         # fault-injection seams (engine/sim.py wires these per backend)
         self._fault_sink: Optional[Callable[[list[str]], str]] = None
         self.failover_armed = False
+        # obs seam (engine/sim.py wires the run's Recorder): the
+        # stats/trace console verbs answer from it at window boundaries
+        self._obs = None
 
     # -- command input -----------------------------------------------------
 
@@ -110,6 +120,11 @@ class RunControl:
         """Register the engine's fault-injection callback: ``sink(tokens)``
         schedules the fault and returns a confirmation line."""
         self._fault_sink = sink
+
+    def set_obs(self, obs) -> None:
+        """Register the run's obs Recorder (shadow_tpu/obs/) so the
+        ``stats`` / ``trace`` verbs can answer from live state."""
+        self._obs = obs
 
     def start_stdin_thread(self) -> None:
         """Read commands from stdin on a daemon thread (interactive use)."""
@@ -191,7 +206,8 @@ class RunControl:
         self._print(
             f"[run-control] paused at window boundary: sim-time "
             f"{stime.fmt(window_end)} (next event {stime.fmt(next_event_time)}); "
-            "commands: c / cN / n / s / s:<pid> / r / rN / fault ... / failover"
+            "commands: c / cN / n / s / s:<pid> / r / rN / stats / "
+            "trace ... / fault ... / failover"
         )
         self._print_info()
         # soft-wait: block until a resuming command arrives
@@ -258,6 +274,12 @@ class RunControl:
                 "is already on the cpu engine)"
             )
             return False
+        if cmd == "stats":
+            self._cmd_stats()
+            return False
+        if cmd == "trace" or cmd.startswith("trace "):
+            self._cmd_trace(cmd.split()[1:])
+            return False
         if cmd == "fault" or cmd.startswith("fault "):
             tokens = cmd.split()[1:]
             if self._fault_sink is None:
@@ -273,6 +295,56 @@ class RunControl:
             return False
         self._print(f"[run-control] unknown command {cmd!r}")
         return False
+
+    # -- obs verbs (docs/observability.md) ---------------------------------
+
+    def _cmd_stats(self) -> None:
+        """``stats``: print a live metrics snapshot — phase walls,
+        counters, gauges — at the current window boundary."""
+        if self._obs is None:
+            self._print(
+                "[run-control] obs is not enabled (set "
+                "experimental.obs_metrics / obs_trace)"
+            )
+            return
+        self._print("[run-control] stats:")
+        for line in self._obs.metrics.snapshot_lines():
+            self._print(f"[run-control]   {line}")
+
+    def _cmd_trace(self, tokens: list[str]) -> None:
+        """``trace`` status / ``trace on|off`` toggle / ``trace dump``:
+        live control of the span tracer."""
+        obs = self._obs
+        tracer = getattr(obs, "tracer", None)
+        if tracer is None:
+            self._print(
+                "[run-control] tracing is not enabled (set "
+                "experimental.obs_trace)"
+            )
+            return
+        if not tokens:
+            state = "recording" if tracer.enabled else "paused"
+            self._print(
+                f"[run-control] trace: {state}, "
+                f"{tracer.span_count()} span(s) recorded, "
+                f"{tracer.dropped} dropped"
+            )
+            return
+        verb = tokens[0]
+        if verb in ("on", "off"):
+            tracer.enabled = verb == "on"
+            self._print(f"[run-control] trace recording {verb}")
+            return
+        if verb == "dump":
+            if len(tokens) > 1:
+                path = tokens[1]
+            elif obs.out_dir is not None:
+                path = str(obs.out_dir / f"trace_{obs.run_id}.json")
+            else:
+                path = f"trace_{obs.run_id}.json"
+            self._print(f"[run-control] trace written: {tracer.export(path)}")
+            return
+        self._print(f"[run-control] unknown trace subcommand {verb!r}")
 
     _pending_run_for: Optional[int] = None
 
@@ -312,9 +384,17 @@ class RunControl:
 
 
 class PerfLog:
-    """``[window-agg]`` / ``[host-exec-agg]`` telemetry (reference fork
-    manager.rs:636-656, host.rs:807-830).  Line formats match the fork so
-    existing analysis tooling parses both."""
+    """``[window-agg]`` / ``[host-exec-agg]`` / ``[hybrid-agg]`` telemetry
+    (reference fork manager.rs:636-656, host.rs:807-830).  Line formats
+    match the fork so existing analysis tooling parses both — pinned by
+    the golden-format tests in tests/test_obs.py.
+
+    Every emission goes through ONE locked :meth:`emit`, so concurrent
+    emitters (host-execution worker threads, the round loop) can never
+    interleave partial lines.  Worker *processes* route their lines to
+    the parent's sink through :class:`BufferedPerfLog` + the round pipes
+    (``MpCpuEngine`` / ``MpHybridEngine``), so a multiprocess run emits
+    one coherent stream."""
 
     HOST_EXEC_LOG_EVERY = 1000  # host.rs:43
 
@@ -330,45 +410,48 @@ class PerfLog:
     def _sink(self) -> TextIO:
         return self._out if self._out is not None else sys.stderr
 
-    def window_agg(
-        self,
+    def emit(self, line: str) -> None:
+        """The one locked emit path: whole lines only, never interleaved."""
+        with self._lock:
+            print(line, file=self._sink, flush=True)
+
+    def emit_many(self, lines: list[str]) -> None:
+        """Emit forwarded lines (a worker process's buffered telemetry)
+        as one locked batch, preserving their order."""
+        if not lines:
+            return
+        with self._lock:
+            sink = self._sink
+            for line in lines:
+                print(line, file=sink, flush=True)
+
+    @staticmethod
+    def format_window_agg(
         active_hosts: int,
         window_start: int,
         window_end: int,
         next_event_time: int,
-    ) -> None:
-        print(
+    ) -> str:
+        return (
             f"[window-agg] active_hosts_in_window={active_hosts} "
             f"window_start_ns={window_start} window_end_ns={window_end} "
-            f"next_event_ns={next_event_time}",
-            file=self._sink,
-            flush=True,
+            f"next_event_ns={next_event_time}"
         )
 
-    def host_exec(self, hostname: str, elapsed_ns: int, window_end: int) -> None:
-        with self._lock:
-            self.host_exec_calls += 1
-            self.host_exec_total_ns += elapsed_ns
-            calls = self.host_exec_calls
-            total = self.host_exec_total_ns
-        if calls % self.HOST_EXEC_LOG_EVERY == 0:
-            print(
-                f"[host-exec-agg] calls={calls} "
-                f"total_ns={total} last_ns={elapsed_ns} "
-                f"host={hostname} window_end_abs_ns={window_end}",
-                file=self._sink,
-                flush=True,
-            )
+    @staticmethod
+    def format_host_exec_agg(
+        calls: int, total_ns: int, last_ns: int, hostname: str, window_end: int
+    ) -> str:
+        return (
+            f"[host-exec-agg] calls={calls} "
+            f"total_ns={total_ns} last_ns={last_ns} "
+            f"host={hostname} window_end_abs_ns={window_end}"
+        )
 
-    def hybrid_agg(self, kind: str, window_end: int, sync_stats: dict) -> None:
-        """``[hybrid-agg]`` telemetry (hybrid backend, docs/hybrid.md):
-        one line per host round (kind=host) / device turn (kind=device)
-        carrying the CUMULATIVE host<->device sync-cost counters, so the
-        per-window deltas — transfer counts, bytes, blocking device-sync
-        and syscall-service wall time — are reproducible from a flag
-        instead of ad-hoc prints."""
+    @staticmethod
+    def format_hybrid_agg(kind: str, window_end: int, sync_stats: dict) -> str:
         s = sync_stats
-        print(
+        return (
             f"[hybrid-agg] kind={kind} window_end_ns={window_end} "
             f"device_turns={s['device_turns']} "
             f"device_sync_ns={int(s['device_sync_s'] * 1e9)} "
@@ -379,10 +462,65 @@ class PerfLog:
             f"inject_bytes={s['inject_bytes']} "
             f"egress_reads={s['egress_reads']} "
             f"egress_rows={s['egress_rows']} "
-            f"egress_bytes={s['egress_bytes']}",
-            file=self._sink,
-            flush=True,
+            f"egress_bytes={s['egress_bytes']}"
         )
+
+    def window_agg(
+        self,
+        active_hosts: int,
+        window_start: int,
+        window_end: int,
+        next_event_time: int,
+    ) -> None:
+        self.emit(
+            self.format_window_agg(
+                active_hosts, window_start, window_end, next_event_time
+            )
+        )
+
+    def host_exec(self, hostname: str, elapsed_ns: int, window_end: int) -> None:
+        with self._lock:
+            self.host_exec_calls += 1
+            self.host_exec_total_ns += elapsed_ns
+            calls = self.host_exec_calls
+            total = self.host_exec_total_ns
+        if calls % self.HOST_EXEC_LOG_EVERY == 0:
+            self.emit(
+                self.format_host_exec_agg(
+                    calls, total, elapsed_ns, hostname, window_end
+                )
+            )
+
+    def hybrid_agg(self, kind: str, window_end: int, sync_stats: dict) -> None:
+        """``[hybrid-agg]`` telemetry (hybrid backend,
+        docs/observability.md): one line per host round (kind=host) /
+        device turn (kind=device) carrying the CUMULATIVE host<->device
+        sync-cost counters, so the per-window deltas — transfer counts,
+        bytes, blocking device-sync and syscall-service wall time — are
+        reproducible from a flag instead of ad-hoc prints."""
+        self.emit(self.format_hybrid_agg(kind, window_end, sync_stats))
 
     def timer(self) -> float:
         return wall_time.perf_counter_ns()
+
+
+class BufferedPerfLog(PerfLog):
+    """The worker-process side of perf-line forwarding: :meth:`emit`
+    buffers instead of printing, and the worker's round reply carries
+    :meth:`drain`'s batch to the parent, which prints it through its own
+    locked :meth:`PerfLog.emit_many` — one coherent stream per run, in
+    deterministic (round, worker-id) order."""
+
+    def __init__(self) -> None:
+        super().__init__(out=None)
+        self._buffer: list[str] = []
+
+    def emit(self, line: str) -> None:
+        with self._lock:
+            self._buffer.append(line)
+
+    def drain(self) -> list[str]:
+        with self._lock:
+            out = self._buffer
+            self._buffer = []
+        return out

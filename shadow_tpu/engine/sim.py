@@ -49,6 +49,7 @@ class Simulation:
         self.restarts = 0
         self.failovers = 0  # TPU->CPU graceful degradations this run
         self.engine = None  # the backend engine of the most recent run()
+        self.obs = None  # the run's obs Recorder (shadow_tpu/obs/)
 
     # -- running -----------------------------------------------------------
 
@@ -65,10 +66,36 @@ class Simulation:
         shadow_log.set_sim_time_provider(
             lambda: getattr(self.engine, "window_end", 0) or 0
         )
+        self.obs = self._make_obs()
+        if self.obs is not None and self.run_control is not None:
+            # the stats/trace console verbs answer from the live recorder
+            self.run_control.set_obs(self.obs)
         try:
             return self._run_logged(write_data, t0)
         finally:
             shadow_log.set_sim_time_provider(None)
+            if self.obs is not None and self.obs.finalized is None:
+                # failed/aborted run: still flush the partial artifacts —
+                # a crash is exactly when the phase breakdown matters
+                self.obs.finalize()
+
+    def _make_obs(self):
+        """Build the run's obs Recorder from ``experimental.obs_*``
+        (None = everything off = zero engine overhead)."""
+        exp = self.cfg.experimental
+        if not (exp.obs_metrics or exp.obs_trace or exp.obs_jsonl):
+            return None
+        from ..obs import Recorder
+
+        out_dir = Path(exp.obs_dir) if exp.obs_dir else self.data_dir
+        run_id = f"{exp.network_backend}-seed{self.cfg.general.seed}"
+        return Recorder(
+            run_id=run_id,
+            out_dir=out_dir,
+            trace=exp.obs_trace,
+            jsonl=exp.obs_jsonl,
+            jax_annotations=exp.obs_jax_annotations,
+        )
 
     def _run_logged(self, write_data: bool, t0: float) -> SimResult:
         cfg = self.cfg
@@ -116,6 +143,26 @@ class Simulation:
             result.rounds,
             len(result.event_log),
         )
+        if self.obs is not None:
+            extra = {
+                "backend": backend,
+                "seed": cfg.general.seed,
+                "num_hosts": len(cfg.hosts),
+                "sim_time_ns": result.sim_time_ns,
+                "wall_seconds": result.wall_seconds,
+                "total_wall_seconds": total,
+                "rounds": result.rounds,
+                "restarts": self.restarts,
+                "failovers": self.failovers,
+                "sim_counters": dict(sorted(result.counters.items())),
+            }
+            sync = getattr(self.engine, "sync_stats", None)
+            if sync is not None:
+                extra["hybrid_sync"] = dict(sync)
+            fin = self.obs.finalize(extra=extra)
+            for k in ("metrics_path", "trace_path"):
+                if k in fin:
+                    log.info("obs artifact: %s", fin[k])
         if write_data:
             self._write_data(result, total)
         return result
@@ -207,6 +254,7 @@ class Simulation:
             self.run_control.set_fault_sink(engine.console_fault_sink)
         if self.cfg.experimental.perf_logging:
             engine.perf_log = PerfLog()
+        engine.obs = self.obs
         t0 = wall_time.perf_counter()
         on_window = self._make_on_window(
             engine.describe_next_window, engine.current_runahead, t0
@@ -258,6 +306,7 @@ class Simulation:
                 engine = self.engine = HybridEngine(self.cfg)
             if self.cfg.experimental.perf_logging:
                 engine.perf_log = PerfLog()
+            engine.obs = self.obs
             t0 = wall_time.perf_counter()
             on_window = self._make_on_window(
                 engine.describe_next_window, engine.current_runahead, t0
@@ -265,6 +314,7 @@ class Simulation:
             return engine.run(on_window=on_window)
 
         engine = self.engine = TpuEngine(self.cfg)
+        engine.obs = self.obs
         mesh_shape = self.cfg.experimental.tpu_mesh_shape
         if mesh_shape is not None and len(mesh_shape) == 1 and mesh_shape[0] > 1:
             if self.cfg.faults.events:
@@ -277,11 +327,16 @@ class Simulation:
 
             from .. import parallel
 
-            if self.run_control is not None or self.cfg.experimental.perf_logging:
+            if (
+                self.run_control is not None
+                or self.cfg.experimental.perf_logging
+                or self.obs is not None
+            ):
                 log.warning(
-                    "run-control / perf-logging are not supported on the "
-                    "sharded-mesh driver (fused on-device loop); running "
-                    "without them — drop tpu_mesh_shape to use them"
+                    "run-control / perf-logging / obs spans are not "
+                    "supported on the sharded-mesh driver (fused on-device "
+                    "loop); running without them — drop tpu_mesh_shape to "
+                    "use them"
                 )
 
             mesh = parallel.make_mesh(mesh_shape[0])

@@ -1,0 +1,26 @@
+"""Structured observability: span tracing + metrics (docs/observability.md).
+
+The instrument every perf PR reads from.  One :class:`Recorder` per run
+owns a :class:`~shadow_tpu.obs.metrics.MetricsRegistry` (counters,
+gauges, timers, per-window histograms, per-phase wall attribution) and —
+when tracing is enabled — a :class:`~shadow_tpu.obs.tracer.Tracer`
+(Chrome-trace/Perfetto span export).  Engines hold ``self.obs`` exactly
+like ``self.perf_log``: ``None`` (the default) is zero overhead — every
+hook is behind an ``if obs is not None`` branch — and the facade
+(:mod:`shadow_tpu.engine.sim`) sets it from
+``experimental.obs_metrics`` / ``obs_trace``.
+
+The determinism contract (docs/determinism.md) is absolute: obs reads
+wall clocks (through the ``import time as wall_time`` alias shadowlint
+SL101 prescribes) and engine counters, and writes only to its own
+artifacts — it never feeds a value back into the simulation, so event
+ordering is bit-identical with obs fully enabled.
+"""
+
+from __future__ import annotations
+
+from .metrics import MetricsRegistry
+from .recorder import PHASES, Recorder
+from .tracer import Tracer
+
+__all__ = ["MetricsRegistry", "PHASES", "Recorder", "Tracer"]
