@@ -6,7 +6,7 @@
 # CTest gate (src/test/determinism/CMakeLists.txt).
 
 .PHONY: test gate native smoke-faults smoke-examples lint-determinism \
-	bench-hybrid obs-smoke bench-report
+	bench-hybrid obs-smoke netobs-smoke bench-report
 
 test: native
 	python -m pytest tests/ -q
@@ -22,6 +22,7 @@ gate: native lint-determinism
 	  tests/test_hybrid_mp.py -q
 	$(MAKE) smoke-examples
 	$(MAKE) obs-smoke
+	$(MAKE) netobs-smoke
 
 # The hybrid backend's short deterministic benchmark (one JSON line):
 # the relay-chain scenario scaled down to CI size, syscall plane on 2
@@ -59,6 +60,13 @@ smoke-faults:
 # JSONL stream (docs/observability.md).
 obs-smoke:
 	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+# Network-telemetry smoke for the gate: a phold run plus a faulted
+# drop-heavy scenario, both through the CLI with --netobs, asserting a
+# valid NETOBS_*.json artifact with nonzero drop-cause attribution and
+# sent == delivered + drops conservation (docs/observability.md).
+netobs-smoke:
+	JAX_PLATFORMS=cpu python scripts/netobs_smoke.py
 
 # Regenerate docs/bench-trajectory.md from the BENCH_r0N.json artifacts.
 bench-report:

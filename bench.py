@@ -90,6 +90,10 @@ HYBRID_SIM_SECONDS = int(os.environ.get(
     "SHADOW_TPU_BENCH_HYBRID_SIM_SECONDS", "10"
 ))
 HYBRID_WORKERS = int(os.environ.get("SHADOW_TPU_BENCH_HYBRID_WORKERS", "0"))
+# netobs evidence run (burst-window histogram for ROADMAP open item 3):
+# one extra UNTIMED mixed-mesh run with the telemetry plane on — the
+# timed best-of runs stay netobs-off so the headline numbers are clean
+NETOBS = os.environ.get("SHADOW_TPU_BENCH_NETOBS", "1") == "1"
 
 
 # the tunneled runtime caches EXECUTIONS across processes keyed on
@@ -122,6 +126,30 @@ def _best_device_rate(cfg, salt0, repeats=None):
         if r.sim_seconds_per_wall_second > best.sim_seconds_per_wall_second:
             best = r
     return best
+
+
+def _netobs_evidence(cfg, salt0):
+    """One netobs-enabled run of ``cfg``: the burst-window histogram
+    (nonzero log2 buckets) plus the bucket-throttle total, straight from
+    the device telemetry plane (obs/netobs.py).  Untimed — the counters
+    are cheap adds, but the evidence run stays separate from the
+    best-of timing samples either way.  (Drop/retransmit totals come
+    from the TIMED run's own counters — one source of truth.)"""
+    import copy as _copy
+
+    cfg = _copy.deepcopy(cfg)
+    cfg.experimental.netobs = True
+    eng = TpuEngine(cfg, log_capacity=0)
+    eng.run(mode="device", cache_salt=salt0)
+    snap = eng.netobs_snapshot()
+    hist = snap["window_hist"]
+    return {
+        "window_hist": {
+            f"b{i}": int(v) for i, v in enumerate(hist) if v
+        },
+        "windows": int(hist.sum()),
+        "throttled": int(snap["arrays"]["throttled"].sum()),
+    }
 
 
 def _build_native() -> None:
@@ -255,6 +283,11 @@ def main() -> None:
         "vs_baseline": round(value / REFERENCE_SPEEDUP, 4),
     }
     configs = {"tgen_mesh_10k_udp": round(value, 4)}
+    out["mesh_drops"] = {
+        "loss": int(result.counters.get("lane_drop_loss", 0)),
+        "codel": int(result.counters.get("lane_drop_codel", 0)),
+        "queue": int(result.counters.get("lane_drop_queue", 0)),
+    }
 
     # the MIXED TCP/UDP mesh (north-star config #4's full shape): the
     # stream tier on device alongside the datagram mesh, at FULL 10k lanes
@@ -271,7 +304,27 @@ def main() -> None:
             mr.counters.get("stream_flows_done", 0)
         )
         out["mixed_iters"] = int(mr.counters.get("lane_iters", 0))
+        # per-scenario drop/retransmit totals from the timed run's own
+        # counters (free: they ride the existing collect readback)
+        out["mixed_drops"] = {
+            "loss": int(mr.counters.get("lane_drop_loss", 0)),
+            "codel": int(mr.counters.get("lane_drop_codel", 0)),
+            "queue": int(mr.counters.get("lane_drop_queue", 0)),
+        }
+        out["mixed_retransmits"] = int(
+            mr.counters.get("stream_retransmits", 0)
+        )
         configs["tgen_mesh_10k_mixed"] = out["mixed_sim_s_per_wall_s"]
+        if NETOBS:
+            # the burst-window histogram: open item 3's evidence base —
+            # where the mixed mesh's windows actually bunch up
+            ev = _netobs_evidence(
+                mixed_flagship_config(MIXED_HOSTS, sim_seconds=5),
+                _SALT + 500,
+            )
+            out["mixed_window_hist"] = ev["window_hist"]
+            out["mixed_windows"] = ev["windows"]
+            out["mixed_throttled"] = ev["throttled"]
 
     # BASELINE.md ladder configs 1-3 (4 is above, 5 is the managed run)
     if LADDER:

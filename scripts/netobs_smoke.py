@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""End-to-end netobs smoke (``make netobs-smoke``, wired into ``make gate``).
+
+Two runs through the CLI, both with the network telemetry plane on:
+
+1. the examples/phold.yaml classic — asserts a valid ``NETOBS_*.json``
+   artifact (schema keys, per-host counter catalog, a window histogram
+   whose bucket sum equals its ``windows`` total, sent == delivered +
+   drops conservation);
+2. a drop-heavy faulted scenario (a loss-ramp fault schedule over a
+   lossy low-bandwidth link) — asserts NONZERO drop-cause attribution
+   (loss + codel) and that the drop totals agree with the per-host
+   breakdown.
+
+Exit 0 = all assertions hold; any failure raises (nonzero exit).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+FAULTED_CFG = """
+general: {stop_time: 2s, seed: 13, heartbeat_interval: null}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_up "4 Mbit" host_bandwidth_down "1 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.05 ]
+      ]
+experimental: {network_backend: cpu}
+faults:
+  events:
+    - {kind: loss, at: 500ms, source: 0, target: 0, loss: 0.3}
+hosts:
+  srv:
+    network_node_id: 0
+    processes: [{path: tgen-server}]
+  cli:
+    count: 5
+    network_node_id: 0
+    processes:
+      - path: tgen-client
+        args: --server srv --interval 5ms --size 1300
+"""
+
+
+def _check_report(path: Path) -> dict:
+    rep = json.loads(path.read_text())
+    for key in ("schema", "run_id", "backend", "seed", "totals",
+                "drops_by_cause", "drop_total", "window_hist",
+                "top_talkers", "log_lost"):
+        assert key in rep, f"NETOBS report missing {key!r}"
+    hist = rep["window_hist"]
+    assert hist["scheme"] == "log2-packet-arrivals"
+    assert sum(hist["buckets"]) == hist["windows"], "histogram sum drift"
+    tot = rep["totals"]
+    # conservation: every sent packet is delivered, dropped on the wire
+    # path (loss at the sender, codel/queue/shed at the receiver), or
+    # still in flight at stop_time
+    wire_drops = (
+        tot["drop_loss"] + tot["drop_codel"] + tot["drop_queue"]
+        + tot["drop_cross_shed"]
+    )
+    assert rep["in_flight"] >= 0, f"negative in_flight: {rep['in_flight']}"
+    assert tot["sent"] == tot["delivered"] + wire_drops + rep["in_flight"]
+    if "per_host" in rep:
+        for k in ("sent", "delivered", "drop_loss", "drop_codel"):
+            per = sum(h[k] for h in rep["per_host"].values())
+            assert per == tot[k], f"per-host {k} sum != total"
+    return rep
+
+
+def main() -> int:
+    from shadow_tpu.__main__ import main as cli_main
+
+    tmp = Path(tempfile.mkdtemp(prefix="shadow_netobs_smoke_"))
+    try:
+        # 1. phold classic with the telemetry plane on
+        data = tmp / "phold"
+        rc = cli_main([
+            str(REPO / "examples" / "phold.yaml"),
+            "--stop-time", "2s",
+            "--data-directory", str(data),
+            "--netobs",
+        ])
+        assert rc == 0, f"phold run exited {rc}"
+        arts = sorted(data.glob("NETOBS_*.json"))
+        assert arts, f"no NETOBS_*.json in {data}"
+        rep = _check_report(arts[0])
+        assert rep["window_hist"]["windows"] > 0, "no windows recorded"
+        assert rep["totals"]["sent"] > 0, "phold sent nothing"
+
+        # 2. faulted drop-heavy scenario: nonzero drop-cause attribution
+        cfg_path = tmp / "faulted.yaml"
+        cfg_path.write_text(FAULTED_CFG)
+        data2 = tmp / "faulted"
+        rc = cli_main([
+            str(cfg_path),
+            "--data-directory", str(data2),
+            "--netobs",
+        ])
+        assert rc == 0, f"faulted run exited {rc}"
+        arts2 = sorted(data2.glob("NETOBS_*.json"))
+        assert arts2, f"no NETOBS_*.json in {data2}"
+        rep2 = _check_report(arts2[0])
+        drops = rep2["drops_by_cause"]
+        assert drops["loss"] > 0, f"no loss drops attributed: {drops}"
+        assert drops["codel"] > 0, f"no codel drops attributed: {drops}"
+        assert rep2["drop_total"] == sum(drops.values())
+
+        print(
+            "netobs-smoke OK: phold "
+            f"{rep['totals']['sent']} sent / "
+            f"{rep['window_hist']['windows']} windows; faulted drops "
+            f"{drops} (artifacts valid, conservation holds)"
+        )
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

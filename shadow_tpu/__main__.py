@@ -70,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
         "(implies --obs-metrics)",
     )
     p.add_argument(
+        "--netobs",
+        action="store_true",
+        help="record per-host network telemetry (sent/delivered/bytes, "
+        "drop-cause accounting, burst-window histogram) and write a "
+        "NETOBS_*.json run report (docs/observability.md)",
+    )
+    p.add_argument(
         "--set",
         dest="overrides",
         action="append",
@@ -124,6 +131,8 @@ def main(argv: list[str] | None = None) -> int:
             overrides["experimental.obs_metrics"] = True
         if ns.obs_trace:
             overrides["experimental.obs_trace"] = True
+        if ns.netobs:
+            overrides["experimental.netobs"] = True
         cfg.apply_overrides(overrides)
         cfg.validate()
     except (ConfigError, OSError, KeyError) as e:

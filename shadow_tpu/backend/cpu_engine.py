@@ -221,27 +221,44 @@ class Host:
             self._execute(until)
 
     def _execute(self, until: int) -> None:
-        while True:
-            ev = self.queue.peek()
-            if ev is None or ev.time >= until:
-                return
-            ev = self.queue.pop()
-            self.now = ev.time
-            if ev.kind == EventKind.PACKET:
-                self.engine.inbound(self, ev)
-            elif ev.kind == EventKind.DELIVERY:
-                data = ev.data
-                if isinstance(data.payload, _TcpSegment):
-                    self.net.on_segment(ev.time, data.payload)
-                else:
-                    for app in self.apps:
-                        self._current_app = app
-                        app.on_delivery(
-                            self, ev.time, data.src, data.seq, data.size,
-                            payload=data.payload,
-                        )
+        no = self.engine.netobs
+        pops = 0
+        try:
+            while True:
+                ev = self.queue.peek()
+                if ev is None or ev.time >= until:
+                    return
+                if ev.kind == EventKind.PACKET:
+                    # PACKET pops only: wire arrivals are the one event
+                    # class whose per-window counts are bit-identical
+                    # across backends (LOCAL/DELIVERY decomposition
+                    # differs: start anchors, delivery elision), so the
+                    # netobs window histogram buckets them
+                    pops += 1
+                ev = self.queue.pop()
+                self.now = ev.time
+                self._dispatch(ev)
+        finally:
+            if no is not None and pops:
+                # one thread-owned row write per execute call
+                no.pops[self.host_id] += pops
+
+    def _dispatch(self, ev) -> None:
+        if ev.kind == EventKind.PACKET:
+            self.engine.inbound(self, ev)
+        elif ev.kind == EventKind.DELIVERY:
+            data = ev.data
+            if isinstance(data.payload, _TcpSegment):
+                self.net.on_segment(ev.time, data.payload)
             else:
-                ev.data.execute(self)
+                for app in self.apps:
+                    self._current_app = app
+                    app.on_delivery(
+                        self, ev.time, data.src, data.seq, data.size,
+                        payload=data.payload,
+                    )
+        else:
+            ev.data.execute(self)
 
     _current_app = None
 
@@ -337,6 +354,15 @@ class CpuEngine:
         self.event_log: list[LogRecord] = []
         self.window_end = 0
         self.rounds = 0
+        # netobs telemetry plane (obs/netobs.py): per-host network
+        # counters + window-occupancy histogram.  Config-driven (worker
+        # replicas of the multiprocess engines need it too); None = off
+        # = zero overhead, the same contract as obs/perf_log
+        self.netobs = None
+        if cfg.experimental.netobs:
+            from ..obs.netobs import NetObs
+
+            self.netobs = NetObs(len(self.hosts))
         # [window-agg]/[host-exec-agg] telemetry sink (set by the facade
         # when experimental.perf_logging is on; None = zero overhead)
         self.perf_log = None
@@ -352,6 +378,47 @@ class CpuEngine:
             from ..faults.overlay import build_fault_runtime
 
             self.faults = build_fault_runtime(cfg, self.graph, self.routing)
+
+    # -- netobs telemetry plane (obs/netobs.py) ----------------------------
+
+    def netobs_snapshot(self):
+        """The run's per-host telemetry in the canonical array schema
+        (None when netobs is off).  Completes the accumulator's counters
+        with the values only the engine can attribute: token-bucket
+        throttles (the buckets live on the hosts), stream retransmit /
+        retry-give-up counters (host counter dicts), queue/shed causes
+        (structurally zero here: the oracle's queues are unbounded)."""
+        no = self.netobs
+        if no is None:
+            return None
+        arrays = no.base_arrays()
+        for hid, h in enumerate(self.hosts):
+            arrays["throttled"][hid] = (
+                h.up_bucket.throttles + h.down_bucket.throttles
+            )
+            arrays["retransmits"][hid] = h.counters.get(
+                "stream_retransmits", 0
+            )
+            arrays["retry_giveup"][hid] = h.counters.get(
+                "stream_retry_drops", 0
+            )
+        return {
+            "arrays": arrays,
+            "window_hist": no.window_hist.copy(),
+            "log_lost": 0,
+        }
+
+    def netobs_lines(self, host=None) -> list[str]:
+        """Run-control ``netstats [host]`` answer from live state."""
+        from ..obs import netobs as nom
+
+        snap = self.netobs_snapshot()
+        if snap is None:
+            return ["netobs is not enabled (set experimental.netobs)"]
+        names = [h.hostname for h in self.hosts]
+        return nom.snapshot_lines(
+            snap["arrays"], snap["window_hist"], names, host
+        )
 
     def console_fault_sink(self, tokens: list[str]) -> str:
         """Run-control ``fault ...`` verb: schedule a fault at the current
@@ -387,6 +454,9 @@ class CpuEngine:
         seq = src_host.send_seq
         src_host.send_seq += 1
         s, d = src_host.host_id, dst
+        no = self.netobs
+        if no is not None:
+            no.on_send(s, size_bytes)
 
         bits = (size_bytes + FRAME_OVERHEAD_BYTES) * 8
         t_dep = src_host.up_bucket.charge(t, bits)
@@ -407,6 +477,8 @@ class CpuEngine:
         if t >= self.bootstrap_end and thresh > 0:
             u = int(rng_mod.rand_u32(self.seed, s | rng_mod.LOSS_STREAM, seq))
             if u < thresh:
+                if no is not None:
+                    no.on_loss(s)
                 src_host.log_buf.append(LogRecord(t, s, d, seq, size_bytes, DROP_LOSS))
                 return seq, None
 
@@ -444,6 +516,11 @@ class CpuEngine:
         seq = host.send_seq
         host.send_seq += 1
         t_deliver = host.now + LOOPBACK_LATENCY_NS
+        no = self.netobs
+        if no is not None:
+            # lo is both halves on one host: a send and a delivery
+            no.on_send(host.host_id, size_bytes)
+            no.on_delivered(host.host_id, size_bytes)
         host.log_buf.append(
             LogRecord(t_deliver, host.host_id, host.host_id, seq,
                       size_bytes, DELIVERED)
@@ -471,11 +548,16 @@ class CpuEngine:
         bits = (size_bytes + FRAME_OVERHEAD_BYTES) * 8
         t_deliver = dst_host.down_bucket.charge(ev.time, bits)
         sojourn = t_deliver - ev.time
+        no = self.netobs
         if dst_host.codel.offer(t_deliver, sojourn):
+            if no is not None:
+                no.on_codel(dst_host.host_id)
             dst_host.log_buf.append(
                 LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DROP_CODEL)
             )
             return
+        if no is not None:
+            no.on_delivered(dst_host.host_id, size_bytes)
         dst_host.log_buf.append(
             LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DELIVERED)
         )
@@ -654,6 +736,10 @@ class CpuEngine:
                     scheduler.run_round(self.window_end)
                     self._barrier_merge()
             self.rounds += 1
+            if self.netobs is not None:
+                # one histogram entry per window (post-barrier, so every
+                # pop of the round has landed)
+                self.netobs.flush_window()
             if obs is not None:
                 m = obs.metrics
                 m.count("windows")

@@ -34,6 +34,8 @@ import multiprocessing as mp
 import os
 import time as wall_time
 
+import numpy as np
+
 from ..config.options import ConfigOptions
 from ..core import time as stime
 from ..core.event import Event, EventKind
@@ -126,6 +128,10 @@ def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                     next_t, outbound, mul,
                     engine.perf_log.drain()
                     if engine.perf_log is not None else (),
+                    # netobs: this round's pop count (the parent owns
+                    # the global window histogram)
+                    engine.netobs.take_round_pops()
+                    if engine.netobs is not None else 0,
                 ))
             elif msg[0] == "finish":
                 engine.finalize()
@@ -138,6 +144,10 @@ def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                     counters,
                     {i: dict(engine.hosts[i].counters) for i in owned},
                     list(getattr(engine, "process_errors", [])),
+                    # netobs per-host arrays: only owned hosts ever
+                    # executed here, so the parent's elementwise sum
+                    # over workers reconstructs the full plane
+                    engine.netobs_snapshot(),
                 ))
                 return
             else:  # pragma: no cover - protocol error
@@ -173,6 +183,14 @@ class MpCpuEngine:
         self.cfg = cfg
         self.workers = workers if workers > 0 else (os.cpu_count() or 1)
         self.workers = max(1, min(self.workers, len(cfg.hosts)))
+        # netobs (obs/netobs.py): the parent owns the global window
+        # histogram and the merged per-host arrays; populated by run()
+        self._netobs = None
+
+    def netobs_snapshot(self):
+        """The merged telemetry snapshot of the last run (None when
+        netobs is off)."""
+        return self._netobs
 
     def run(self) -> SimResult:
         if self.cfg.experimental.perf_logging and self.perf_log is None:
@@ -185,7 +203,9 @@ class MpCpuEngine:
             eng = CpuEngine(self.cfg)
             eng.perf_log = self.perf_log
             eng.obs = self.obs
-            return eng.run()
+            result = eng.run()
+            self._netobs = eng.netobs_snapshot()
+            return result
         # the parent's replica serves the Controller role: initial
         # next-event times, runahead, stop time (no host ever executes
         # here)
@@ -210,6 +230,11 @@ class MpCpuEngine:
             min_used_lat = None
             rounds = 0
             obs = self.obs
+            netobs_on = self.cfg.experimental.netobs
+            if netobs_on:
+                from ..obs import netobs as nom
+
+                window_hist = np.zeros(nom.HIST_BUCKETS, dtype=np.int64)
             while True:
                 start = min(next_times)
                 if start >= stop or start == stime.NEVER:
@@ -224,8 +249,9 @@ class MpCpuEngine:
                     pending[w] = []
                 t_ship = wall_time.perf_counter() if obs is not None else 0.0
                 perf_lines: list[str] = []
+                round_pops = 0
                 for w, conn in enumerate(conns):
-                    next_t, outbound, mul, wlines = conn.recv()
+                    next_t, outbound, mul, wlines, wpops = conn.recv()
                     next_times[w] = next_t
                     if mul is not None and (
                         min_used_lat is None or mul < min_used_lat
@@ -235,6 +261,9 @@ class MpCpuEngine:
                         pending[owner_of[pkt[0]]].append(pkt)
                     if wlines:
                         perf_lines.extend(wlines)
+                    round_pops += wpops
+                if netobs_on and round_pops > 0:
+                    window_hist[nom.hist_bucket(round_pops)] += 1
                 # in-flight cross-partition packets lower the owners'
                 # next-event times before the next window is computed
                 for w in range(self.workers):
@@ -263,16 +292,27 @@ class MpCpuEngine:
             counters: dict[str, int] = {}
             per_host: list[dict] = [{} for _ in range(n)]
             process_errors: list[str] = []
+            nb_arrays = None
             for conn in conns:
                 conn.send(("finish",))
             for conn in conns:
-                log, cnt, per, errs = conn.recv()
+                log, cnt, per, errs, wsnap = conn.recv()
                 event_log.extend(log)
                 for k, v in cnt.items():
                     counters[k] = counters.get(k, 0) + v
                 for hid, c in per.items():
                     per_host[hid] = c
                 process_errors.extend(errs)
+                if wsnap is not None:
+                    if nb_arrays is None:
+                        nb_arrays = nom.empty_arrays(n)
+                    nom.merge_arrays(nb_arrays, wsnap["arrays"])
+            if netobs_on and nb_arrays is not None:
+                self._netobs = {
+                    "arrays": nb_arrays,
+                    "window_hist": window_hist,
+                    "log_lost": 0,
+                }
         finally:
             for conn in conns:
                 conn.close()

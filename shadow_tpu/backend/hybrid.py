@@ -264,6 +264,8 @@ def _hybrid_worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                     counters,
                     {h.host_id: dict(h.counters) for h in engine.owned_hosts},
                     list(getattr(engine, "process_errors", [])),
+                    # netobs host-side arrays (owned hosts only executed)
+                    engine.netobs_snapshot(),
                 ))
                 return
             else:  # pragma: no cover - protocol error
@@ -596,6 +598,30 @@ class HybridEngine(_HostSideHybrid):
             if on_window is not None:
                 on_window(start, end, self.next_event_time())
 
+    def netobs_snapshot(self):
+        """The combined telemetry plane: host-side counters (managed
+        hosts' sends, loopback, throttles) summed with the device-side
+        counters (every dst half, lane-model hosts' sends).  The window
+        histogram is the device's: ALL packet arrivals pop on the lane
+        plane on this backend (``inbound`` asserts host queues never
+        hold PACKET events), so there is no host-plane arrival
+        histogram to report."""
+        host = super().netobs_snapshot()
+        dev = self.device.netobs_snapshot()
+        if host is None or dev is None:
+            return None
+        from ..obs import netobs as nom
+
+        arrays = nom.merge_arrays(
+            {k: v.copy() for k, v in dev["arrays"].items()},
+            host["arrays"],
+        )
+        return {
+            "arrays": arrays,
+            "window_hist": dev["window_hist"],
+            "log_lost": 0,
+        }
+
     def _hybrid_loop(self, scheduler, on_window, t0) -> SimResult:
         state = self._window_loop(
             lambda until: self._service_round(scheduler, until), on_window
@@ -724,6 +750,27 @@ class MpHybridEngine(HybridEngine):
         if self.perf_log is not None:
             self.perf_log.hybrid_agg("host", window_end, self.sync_stats)
 
+    def netobs_snapshot(self):
+        """Worker-merged host arrays + device arrays (the window
+        histogram is the device's — see HybridEngine.netobs_snapshot)."""
+        wnb = getattr(self, "_worker_nb", None)
+        if wnb is None:
+            # serial / degenerate (workers == 1) path ran in-process
+            return super().netobs_snapshot()
+        dev = self.device.netobs_snapshot()
+        if dev is None:
+            return None
+        from ..obs import netobs as nom
+
+        arrays = nom.merge_arrays(
+            {k: v.copy() for k, v in dev["arrays"].items()}, wnb
+        )
+        return {
+            "arrays": arrays,
+            "window_hist": dev["window_hist"],
+            "log_lost": 0,
+        }
+
     # -- run ---------------------------------------------------------------
 
     def run(self, on_window=None) -> SimResult:
@@ -773,16 +820,23 @@ class MpHybridEngine(HybridEngine):
         counters: dict[str, int] = {}
         per_host: list[dict] = [{} for _ in range(len(self.hosts))]
         process_errors: list[str] = []
+        self._worker_nb = None
         for conn in conns:
             conn.send(("finish",))
         for conn in conns:
-            log, cnt, per, errs = conn.recv()
+            log, cnt, per, errs, wsnap = conn.recv()
             event_log.extend(log)
             for k, v in cnt.items():
                 counters[k] = counters.get(k, 0) + v
             for hid, c in per.items():
                 per_host[hid] = c
             process_errors.extend(errs)
+            if wsnap is not None:
+                from ..obs import netobs as nom
+
+                if self._worker_nb is None:
+                    self._worker_nb = nom.empty_arrays(len(self.hosts))
+                nom.merge_arrays(self._worker_nb, wsnap["arrays"])
         wall = wall_time.perf_counter() - t0
 
         dev_result = self.device.collect(state, wall)

@@ -112,6 +112,12 @@ NEVER32 = _pairs.NEVER32
 MASK31 = _pairs.MASK31
 MOD_SMALL_LIMIT = _pairs.MOD_SMALL_LIMIT
 
+# netobs (obs/netobs.py): fixed bucket count of the per-window
+# PACKET-arrival histogram — bucket b holds windows whose popped packet
+# count has floor(log2(count)) == b, the last bucket absorbs the tail.
+# Must match obs.netobs.HIST_BUCKETS (import would cycle).
+NB_HIST_BUCKETS = 24
+
 # pair arithmetic helpers (shared with the stream tier — lanes_pairs.py)
 pair_lt = _pairs.pair_lt
 pair_ge = _pairs.pair_ge
@@ -241,6 +247,19 @@ class LaneState(NamedTuple):
     egress_lost: Any = ()
     egress_min_hi: Any = ()
     egress_min_lo: Any = ()
+    # netobs telemetry block (LaneParams.netobs; obs/netobs.py): per-lane
+    # int32 counters updated inside the already-traced kernels — bytes by
+    # direction, token-bucket throttle events, cross-block sheds — plus
+    # the device-resident per-window packet-arrival histogram and its
+    # running window count.  () when netobs is off: the off path traces
+    # ZERO extra ops (every update is behind `if p.netobs`), so the
+    # compiled program is identical to a pre-netobs build.
+    nb_txb: Any = ()  # [N] int32: bytes offered to the up bucket (sends)
+    nb_rxb: Any = ()  # [N] int32: bytes delivered (post-CoDel)
+    nb_thr: Any = ()  # [N] int32: token-bucket throttle events (up + dn)
+    nb_shed: Any = ()  # [N] int32: cross-block sheds (subset of n_queue)
+    nb_hist: Any = ()  # [NB_HIST_BUCKETS] int32 packet-arrival histogram
+    nb_win: Any = ()  # int32 scalar: packets popped in the current window
 
 
 @dataclasses.dataclass(frozen=True)
@@ -310,6 +329,9 @@ class LaneParams:
     # host CPU while their network dn-side (down bucket, CoDel, arrival
     # queue) stays on device.  Deliveries to external lanes leave through
     # the egress buffer; host sends enter through the injection merge.
+    # netobs telemetry plane (obs/netobs.py): static — off compiles every
+    # counter update away (the LaneState nb_* fields stay ())
+    netobs: bool = False
     external_any: bool = False
     egress_capacity: int = 0  # E (rows in the egress buffer)
     ext_per_iter: int = 0  # worst-case egress appends per iteration
@@ -420,9 +442,12 @@ def bucket_charge_vec(
     t_hi, t_lo, bits, active, interval
 ):
     """Masked PAIR-arithmetic form of TokenBucket.charge; returns
-    (tokens', nr_hi', nr_lo', ld_hi', ld_lo', dep_hi, dep_lo).  Identical
-    update law to net/token_bucket.py, with the elapsed-interval count
-    computed exactly:
+    (tokens', nr_hi', nr_lo', ld_hi', ld_lo', dep_hi, dep_lo, waited).
+    ``waited`` is the THROTTLE mask (active, rate-limited, and tokens
+    short after the refill — the instant the scalar law counts as a
+    throttle event, netobs' token-bucket cause).  Identical update law to
+    net/token_bucket.py, with the elapsed-interval count computed
+    exactly:
 
     - within the k_full horizon (``kfi = k_full * interval`` ns, where
       ``k_full`` intervals always refill to burst) the elapsed count comes
@@ -481,7 +506,7 @@ def bucket_charge_vec(
     nr_lo = jnp.where(wait_lane, nr2_lo, nr_lo)
     ld_hi = jnp.where(act, dep_hi, ld_hi)
     ld_lo = jnp.where(act, dep_lo, ld_lo)
-    return tokens, nr_hi, nr_lo, ld_hi, ld_lo, dep_hi, dep_lo
+    return tokens, nr_hi, nr_lo, ld_hi, ld_lo, dep_hi, dep_lo, wait_lane
 
 
 def bucket_charge_chained_vec(
@@ -523,7 +548,7 @@ def bucket_charge_chained_vec(
     nr_lo = jnp.where(wait_lane, nr2_lo, nr_lo)
     ld_hi = jnp.where(act, dep_hi, ld_hi)
     ld_lo = jnp.where(act, dep_lo, ld_lo)
-    return tokens, nr_hi, nr_lo, ld_hi, ld_lo, dep_hi, dep_lo
+    return tokens, nr_hi, nr_lo, ld_hi, ld_lo, dep_hi, dep_lo, wait_lane
 
 
 # CoDel "first_above" unset sentinel: the int64 law used time 0; with pair
@@ -785,7 +810,8 @@ def _process_slot(
     # ---- PACKET pops: down bucket + CoDel -> DELIVERY self-insert --------
     is_pkt = active & (kind == PACKET)
     bits = (size + FRAME_OVERHEAD_BYTES) * 8  # int32: size <= 64 KiB
-    dn_tokens, dn_nr_hi, dn_nr_lo, dn_ld_hi, dn_ld_lo, td_hi, td_lo = (
+    (dn_tokens, dn_nr_hi, dn_nr_lo, dn_ld_hi, dn_ld_lo, td_hi, td_lo,
+     dn_wait) = (
         bucket_charge_vec(
             s.dn_tokens, s.dn_nr_hi, s.dn_nr_lo, s.dn_ld_hi, s.dn_ld_lo,
             tb.dn_rate, tb.dn_burst, tb.dn_kfull, tb.dn_kfi,
@@ -796,6 +822,8 @@ def _process_slot(
         dn_tokens=dn_tokens, dn_nr_hi=dn_nr_hi, dn_nr_lo=dn_nr_lo,
         dn_ld_hi=dn_ld_hi, dn_ld_lo=dn_ld_lo,
     )
+    if p.netobs:
+        s = s._replace(nb_thr=s.nb_thr + dn_wait)
     # sojourn only feeds compares against TARGET/INTERVAL: the clamp at
     # NEVER32 is exact for every branch of the law
     sojourn = pair_sub_clamp(td_hi, td_lo, thi, tlo, NEVER32)
@@ -806,6 +834,8 @@ def _process_slot(
         n_codel=s.n_codel + (is_pkt & codel_drop),
         n_delivered=s.n_delivered + deliver,
     )
+    if p.netobs:
+        s = s._replace(nb_rxb=s.nb_rxb + jnp.where(deliver, size, 0))
 
     # passive lanes consume the delivery inline (counters only); active
     # lanes get a DELIVERY self-insert keyed by the packet's (src, seq).
@@ -1005,7 +1035,8 @@ def _process_slot(
 
     # up bucket
     out_bits = (out_size + FRAME_OVERHEAD_BYTES) * 8
-    up_tokens, up_nr_hi, up_nr_lo, up_ld_hi, up_ld_lo, dep_hi, dep_lo = (
+    (up_tokens, up_nr_hi, up_nr_lo, up_ld_hi, up_ld_lo, dep_hi, dep_lo,
+     up_wait) = (
         bucket_charge_vec(
             s.up_tokens, s.up_nr_hi, s.up_nr_lo, s.up_ld_hi, s.up_ld_lo,
             tb.up_rate, tb.up_burst, tb.up_kfull, tb.up_kfi,
@@ -1016,6 +1047,11 @@ def _process_slot(
         up_tokens=up_tokens, up_nr_hi=up_nr_hi, up_nr_lo=up_nr_lo,
         up_ld_hi=up_ld_hi, up_ld_lo=up_ld_lo,
     )
+    if p.netobs:
+        s = s._replace(
+            nb_thr=s.nb_thr + up_wait,
+            nb_txb=s.nb_txb + jnp.where(do_send, out_size, 0),
+        )
 
     # loss (bootstrap window is loss-free; loss-free graphs skip the draw)
     my_node = tb.node_of
@@ -1066,20 +1102,27 @@ def _process_slot(
     # slot-0 first, then the burst prefix.  Per-lane counters and bucket
     # state round-trip through one row gather + one write-unique scatter.
     if sp:
-        lane_mat = jnp.stack(
-            [s.up_tokens, s.up_nr_hi, s.up_nr_lo, s.up_ld_hi, s.up_ld_lo,
-             s.send_seq, s.local_seq, s.n_sends, s.n_loss], axis=1
-        )
-        lm = lane_mat[el]  # [2S, 9] row gather
+        lane_cols = [s.up_tokens, s.up_nr_hi, s.up_nr_lo, s.up_ld_hi,
+                     s.up_ld_lo, s.send_seq, s.local_seq, s.n_sends,
+                     s.n_loss]
+        if p.netobs:
+            # the netobs counters round-trip through the same gather /
+            # write-unique scatter as the send bookkeeping
+            lane_cols += [s.nb_txb, s.nb_thr]
+        lane_mat = jnp.stack(lane_cols, axis=1)
+        lm = lane_mat[el]  # [2S, 9(+2)] row gather
         g_tok, g_nrh, g_nrl = lm[:, 0], lm[:, 1], lm[:, 2]
         g_ldh, g_ldl = lm[:, 3], lm[:, 4]
         g_sseq, g_lseq = lm[:, 5], lm[:, 6]
         g_nsend, g_nloss = lm[:, 7], lm[:, 8]
+        if p.netobs:
+            g_txb, g_thr = lm[:, 9], lm[:, 10]
 
         # slot-0 control send
         se_size = sem.send_size
         se_bits = (se_size + FRAME_OVERHEAD_BYTES) * 8
-        g_tok, g_nrh, g_nrl, g_ldh, g_ldl, se_dep_hi, se_dep_lo = (
+        (g_tok, g_nrh, g_nrl, g_ldh, g_ldl, se_dep_hi, se_dep_lo,
+         se_wait) = (
             bucket_charge_vec(
                 g_tok, g_nrh, g_nrl, g_ldh, g_ldl,
                 tb.flow_up_rate, tb.flow_up_burst, tb.flow_up_kfull,
@@ -1090,6 +1133,9 @@ def _process_slot(
         se_seq = g_sseq
         g_sseq = g_sseq + st_send
         g_nsend = g_nsend + st_send
+        if p.netobs:
+            g_txb = g_txb + jnp.where(st_send, se_size, 0)
+            g_thr = g_thr + se_wait
         if p.has_loss:
             bs_hi2, bs_lo2 = p.bootstrap_end >> 31, p.bootstrap_end & MASK31
             e_past_bs = pair_ge(ethi, etlo, bs_hi2, bs_lo2)
@@ -1136,14 +1182,15 @@ def _process_slot(
         cl_lanes_u32 = el[cl_sl].astype(jnp.uint32)
 
         def bstep_body(carry, cols, first: bool):
-            tok, nrh, nrl, ldh, ldl, nloss, mul, sent_before = carry
+            (tok, nrh, nrl, ldh, ldl, nloss, mul, sent_before,
+             btxb, bthr) = carry
             bm, bflags, bunit, back, bsize = cols
             bbits = (bsize + FRAME_OVERHEAD_BYTES) * 8
             if first:
                 # only unit 1 can see a pending refill; later units'
                 # charge clock is last_depart, provably short of
                 # next_refill, so they take the reduced chained law
-                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = (
+                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo, bwait = (
                     bucket_charge_vec(
                         tok, nrh, nrl, ldh, ldl,
                         tb.flow_up_rate[cl_sl], tb.flow_up_burst[cl_sl],
@@ -1152,13 +1199,16 @@ def _process_slot(
                     )
                 )
             else:
-                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = (
+                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo, bwait = (
                     bucket_charge_chained_vec(
                         tok, nrh, nrl, ldh, ldl, tb.flow_up_rate[cl_sl],
                         tb.flow_up_burst[cl_sl], bbits, bm,
                         p.bucket_interval, cthi, ctlo,
                     )
                 )
+            if p.netobs:
+                btxb = btxb + jnp.where(bm, bsize, 0)
+                bthr = bthr + bwait
             bseq = se_seq[cl_sl] + sent_before
             if p.has_loss:
                 bu = rand_u32_lane(
@@ -1185,12 +1235,13 @@ def _process_slot(
                 blost, bdep_hi, bdep_lo,
             )
             return (tok, nrh, nrl, ldh, ldl, nloss, mul,
-                    sent_before + bm), outs
+                    sent_before + bm, btxb, bthr), outs
 
+        zero_c = jnp.zeros(s_flows, dtype=i32)
         carry0 = (
             g_tok[cl_sl], g_nrh[cl_sl], g_nrl[cl_sl], g_ldh[cl_sl],
             g_ldl[cl_sl], g_nloss[cl_sl], s.min_used_lat,
-            st_send[cl_sl].astype(i32),
+            st_send[cl_sl].astype(i32), zero_c, zero_c,
         )
         st_burst_c = jax.tree.map(lambda a: a[:, cl_sl], tuple(st_burst))
         first_cols = jax.tree.map(lambda a: a[0], st_burst_c)
@@ -1208,7 +1259,8 @@ def _process_slot(
             )
         else:
             bouts = jax.tree.map(lambda a0: a0[None], out0)
-        (tok_c, nrh_c, nrl_c, ldh_c, ldl_c, nloss_c, mul, sent_after) = carry
+        (tok_c, nrh_c, nrl_c, ldh_c, ldl_c, nloss_c, mul, sent_after,
+         btxb_c, bthr_c) = carry
         if p.dynamic_runahead:
             s = s._replace(min_used_lat=mul)
         sv_sl = slice(s_flows, s2)
@@ -1225,13 +1277,17 @@ def _process_slot(
         g_nsend = g_nsend + jnp.concatenate(
             [burst_total, jnp.zeros(s_flows, dtype=i32)]
         )
+        if p.netobs:
+            g_txb = g_txb + jnp.concatenate([btxb_c, zero_c])
+            g_thr = g_thr + jnp.concatenate([bthr_c, zero_c])
 
         # write-back: one masked row scatter (at most one endpoint of a
         # lane is stimulated per slot, so indices are write-unique)
-        new_rows = jnp.stack(
-            [g_tok, g_nrh, g_nrl, g_ldh, g_ldl, g_sseq, g_lseq, g_nsend,
-             g_nloss], axis=1
-        )
+        row_cols = [g_tok, g_nrh, g_nrl, g_ldh, g_ldl, g_sseq, g_lseq,
+                    g_nsend, g_nloss]
+        if p.netobs:
+            row_cols += [g_txb, g_thr]
+        new_rows = jnp.stack(row_cols, axis=1)
         sc_idx = jnp.where(stream_stim, el, jnp.int32(n))
         lane_mat = lane_mat.at[sc_idx].set(new_rows, mode="drop")
         s = s._replace(
@@ -1241,6 +1297,8 @@ def _process_slot(
             local_seq=lane_mat[:, 6], n_sends=lane_mat[:, 7],
             n_loss=lane_mat[:, 8],
         )
+        if p.netobs:
+            s = s._replace(nb_txb=lane_mat[:, 9], nb_thr=lane_mat[:, 10])
 
         (bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
          blost_all, bdep_hi_all, bdep_lo_all) = bouts  # [B, S] each
@@ -1667,6 +1725,11 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
         n_queue=s.n_queue + tail_mask.sum(axis=1, dtype=jnp.int32)
         + lost_pre,
     )
+    if p.netobs:
+        # cross-block sheds stay inside n_queue (the strict-mode total)
+        # but carry their own cause counter so the netobs drop taxonomy
+        # can split queue overflow from exchange-width shed
+        s = s._replace(nb_shed=s.nb_shed + lost_pre)
     if sp:
         s = s._replace(q_phi=mphi[:, :c], q_plo=mplo[:, :c])
 
@@ -1904,6 +1967,32 @@ def _queue_min(p: LaneParams, s: LaneState):
     return mh, ml
 
 
+def ilog2_i32(x):
+    """floor(log2(x)) for int32 x >= 1, branch-free (0 for x <= 1)."""
+    x = jnp.asarray(x, dtype=jnp.int32)
+    r = jnp.zeros_like(x)
+    for shift in (16, 8, 4, 2, 1):
+        ge = x >= (1 << shift)
+        x = jnp.where(ge, x >> shift, x)
+        r = r + jnp.where(ge, shift, 0)
+    return r
+
+
+def _flush_hist(p: LaneParams, s: LaneState, enable) -> LaneState:
+    """Fold the running window occupancy (packet arrivals) into the [B]
+    histogram and reset it — called exactly when a NEW window begins
+    (and once more at collect, host-side, for the trailing window).
+    Packet-free windows leave ``nb_win == 0`` and are skipped — on both
+    backends identically, so the histogram stays bit-comparable."""
+    do = enable & (s.nb_win > 0)
+    bucket = jnp.minimum(ilog2_i32(s.nb_win), NB_HIST_BUCKETS - 1)
+    idx = jnp.where(do, bucket, NB_HIST_BUCKETS)
+    return s._replace(
+        nb_hist=s.nb_hist.at[idx].add(1, mode="drop"),
+        nb_win=jnp.where(do, 0, s.nb_win),
+    )
+
+
 def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
                       we_hi, we_lo, tier_cross) -> LaneState:
     """One iteration of the TIERED stream backend: pop ≤K_s events per
@@ -1952,6 +2041,14 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
         prefix = same_t & pkt_prefix
     allowed = prefix | first_col
     act_b = allowed & pair_lt(thi_b, tlo_b, we_hi, we_lo)
+    if p.netobs:
+        # tier PACKET pops join the window occupancy count ([N] pops are
+        # added by iter_body; wire arrivals are the one event class whose
+        # per-window counts are bit-identical across backends)
+        s = s._replace(
+            nb_win=s.nb_win
+            + (act_b & (kind_cols == PACKET)).sum(dtype=i32)
+        )
     q = q.at[lstr.TQ_THI, :, :k].set(jnp.where(act_b, NEVER32, thi_b))
     q = q.at[lstr.TQ_TLO, :, :k].set(jnp.where(act_b, NEVER32, tlo_b))
 
@@ -1987,7 +2084,7 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
         # -- PACKET: dn bucket + CoDel on compact rows ---------------------
         is_pkt = act & (kind == PACKET)
         bits = (size + FRAME_OVERHEAD_BYTES) * 8
-        (dn_tok, dn_nrh, dn_nrl, dn_ldh, dn_ldl, td_hi, td_lo) = (
+        (dn_tok, dn_nrh, dn_nrl, dn_ldh, dn_ldl, td_hi, td_lo, dn_wait) = (
             bucket_charge_vec(
                 v[lstr.TV_DN_TOK], v[lstr.TV_DN_NRH], v[lstr.TV_DN_NRL],
                 v[lstr.TV_DN_LDH], v[lstr.TV_DN_LDL],
@@ -2018,6 +2115,9 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
         v = v.at[lstr.TV_CD_DROP].set(cd_drop_state.astype(i32))
         v = v.at[lstr.TV_N_DEL].add(deliver)
         v = v.at[lstr.TV_N_CODEL].add(is_pkt & codel_drop)
+        if p.netobs:
+            v = v.at[lstr.TV_NB_RXB].add(jnp.where(deliver, size, 0))
+            v = v.at[lstr.TV_NB_THR].add(dn_wait)
 
         # -- delivery elision gate ----------------------------------------
         # elide only under the wide-pop guarantee (window < RTO_MIN): it
@@ -2065,7 +2165,8 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
         # -- slot-0 control send (up bucket, loss, arrival) ---------------
         se_size = sem.send_size
         se_bits = (se_size + FRAME_OVERHEAD_BYTES) * 8
-        (up_tok, up_nrh, up_nrl, up_ldh, up_ldl, se_dep_hi, se_dep_lo) = (
+        (up_tok, up_nrh, up_nrl, up_ldh, up_ldl, se_dep_hi, se_dep_lo,
+         se_wait) = (
             bucket_charge_vec(
                 v[lstr.TV_UP_TOK], v[lstr.TV_UP_NRH], v[lstr.TV_UP_NRL],
                 v[lstr.TV_UP_LDH], v[lstr.TV_UP_LDL],
@@ -2110,11 +2211,12 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
         cl_lanes_u32 = el[cl_sl].astype(jnp.uint32)
 
         def bstep(carry, cols, first: bool):
-            tok, nrh, nrl, ldh, ldl, nloss, mu, sent_before = carry
+            (tok, nrh, nrl, ldh, ldl, nloss, mu, sent_before,
+             btxb, bthr) = carry
             bm, bflags, bunit, back, bsize = cols
             bbits = (bsize + FRAME_OVERHEAD_BYTES) * 8
             if first:
-                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = (
+                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo, bwait = (
                     bucket_charge_vec(
                         tok, nrh, nrl, ldh, ldl,
                         tb.flow_up_rate[cl_sl], tb.flow_up_burst[cl_sl],
@@ -2123,13 +2225,16 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
                     )
                 )
             else:
-                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo = (
+                tok, nrh, nrl, ldh, ldl, bdep_hi, bdep_lo, bwait = (
                     bucket_charge_chained_vec(
                         tok, nrh, nrl, ldh, ldl, tb.flow_up_rate[cl_sl],
                         tb.flow_up_burst[cl_sl], bbits, bm,
                         p.bucket_interval, cthi, ctlo,
                     )
                 )
+            if p.netobs:
+                btxb = btxb + jnp.where(bm, bsize, 0)
+                bthr = bthr + bwait
             bseq = se_seq[cl_sl] + sent_before
             if p.has_loss:
                 bu = rand_u32_lane(
@@ -2156,13 +2261,14 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
                 blost, bdep_hi, bdep_lo,
             )
             return (tok, nrh, nrl, ldh, ldl, nloss, mu,
-                    sent_before + bm), outs
+                    sent_before + bm, btxb, bthr), outs
 
         up_nloss = v[lstr.TV_N_LOSS] + se_lost
+        zero_cc = jnp.zeros(s_flows, dtype=i32)
         carry0 = (
             up_tok[cl_sl], up_nrh[cl_sl], up_nrl[cl_sl], up_ldh[cl_sl],
             up_ldl[cl_sl], up_nloss[cl_sl], mul,
-            st_send[cl_sl].astype(i32),
+            st_send[cl_sl].astype(i32), zero_cc, zero_cc,
         )
         st_burst_c = jax.tree.map(lambda a: a[:, cl_sl], tuple(st_burst))
         first_cols = jax.tree.map(lambda a: a[0], st_burst_c)
@@ -2179,7 +2285,8 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
             )
         else:
             bouts = jax.tree.map(lambda a0: a0[None], out0)
-        (tok_c, nrh_c, nrl_c, ldh_c, ldl_c, nloss_c, mul, sent_after) = carry
+        (tok_c, nrh_c, nrl_c, ldh_c, ldl_c, nloss_c, mul, sent_after,
+         btxb_c, bthr_c) = carry
         burst_total = sent_after - st_send[cl_sl].astype(i32)
         pad_c = jnp.zeros(s_flows, dtype=i32)
 
@@ -2200,6 +2307,12 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
         v = v.at[lstr.TV_N_SENDS].add(
             st_send + jnp.concatenate([burst_total, pad_c]))
         v = v.at[lstr.TV_LOCAL_SEQ].add(sa_valid)
+        if p.netobs:
+            v = v.at[lstr.TV_NB_TXB].add(
+                jnp.where(st_send, se_size, 0)
+                + jnp.concatenate([btxb_c, pad_c]))
+            v = v.at[lstr.TV_NB_THR].add(
+                se_wait + jnp.concatenate([bthr_c, pad_c]))
 
         (bo_valid, bo_thi, bo_tlo, bo_auxl, bo_size, bo_phi, bo_plo,
          blost_all, bdep_hi_all, bdep_lo_all) = bouts
@@ -2550,6 +2663,17 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
             q_thi=s.q_thi.at[:, :k].set(jnp.where(consumed, NEVER32, thi)),
             q_tlo=s.q_tlo.at[:, :k].set(jnp.where(consumed, NEVER32, tlo)),
         )
+        if p.netobs:
+            # PACKET pops this iteration join the running window
+            # occupancy (flushed into nb_hist when the window advances —
+            # the burst-window evidence of docs/observability.md).
+            # Packets only: wire arrivals are bit-identical across
+            # backends, while LOCAL/DELIVERY decomposition is not (start
+            # anchors, delivery elision)
+            s = s._replace(
+                nb_win=s.nb_win
+                + (consumed & (kind_cols == PACKET)).sum(dtype=jnp.int32)
+            )
 
         # the stream tier's slot body is large: inlining it per slot blows
         # up XLA:CPU compile time, so slot-level conds stay there.  On the
@@ -2788,6 +2912,10 @@ def _build_round(p: LaneParams, tb: LaneTables):
         # rows sorted: col 0 is each queue's min; lexicographic pair min
         start = t_join(*_queue_min(p, s))
         done = start >= p.stop_time
+        if p.netobs:
+            # a live round IS a new window: flush the previous round's
+            # occupancy (the trailing window flushes at collect)
+            s = _flush_hist(p, s, ~done)
         window_end = jnp.minimum(
             start + _effective_runahead(p, s), p.stop_time
         )
@@ -2839,6 +2967,11 @@ _SCALAR_FIELDS = ("log_count", "log_lost", "rounds", "iters", "now_we_hi", "now_
 # hybrid-backend scalar extension (present only when egress is live)
 _EG_SCALARS = ("egress_count", "egress_lost", "egress_min_hi",
                "egress_min_lo")
+# netobs extension (present only when LaneParams.netobs): [N] counters
+# ride the c32 stack after cd_dropping, the window count rides the
+# scalar vector, and the [B] histogram is its own carry leaf
+_NB_N_FIELDS = ("nb_txb", "nb_rxb", "nb_thr", "nb_shed")
+_NB_SCALARS = ("nb_win",)
 
 
 def pack_state(s: LaneState):
@@ -2847,31 +2980,51 @@ def pack_state(s: LaneState):
     if has_pay:
         q_cols += [s.q_phi, s.q_plo]
     q = jnp.stack(q_cols)
+    has_nb = not isinstance(s.nb_txb, tuple)
+    nb_fields = _NB_N_FIELDS if has_nb else ()
     c32 = jnp.stack(
         [getattr(s, f) for f in _I32_N_FIELDS]
         + [s.cd_dropping.astype(jnp.int32)]
+        + [getattr(s, f) for f in nb_fields]
     )
     has_eg = not isinstance(s.egress, tuple)
-    sc_fields = _SCALAR_FIELDS + (_EG_SCALARS if has_eg else ())
+    sc_fields = (
+        _SCALAR_FIELDS
+        + (_EG_SCALARS if has_eg else ())
+        + (_NB_SCALARS if has_nb else ())
+    )
     sc = jnp.stack(
         [jnp.asarray(getattr(s, f), dtype=jnp.int32) for f in sc_fields]
     )
-    return (q, c32, sc, s.log, s.stream, s.egress)
+    return (q, c32, sc, s.log, s.stream, s.egress, s.nb_hist)
 
 
 def unpack_state(carry) -> LaneState:
-    q, c32, sc, log, stream, egress = carry
+    q, c32, sc, log, stream, egress, nb_hist = carry
     has_pay = q.shape[0] == 7
-    has_eg = sc.shape[0] > len(_SCALAR_FIELDS)
+    # extras beyond the base scalar vector disambiguate which optional
+    # blocks are live: egress adds 4 scalars, netobs adds 1
+    extra = sc.shape[0] - len(_SCALAR_FIELDS)
+    has_eg = extra >= 4
+    has_nb = extra in (1, 5)
     kw = {f: c32[i] for i, f in enumerate(_I32_N_FIELDS)}
-    sc_fields = _SCALAR_FIELDS + (_EG_SCALARS if has_eg else ())
+    n_base = len(_I32_N_FIELDS) + 1  # + cd_dropping
+    if has_nb:
+        kw.update({
+            f: c32[n_base + i] for i, f in enumerate(_NB_N_FIELDS)
+        })
+    sc_fields = (
+        _SCALAR_FIELDS
+        + (_EG_SCALARS if has_eg else ())
+        + (_NB_SCALARS if has_nb else ())
+    )
     kw.update({f: sc[i] for i, f in enumerate(sc_fields)})
     return LaneState(
         q_thi=q[0], q_tlo=q[1], q_auxh=q[2], q_auxl=q[3], q_size=q[4],
         q_phi=q[5] if has_pay else (), q_plo=q[6] if has_pay else (),
         stream=stream,
         cd_dropping=c32[len(_I32_N_FIELDS)].astype(bool),
-        log=log, egress=egress, **kw,
+        log=log, egress=egress, nb_hist=nb_hist, **kw,
     )
 
 
@@ -2903,6 +3056,9 @@ def _build_full_run(p: LaneParams, tb: LaneTables):
             mn_hi, mn_lo = _queue_min(p, st)
             live = pair_lt(mn_hi, mn_lo, stop_hi, stop_lo)
             fresh = pair_ge(mn_hi, mn_lo, st.now_we_hi, st.now_we_lo) & live
+            if p.netobs:
+                # window advance: flush the finished window's occupancy
+                st = _flush_hist(p, st, fresh)
             # clamp before adding runahead: min_next may be the NEVER pair
             # on a no-op trailing step
             c_hi, c_lo = pair_sel(
@@ -2998,6 +3154,8 @@ def _inject_merge(p: LaneParams, tb: LaneTables, s: LaneState, inj):
             is_stable=False,
         )
     tail = (mthi[:, c:] != NEVER32).sum(axis=1, dtype=jnp.int32)
+    if p.netobs:
+        s = s._replace(nb_shed=s.nb_shed + lost_pre)
     return s._replace(
         q_thi=mthi[:, :c], q_tlo=mtlo[:, :c], q_auxh=mh[:, :c],
         q_auxl=ml[:, :c], q_size=ms[:, :c],
@@ -3069,6 +3227,8 @@ def _build_hybrid_run(p: LaneParams, tb: LaneTables):
             )
             live = pair_lt(mn_hi, mn_lo, stop_hi, stop_lo)
             fresh = pair_ge(mn_hi, mn_lo, st.now_we_hi, st.now_we_lo) & live
+            if p.netobs:
+                st = _flush_hist(p, st, fresh)
             c_hi, c_lo = pair_sel(live, mn_hi, mn_lo, stop_hi, stop_lo)
             c_hi, c_lo = pair_add32(c_hi, c_lo, _effective_runahead(p, st))
             c_hi, c_lo = pair_sel(

@@ -883,13 +883,18 @@ def endpoint_split(f: FlowCols) -> StreamState:
 # bijection with endpoint rows.
 # --------------------------------------------------------------------------
 
-# row indices of the packed [TV_COUNT, 2S] int32 tier vector matrix
+# row indices of the packed [TV_COUNT, 2S] int32 tier vector matrix.
+# The trailing TV_NB_* rows are the netobs telemetry block (tx/rx bytes
+# and token-bucket throttle events per endpoint, docs/observability.md):
+# always allocated (the packed matrix keeps the while carry flat) but
+# written only when LaneParams.netobs is on — off, they stay the zeros
+# they were initialized to and XLA carries them untouched.
 (TV_DN_TOK, TV_DN_NRH, TV_DN_NRL, TV_DN_LDH, TV_DN_LDL,
  TV_CD_FATH, TV_CD_FATL, TV_CD_DNH, TV_CD_DNL, TV_CD_CNT, TV_CD_DROP,
  TV_UP_TOK, TV_UP_NRH, TV_UP_NRL, TV_UP_LDH, TV_UP_LDL,
  TV_SEND_SEQ, TV_LOCAL_SEQ, TV_N_SENDS, TV_N_LOSS, TV_N_DEL, TV_N_CODEL,
- TV_N_QUEUE) = range(23)
-TV_COUNT = 23
+ TV_N_QUEUE, TV_NB_TXB, TV_NB_RXB, TV_NB_THR) = range(26)
+TV_COUNT = 26
 
 
 class TierState(NamedTuple):

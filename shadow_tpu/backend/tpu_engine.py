@@ -56,6 +56,7 @@ class TpuEngine:
         external=None,
         inject_batch: Optional[int] = None,
         world=None,
+        netobs: Optional[bool] = None,
     ) -> None:
         """``external``: optional [N] bool mask — marked hosts are
         EXTERNAL (hybrid backend, backend/hybrid.py): their apps run on
@@ -69,6 +70,12 @@ class TpuEngine:
         cfg.validate()
         self.cfg = cfg
         self.strict_capacity = strict_capacity
+        if netobs is None:
+            netobs = cfg.experimental.netobs
+        self._netobs_on = bool(netobs)
+        # populated by collect() when netobs is on: the device-side
+        # telemetry snapshot (obs/netobs.py array schema)
+        self._netobs_data = None
         if inject_batch is None:
             inject_batch = cfg.experimental.tpu_inject_batch
         n = len(cfg.hosts)
@@ -356,6 +363,7 @@ class TpuEngine:
             stream_tiered=tiered,
             stream_pops=cfg.experimental.tpu_stream_events_per_round,
             stream_capacity=cfg.experimental.tpu_stream_queue_capacity,
+            netobs=self._netobs_on,
             external_any=bool(ext_mask.any()),
             # worst case: every external lane pops a full slot row of
             # packets in one iteration; the egress buffer keeps at least
@@ -742,6 +750,15 @@ class TpuEngine:
             egress_lost=jnp.int32(0) if p.external_any else (),
             egress_min_hi=jnp.int32(lanes.NEVER32) if p.external_any else (),
             egress_min_lo=jnp.int32(lanes.NEVER32) if p.external_any else (),
+            nb_txb=jnp.asarray(z32) if p.netobs else (),
+            nb_rxb=jnp.asarray(z32) if p.netobs else (),
+            nb_thr=jnp.asarray(z32) if p.netobs else (),
+            nb_shed=jnp.asarray(z32) if p.netobs else (),
+            nb_hist=(
+                jnp.zeros(lanes.NB_HIST_BUCKETS, dtype=i32)
+                if p.netobs else ()
+            ),
+            nb_win=jnp.int32(0) if p.netobs else (),
         )
 
     # -- running -----------------------------------------------------------
@@ -1018,8 +1035,11 @@ class TpuEngine:
         # wrap past 2**31 shows as a negative value — raise instead of
         # reporting garbage (2e9 events per lane is unreachable in any
         # realistic run)
-        for fname in ("send_seq", "local_seq", "n_delivered", "n_sends",
-                      "recv_bytes", "m_peer_offset"):
+        wrap_check = ["send_seq", "local_seq", "n_delivered", "n_sends",
+                      "recv_bytes", "m_peer_offset"]
+        if self.params.netobs:
+            wrap_check += ["nb_txb", "nb_rxb", "nb_thr"]
+        for fname in wrap_check:
             if int(np.asarray(getattr(s, fname)).min(initial=0)) < 0:
                 raise RuntimeError(
                     f"lane counter {fname} wrapped past 2**31; this run "
@@ -1052,6 +1072,13 @@ class TpuEngine:
         log_count = int(s.log_count)
         log_lost = int(s.log_lost)
         if log_lost:
+            # surface the overflow as a metrics-registry counter BEFORE
+            # raising: failed runs still flush partial obs artifacts
+            # (engine/sim.py's finally), so the loss is machine-visible
+            # in METRICS_*.json instead of only a crash string
+            if self.obs is not None:
+                self.obs.metrics.count("device_log_lost", log_lost)
+                self.obs.metrics.gauge("device_log_overflowed", True)
             raise RuntimeError(
                 f"device event log overflowed ({log_lost} records lost); "
                 "raise log_capacity or disable logging"
@@ -1114,6 +1141,9 @@ class TpuEngine:
                 int((sv_m[:, lstr_mod.C_COMPLETED] != 0).sum()),
             )
 
+        if self.params.netobs:
+            self._netobs_data = self._netobs_collect(s, tv)
+
         return SimResult(
             sim_time_ns=self.params.stop_time,
             wall_seconds=wall,
@@ -1122,3 +1152,87 @@ class TpuEngine:
             counters=counters,
             per_host_counters=[],
         )
+
+    # -- netobs telemetry plane (obs/netobs.py) ----------------------------
+
+    def _netobs_collect(self, s: lanes.LaneState, tv) -> dict:
+        """Fold the device-resident telemetry block into the canonical
+        per-host array schema (obs.netobs).  Piggybacks the collect
+        readback — no extra device sync beyond the arrays already
+        fetched at end-of-run."""
+        from ..obs import netobs as nom
+
+        n = self.params.n_lanes
+
+        def fold(lane_arr, tv_row=None):
+            out = np.asarray(lane_arr).astype(np.int64).copy()
+            if tv is not None and tv_row is not None:
+                # tier rows are per endpoint; scatter-add back to lanes
+                np.add.at(out, self._el_np, tv[tv_row].astype(np.int64))
+            return out
+
+        from . import lanes_stream as lstr
+
+        arrays = {
+            "sent": fold(s.n_sends, lstr.TV_N_SENDS),
+            "delivered": fold(s.n_delivered, lstr.TV_N_DEL),
+            "tx_bytes": fold(s.nb_txb, lstr.TV_NB_TXB),
+            "rx_bytes": fold(s.nb_rxb, lstr.TV_NB_RXB),
+            "drop_loss": fold(s.n_loss, lstr.TV_N_LOSS),
+            "drop_codel": fold(s.n_codel, lstr.TV_N_CODEL),
+            "drop_queue": fold(s.n_queue, lstr.TV_N_QUEUE)
+            - np.asarray(s.nb_shed).astype(np.int64),
+            "drop_cross_shed": fold(s.nb_shed),
+            "throttled": fold(s.nb_thr, lstr.TV_NB_THR),
+            "retransmits": np.zeros(n, dtype=np.int64),
+            "retry_giveup": np.zeros(n, dtype=np.int64),
+        }
+        if self.params.stream_present:
+            # retransmit attribution mirrors the CPU _track: counted at
+            # the CLIENT lane, for completed flows only
+            flows = (
+                s.stream.flows if self.params.stream_tiered else s.stream
+            )
+            cl_m = np.asarray(flows.cl)
+            done = cl_m[:, lstr.C_COMPLETED] != 0
+            cl_lanes = np.asarray(self.params.stream_clients, dtype=np.int64)
+            if cl_lanes.size:
+                np.add.at(
+                    arrays["retransmits"], cl_lanes,
+                    np.where(done, cl_m[:, lstr.C_RETRANS], 0).astype(
+                        np.int64
+                    ),
+                )
+        hist = np.asarray(s.nb_hist).astype(np.int64).copy()
+        # trailing window: its occupancy was never followed by a window
+        # advance, so flush it here (host-side, same bucket law)
+        tail = int(s.nb_win)
+        if tail > 0:
+            hist[nom.hist_bucket(tail)] += 1
+        return {"arrays": arrays, "window_hist": hist, "log_lost": 0}
+
+    def netobs_snapshot(self):
+        """The device telemetry snapshot of the last collected run (None
+        when netobs is off or no run has completed)."""
+        return self._netobs_data
+
+    def netobs_lines(self, host: Optional[str] = None) -> list[str]:
+        """Run-control ``netstats`` answer: summarize the LIVE device
+        counters (step driver — ``_live_state`` is refreshed per round;
+        reading it here is a snapshot-epoch fetch, not a new per-window
+        sync)."""
+        from ..obs import netobs as nom
+
+        if not self.params.netobs:
+            return ["netobs is not enabled (set experimental.netobs)"]
+        state = getattr(self, "_live_state", None)
+        if state is None:
+            return ["no live device state yet (step driver only)"]
+        tv = (
+            np.asarray(state.stream.v)
+            if self.params.stream_tiered else None
+        )
+        snap = self._netobs_collect(state, tv)
+        names = [h.hostname for h in self.cfg.hosts]
+        return nom.snapshot_lines(snap["arrays"], snap["window_hist"],
+                                  names, host)

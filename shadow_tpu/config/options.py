@@ -105,6 +105,14 @@ class ExperimentalOptions:
     obs_jsonl: bool = False
     obs_jax_annotations: bool = False
     obs_dir: Optional[str] = None  # None = general.data_directory
+    # simulated-network telemetry plane (obs/netobs.py): per-host
+    # sent/delivered/bytes counters, drop-cause accounting, and the
+    # burst-window histogram, exported as NETOBS_<backend>-seed<N>.json.
+    # Device-side the counters live in the lane kernels (zero new
+    # host<->device syncs; LaneParams.netobs compiles them away when
+    # off); the CPU oracle accumulates the identical counters so the
+    # parity suite can diff them per host
+    netobs: bool = False
     # --- TPU-native extensions -------------------------------------------
     network_backend: str = "cpu"  # "cpu" | "tpu"
     tpu_lane_queue_capacity: int = 64  # per-host in-flight packet slots

@@ -23,6 +23,9 @@ Observability extensions (shadow_tpu/obs/, docs/observability.md):
 
 - ``stats``          print a live metrics snapshot (phase walls,
   counters, gauges) at the current window boundary
+- ``netstats [host]``  print the simulated-network telemetry snapshot
+  (per-host counters, drop causes, burst-window histogram — the netobs
+  plane of obs/netobs.py); with a hostname, that host's counter row too
 - ``trace``          tracer status; ``trace on|off`` toggles recording;
   ``trace dump [path]`` exports the Chrome trace collected so far
 
@@ -108,6 +111,9 @@ class RunControl:
         # obs seam (engine/sim.py wires the run's Recorder): the
         # stats/trace console verbs answer from it at window boundaries
         self._obs = None
+        # netobs seam: `netstats [host]` answers from the engine's live
+        # network-telemetry counters (obs/netobs.py)
+        self._netobs_sink: Optional[Callable[[Optional[str]], list[str]]] = None
 
     # -- command input -----------------------------------------------------
 
@@ -125,6 +131,13 @@ class RunControl:
         """Register the run's obs Recorder (shadow_tpu/obs/) so the
         ``stats`` / ``trace`` verbs can answer from live state."""
         self._obs = obs
+
+    def set_netobs_sink(
+        self, sink: Callable[[Optional[str]], list[str]]
+    ) -> None:
+        """Register the engine's network-telemetry snapshot callback:
+        ``sink(host_or_None)`` returns the ``netstats`` answer lines."""
+        self._netobs_sink = sink
 
     def start_stdin_thread(self) -> None:
         """Read commands from stdin on a daemon thread (interactive use)."""
@@ -207,7 +220,7 @@ class RunControl:
             f"[run-control] paused at window boundary: sim-time "
             f"{stime.fmt(window_end)} (next event {stime.fmt(next_event_time)}); "
             "commands: c / cN / n / s / s:<pid> / r / rN / stats / "
-            "trace ... / fault ... / failover"
+            "netstats [host] / trace ... / fault ... / failover"
         )
         self._print_info()
         # soft-wait: block until a resuming command arrives
@@ -277,6 +290,9 @@ class RunControl:
         if cmd == "stats":
             self._cmd_stats()
             return False
+        if cmd == "netstats" or cmd.startswith("netstats "):
+            self._cmd_netstats(cmd.split()[1:])
+            return False
         if cmd == "trace" or cmd.startswith("trace "):
             self._cmd_trace(cmd.split()[1:])
             return False
@@ -309,6 +325,21 @@ class RunControl:
             return
         self._print("[run-control] stats:")
         for line in self._obs.metrics.snapshot_lines():
+            self._print(f"[run-control]   {line}")
+
+    def _cmd_netstats(self, tokens: list[str]) -> None:
+        """``netstats [host]``: the simulated-network telemetry snapshot
+        (obs/netobs.py) — totals, drop causes, window histogram, and one
+        host's counter row when a hostname is given."""
+        if self._netobs_sink is None:
+            self._print(
+                "[run-control] netobs is not enabled on this backend "
+                "(set experimental.netobs)"
+            )
+            return
+        host = tokens[0] if tokens else None
+        self._print("[run-control] netstats:")
+        for line in self._netobs_sink(host):
             self._print(f"[run-control]   {line}")
 
     def _cmd_trace(self, tokens: list[str]) -> None:

@@ -83,7 +83,10 @@ class Simulation:
         """Build the run's obs Recorder from ``experimental.obs_*``
         (None = everything off = zero engine overhead)."""
         exp = self.cfg.experimental
-        if not (exp.obs_metrics or exp.obs_trace or exp.obs_jsonl):
+        if not (exp.obs_metrics or exp.obs_trace or exp.obs_jsonl
+                or exp.netobs):
+            # netobs implies a Recorder: the NETOBS_*.json artifact rides
+            # the same run-id/out-dir lifecycle as METRICS_*.json
             return None
         from ..obs import Recorder
 
@@ -159,6 +162,7 @@ class Simulation:
             sync = getattr(self.engine, "sync_stats", None)
             if sync is not None:
                 extra["hybrid_sync"] = dict(sync)
+            self._write_netobs(extra)
             fin = self.obs.finalize(extra=extra)
             for k in ("metrics_path", "trace_path"):
                 if k in fin:
@@ -166,6 +170,46 @@ class Simulation:
         if write_data:
             self._write_data(result, total)
         return result
+
+    def _write_netobs(self, extra: dict) -> None:
+        """Write the NETOBS_<run_id>.json telemetry artifact through the
+        Recorder lifecycle (docs/observability.md) and fold the totals
+        into the metrics registry so the ``stats`` verb and the METRICS
+        report carry the network counters too."""
+        cfg = self.cfg
+        snap_fn = getattr(self.engine, "netobs_snapshot", None)
+        if not cfg.experimental.netobs or snap_fn is None:
+            return
+        snap = snap_fn()
+        if snap is None:
+            return
+        from ..obs import netobs as nom
+
+        names = [h.hostname for h in cfg.hosts]
+        report = nom.build_report(
+            self.obs.run_id,
+            cfg.experimental.network_backend,
+            cfg.general.seed,
+            names,
+            snap["arrays"],
+            snap["window_hist"],
+            host_window_hist=snap.get("host_window_hist"),
+            log_lost=snap.get("log_lost", 0),
+        )
+        if self.obs.out_dir is not None:
+            path = nom.write_report(
+                self.obs.out_dir / f"NETOBS_{self.obs.run_id}.json", report
+            )
+            log.info("obs artifact: %s", path)
+        m = self.obs.metrics
+        for k, v in report["totals"].items():
+            if v:
+                m.count(f"net_{k}", v)
+        extra["netobs"] = {
+            "drops_by_cause": report["drops_by_cause"],
+            "drop_total": report["drop_total"],
+            "windows": report["window_hist"]["windows"],
+        }
 
     def _make_on_window(self, describe_source, runahead, t0: float):
         """Compose the per-round callback: heartbeat lines + run-control
@@ -252,6 +296,9 @@ class Simulation:
             # window boundary (cpu backend only: the device program's
             # tables are baked per epoch and cannot take ad-hoc edits)
             self.run_control.set_fault_sink(engine.console_fault_sink)
+            if engine.netobs is not None:
+                # the `netstats [host]` verb answers from live counters
+                self.run_control.set_netobs_sink(engine.netobs_lines)
         if self.cfg.experimental.perf_logging:
             engine.perf_log = PerfLog()
         engine.obs = self.obs
@@ -313,10 +360,19 @@ class Simulation:
             )
             return engine.run(on_window=on_window)
 
-        engine = self.engine = TpuEngine(self.cfg)
-        engine.obs = self.obs
         mesh_shape = self.cfg.experimental.tpu_mesh_shape
-        if mesh_shape is not None and len(mesh_shape) == 1 and mesh_shape[0] > 1:
+        multi_mesh = (
+            mesh_shape is not None and len(mesh_shape) == 1
+            and mesh_shape[0] > 1
+        )
+        engine = self.engine = TpuEngine(
+            self.cfg,
+            # netobs is single-device only for now: the window histogram
+            # and counter flush live in the unsharded collect path
+            netobs=False if multi_mesh else None,
+        )
+        engine.obs = self.obs
+        if multi_mesh:
             if self.cfg.faults.events:
                 raise LaneCompatError(
                     "fault schedules are not supported on the sharded-mesh "
@@ -331,12 +387,13 @@ class Simulation:
                 self.run_control is not None
                 or self.cfg.experimental.perf_logging
                 or self.obs is not None
+                or self.cfg.experimental.netobs
             ):
                 log.warning(
-                    "run-control / perf-logging / obs spans are not "
-                    "supported on the sharded-mesh driver (fused on-device "
-                    "loop); running without them — drop tpu_mesh_shape to "
-                    "use them"
+                    "run-control / perf-logging / obs spans / netobs are "
+                    "not supported on the sharded-mesh driver (fused "
+                    "on-device loop); running without them — drop "
+                    "tpu_mesh_shape to use them"
                 )
 
             mesh = parallel.make_mesh(mesh_shape[0])
@@ -356,6 +413,10 @@ class Simulation:
             # the `failover` console verb is live on the pausable tpu
             # driver: it unwinds a FailoverRequest to the guarded caller
             self.run_control.failover_armed = True
+            if self.cfg.experimental.netobs:
+                # `netstats` reads the live device counters at a paused
+                # boundary (a snapshot epoch, not a new per-window sync)
+                self.run_control.set_netobs_sink(engine.netobs_lines)
         if self.cfg.experimental.perf_logging:
             engine.perf_log = PerfLog()
         return engine.run(mode="step", on_window=on_window)

@@ -44,6 +44,11 @@ class TokenBucket:
     tokens: int = -1  # set to burst in __post_init__
     next_refill: int = -1
     last_depart: int = 0
+    # telemetry: charges that had to wait for a refill (tokens short
+    # after the refill step) — the netobs "throttled" cause.  A pure
+    # function of the charge sequence, so it is deterministic and the
+    # lane kernels' wait mask counts the identical instants.
+    throttles: int = 0
 
     def __post_init__(self) -> None:
         if self.tokens < 0:
@@ -70,6 +75,7 @@ class TokenBucket:
             self.tokens -= bits
             self.last_depart = t
             return t
+        self.throttles += 1
         need = bits - self.tokens
         w = -(-need // self.rate)  # ceil
         depart = self.next_refill + (w - 1) * self.interval

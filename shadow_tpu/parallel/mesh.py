@@ -32,7 +32,10 @@ HOST_AXIS = "hosts"
 # collectives for the lane-indexed gathers/scatters at the tier boundary
 _REPLICATED_FIELDS = frozenset(
     ("log", "log_count", "log_lost", "rounds", "iters", "now_we_hi", "now_we_lo",
-     "min_used_lat", "stream")
+     "min_used_lat", "stream",
+     # netobs scalars/histogram (the sharded driver runs netobs-off —
+     # engine/sim.py gates it — but the sharding pytree stays total)
+     "nb_hist", "nb_win")
 )
 
 
