@@ -6,7 +6,7 @@
 # CTest gate (src/test/determinism/CMakeLists.txt).
 
 .PHONY: test gate native smoke-faults smoke-examples lint-determinism \
-	bench-hybrid obs-smoke netobs-smoke bench-report
+	bench-hybrid obs-smoke netobs-smoke turns-smoke bench-report
 
 test: native
 	python -m pytest tests/ -q
@@ -23,6 +23,7 @@ gate: native lint-determinism
 	$(MAKE) smoke-examples
 	$(MAKE) obs-smoke
 	$(MAKE) netobs-smoke
+	$(MAKE) turns-smoke
 
 # The hybrid backend's short deterministic benchmark (one JSON line):
 # the relay-chain scenario scaled down to CI size, syscall plane on 2
@@ -67,6 +68,14 @@ obs-smoke:
 # sent == delivered + drops conservation (docs/observability.md).
 netobs-smoke:
 	JAX_PLATFORMS=cpu python scripts/netobs_smoke.py
+
+# Device-turn-ledger smoke for the gate: a gate-scale managed hybrid run
+# (relay chains, 2 syscall workers, CPU-JAX lanes) with --obs-turns
+# semantics, asserting a valid TURNS_*.json artifact, the
+# turns == sum(cause_counts) conservation law, and a non-empty
+# fusable-run histogram (docs/observability.md).
+turns-smoke: native
+	JAX_PLATFORMS=cpu python scripts/turns_smoke.py
 
 # Regenerate docs/bench-trajectory.md from the BENCH_r0N.json artifacts.
 bench-report:

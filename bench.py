@@ -222,9 +222,12 @@ def _hybrid_rate():
         # engine built directly: log_capacity=0 skips the device event
         # log (1000 lanes x 20 sends/s overflow the 200k default, and a
         # bench diffs counters, not logs) — the Simulation facade path is
-        # what the parity/determinism tests exercise
+        # what the parity/determinism tests exercise.  The device-turn
+        # ledger rides the TIMED run: its rows derive from host-side
+        # values the window law reads anyway (zero extra transfers), and
+        # its fusion-headroom keys are ROADMAP item 1's design input.
         eng = MpHybridEngine(cfg, workers=HYBRID_WORKERS, log_capacity=0)
-        eng.obs = Recorder(run_id="bench-hybrid")
+        eng.obs = Recorder(run_id="bench-hybrid", turns=True)
         t0 = time.perf_counter()
         result = eng.run()
         total = time.perf_counter() - t0
@@ -235,6 +238,28 @@ def _hybrid_rate():
         phase_wall = {
             k: round(v, 3)
             for k, v in sorted(eng.obs.metrics.phase_wall_s().items())
+        }
+        ledger = eng.obs.turns
+        ledger.finish()
+        tsum = ledger.summary()
+        turn_keys = {
+            "turns": tsum["turns"],
+            "turn_causes": {
+                k: v for k, v in tsum["cause_counts"].items() if v
+            },
+            "empty_injection_turns": tsum["empty_injection_turns"],
+            "fusable_runs": tsum["fusable_runs"],
+            "fusable_run_p50": tsum["fusable_run_p50"],
+            "fusable_run_p99": tsum["fusable_run_p99"],
+            "fusable_run_max": tsum["fusable_run_max"],
+            # speculative (empty-injection) ceiling + the provable
+            # free-run collapse — ROADMAP item 1b / 1a respectively
+            "kfusion_headroom": tsum["kfusion_headroom"],
+            "kfusion_headroom_freerun": tsum["kfusion_headroom_freerun"],
+            "fusable_run_hist": {
+                f"b{i}": int(v)
+                for i, v in enumerate(ledger.run_hist) if v
+            },
         }
         return {
             "hybrid_sim_s_per_wall_s": round(
@@ -258,6 +283,7 @@ def _hybrid_rate():
             "hybrid_rounds": int(result.rounds),
             "hybrid_sync": sync,
             "hybrid_phase_wall_s": phase_wall,
+            **turn_keys,
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -61,6 +61,16 @@ KEY_ORDER = [
     "hybrid_sync.device_sync_s",
     "hybrid_sync.syscall_service_s",
     "hybrid_sync.device_turns",
+    # device-turn ledger keys (obs/turns.py — ROADMAP item 1's
+    # instrument: why each blocking turn exists and how many could fuse)
+    "turns",
+    "empty_injection_turns",
+    "fusable_runs",
+    "fusable_run_p50",
+    "fusable_run_p99",
+    "fusable_run_max",
+    "kfusion_headroom",
+    "kfusion_headroom_freerun",
     # netobs telemetry keys (drop-cause / retransmit totals + the
     # burst-window histogram buckets — open item 3's evidence base;
     # mixed_window_hist.b* buckets follow in the sorted tail)
@@ -78,6 +88,51 @@ KEY_ORDER = [
 KEY_LABEL = {
     "value": "tgen_mesh_10k (headline)",
 }
+
+# bucket histograms render as ONE compact sparkline row per group
+# instead of a raw b0..bN key explosion (the per-bucket values stay
+# machine-readable in --format json)
+HIST_GROUPS = ("mixed_window_hist", "fusable_run_hist")
+HIST_KEY_RE = re.compile(
+    r"^(" + "|".join(HIST_GROUPS) + r")\.b(\d+)$"
+)
+SPARK_CHARS = "·▁▂▃▄▅▆▇█"  # index 0 = empty bucket, 1..8 = scaled
+
+
+def sparkline(buckets: list[int]) -> str:
+    """Deterministic unicode sparkline: each bucket scales against the
+    row's max (empty buckets print the midline dot)."""
+    vmax = max(buckets, default=0)
+    if vmax <= 0:
+        return "—"
+    return "".join(
+        SPARK_CHARS[0] if v <= 0 else SPARK_CHARS[1 + (7 * int(v)) // vmax]
+        for v in buckets
+    )
+
+
+def hist_tables(
+    rounds: dict[str, dict[str, object]],
+) -> dict[str, dict[str, list[int]]]:
+    """group -> round tag -> dense bucket list (width = the max bucket
+    index seen for that group across all rounds, so columns align)."""
+    width: dict[str, int] = {}
+    raw: dict[str, dict[str, dict[int, int]]] = {}
+    for tag, flat in rounds.items():
+        for key, val in flat.items():
+            m = HIST_KEY_RE.match(key)
+            if not m:
+                continue
+            group, idx = m.group(1), int(m.group(2))
+            width[group] = max(width.get(group, 0), idx + 1)
+            raw.setdefault(group, {}).setdefault(tag, {})[idx] = int(val)
+    return {
+        group: {
+            tag: [cells.get(i, 0) for i in range(width[group])]
+            for tag, cells in per_tag.items()
+        }
+        for group, per_tag in raw.items()
+    }
 
 
 def _flatten(d: dict, prefix: str = "") -> dict[str, object]:
@@ -124,6 +179,8 @@ def build_table(
     seen: set[str] = set()
     for flat in rounds.values():
         seen.update(flat)
+    # per-bucket histogram keys collapse into sparkline rows (below)
+    seen = {k for k in seen if not HIST_KEY_RE.match(k)}
     keys = [k for k in KEY_ORDER if k in seen]
     # every remaining key follows the curated order — nested (dotted)
     # ones included, so a new phase/sync key can never silently vanish
@@ -154,7 +211,11 @@ def render_markdown(rounds: dict[str, dict[str, object]]) -> str:
         "move between axon-runtime and CPU-JAX measurement boxes across "
         "rounds (each BENCH file's `source` notes which); per-phase "
         "`hybrid_phase_wall_s.*` keys are the obs-measured wall "
-        "attribution (docs/observability.md).",
+        "attribution (docs/observability.md).  Bucket histograms "
+        "(`mixed_window_hist`, `fusable_run_hist`) render as one "
+        "sparkline row each — log2 buckets left to right from b0, "
+        "scaled per cell; `·` is an empty bucket (raw values: "
+        "`--format json`).",
         "",
     ]
     header = "| key | " + " | ".join(tags) + " | Δ vs 6.38 |"
@@ -173,6 +234,18 @@ def render_markdown(rounds: dict[str, dict[str, object]]) -> str:
                 delta = f"{float(latest) / REFERENCE_SPEEDUP:.2%}"
         label = KEY_LABEL.get(key, key)
         lines.append(f"| `{label}` | " + " | ".join(cells) + f" | {delta} |")
+    hists = hist_tables(rounds)
+    for group in HIST_GROUPS:
+        if group not in hists:
+            continue
+        cells = [
+            sparkline(hists[group][t]) if t in hists[group] else "—"
+            for t in tags
+        ]
+        lines.append(
+            f"| `{group}` (log2 buckets, b0→) | "
+            + " | ".join(cells) + " |  |"
+        )
     lines.append("")
     return "\n".join(lines)
 
@@ -186,6 +259,8 @@ def render_json(rounds: dict[str, dict[str, object]]) -> str:
             "table": {
                 key: {t: rounds[t].get(key) for t in tags} for key in keys
             },
+            # the sparkline rows' raw buckets, machine-readable
+            "histograms": hist_tables(rounds),
         },
         indent=2,
     )

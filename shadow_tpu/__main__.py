@@ -77,6 +77,13 @@ def build_parser() -> argparse.ArgumentParser:
         "NETOBS_*.json run report (docs/observability.md)",
     )
     p.add_argument(
+        "--obs-turns",
+        action="store_true",
+        help="record the device-turn ledger (turn-cause accounting + "
+        "fusable-run-length measurement) and write a TURNS_*.json run "
+        "report (docs/observability.md)",
+    )
+    p.add_argument(
         "--set",
         dest="overrides",
         action="append",
@@ -133,6 +140,8 @@ def main(argv: list[str] | None = None) -> int:
             overrides["experimental.obs_trace"] = True
         if ns.netobs:
             overrides["experimental.netobs"] = True
+        if ns.obs_turns:
+            overrides["experimental.obs_turns"] = True
         cfg.apply_overrides(overrides)
         cfg.validate()
     except (ConfigError, OSError, KeyError) as e:

@@ -117,6 +117,13 @@ class Host:
         self._net = None  # lazy HostNetStack (TCP tier)
         self._passive = None  # lazy: all apps passive_delivery (or no apps)
 
+    # device-turn ledger accounting (obs/turns.py; class defaults keep
+    # the hot path to one engine-flag check when the ledger is off):
+    # _ledger_managed marks hosts whose sends a hybrid run would stage,
+    # _ledger_sends is the thread-owned per-window staged-send count
+    _ledger_managed = False
+    _ledger_sends = 0
+
     # -- HostApi ----------------------------------------------------------
 
     @property
@@ -366,6 +373,9 @@ class CpuEngine:
         # [window-agg]/[host-exec-agg] telemetry sink (set by the facade
         # when experimental.perf_logging is on; None = zero overhead)
         self.perf_log = None
+        # device-turn ledger send accounting (obs/turns.py): armed by
+        # _ledger_enable when obs.turns is on; False = zero overhead
+        self._turns_sends = False
         # obs Recorder (shadow_tpu/obs/): phase spans + metrics, set by
         # the facade when experimental.obs_* is on; None = zero overhead
         self.obs = None
@@ -493,6 +503,10 @@ class CpuEngine:
         seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload)
         if arr is None:
             return seq
+        if self._turns_sends and src_host._ledger_managed:
+            # the oracle analogue of a hybrid injection row: a managed
+            # host's surviving non-loopback send (thread-owned bump)
+            src_host._ledger_sends += 1
         ev = Event(
             arr, EventKind.PACKET, src_host=src_host.host_id, seq=seq,
             data=(size_bytes, payload),
@@ -589,6 +603,45 @@ class CpuEngine:
                 data=Delivery(ev.src_host, ev.seq, size_bytes, payload),
             )
         )
+
+    # -- device-turn ledger (obs/turns.py) ---------------------------------
+
+    def _ledger_enable(self) -> list[Host]:
+        """Arm the oracle side of the device-turn ledger: mark the
+        managed hosts (whose sends a hybrid run would stage for device
+        injection) and enable the per-send counter.  Returns the managed
+        hosts in host-id order."""
+        from ..native.process import ManagedApp
+
+        managed = [
+            h for h in self.hosts
+            if any(isinstance(a, ManagedApp) for a in h.apps)
+        ]
+        for h in managed:
+            h._ledger_managed = True
+            h._ledger_sends = 0
+        self._turns_sends = True
+        return managed
+
+    @staticmethod
+    def _ledger_participants(managed: list[Host], until: int) -> tuple:
+        """Managed hosts with events inside the window — taken BEFORE
+        execution mutates the queues (the same law the hybrid engines
+        apply per device turn)."""
+        return tuple(
+            h.host_id for h in managed if h.queue.next_time() < until
+        )
+
+    @staticmethod
+    def _ledger_take_sends(managed: list[Host]) -> int:
+        """Drain the managed hosts' per-window staged-send counters
+        (thread-owned bumps, swept post-barrier on the round loop)."""
+        n = 0
+        for h in managed:
+            if h._ledger_sends:
+                n += h._ledger_sends
+                h._ledger_sends = 0
+        return n
 
     # -- round loop (controller.rs:88-113 + manager.rs:541) ----------------
 
@@ -701,21 +754,29 @@ class CpuEngine:
 
     def _round_loop(self, scheduler, on_window, t0) -> "SimResult":
         obs = self.obs
+        turns = obs.turns if obs is not None else None
+        managed_hosts = self._ledger_enable() if turns is not None else None
         while True:
             start = self.next_event_time()
             if start >= self.stop_time or start == stime.NEVER:
                 break
+            swapped = False
             if self.faults is not None:
                 # apply every fault epoch at or before this window's start,
                 # then clamp the window at the next pending epoch: sends at
                 # t >= epoch see the new tables, earlier sends never do —
                 # the identical law the TPU engine's epoch segmentation
                 # enforces, so windows (and logs) stay bit-identical
+                prev_install = (
+                    self.faults._installed_at if turns is not None else None
+                )
                 if obs is None:
                     self.faults.advance_to(start)
                 else:
                     with obs.phase("fault_swap", window_start=start):
                         self.faults.advance_to(start)
+                if turns is not None:
+                    swapped = self.faults._installed_at != prev_install
             self.window_end = min(start + self.current_runahead(), self.stop_time)
             if self.faults is not None:
                 self.window_end = min(
@@ -725,6 +786,10 @@ class CpuEngine:
             if pl is not None or obs is not None:
                 active = sum(
                     1 for h in self.hosts if h.queue.next_time() < self.window_end
+                )
+            if turns is not None:
+                parts = self._ledger_participants(
+                    managed_hosts, self.window_end
                 )
             if obs is None:
                 scheduler.run_round(self.window_end)
@@ -740,6 +805,24 @@ class CpuEngine:
                 # one histogram entry per window (post-barrier, so every
                 # pop of the round has landed)
                 self.netobs.flush_window()
+            if turns is not None:
+                # the oracle ledger row: one window = one hypothetical
+                # device turn, with the cause a hybrid run of this config
+                # would have recorded (fault swap > staged managed sends
+                # > managed participation > legal free-run)
+                sends = self._ledger_take_sends(managed_hosts)
+                if swapped:
+                    cause = "fault_swap"
+                elif sends:
+                    cause = "injection"
+                elif parts:
+                    cause = "host_window"
+                else:
+                    cause = "free_run"
+                turns.turn(
+                    cause, start, self.window_end,
+                    inject_rows=sends, participants=parts,
+                )
             if obs is not None:
                 m = obs.metrics
                 m.count("windows")

@@ -78,7 +78,9 @@ def spawn_cpu_workers(target, arg_tuples):
     return conns, procs
 
 
-def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
+def _worker_main(
+    cfg: ConfigOptions, owned: list[int], record_turns: bool, conn
+) -> None:
     # spawn start method: each worker REBUILDS its world replica from the
     # config — deterministic construction makes every replica identical,
     # and no JAX-threaded parent is ever forked (forking a process whose
@@ -93,6 +95,15 @@ def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
         engine.perf_log = BufferedPerfLog()
     owned_hosts = [engine.hosts[i] for i in owned]
     owned_set = set(owned)
+    managed_owned: list = []
+    if record_turns:
+        # device-turn ledger (obs/turns.py): this worker accounts the
+        # managed hosts it owns — participants before execution, staged
+        # (surviving, non-loopback) send counts after — and ships both
+        # with the round reply so the parent's ledger matches the serial
+        # engine's at any worker count
+        managed = set(h.host_id for h in engine._ledger_enable())
+        managed_owned = [h for h in owned_hosts if h.host_id in managed]
     try:
         while True:
             msg = conn.recv()
@@ -103,6 +114,11 @@ def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                     engine.hosts[dst].queue.push(
                         Event(t, EventKind.PACKET, src_host=src, seq=seq,
                               data=data)
+                    )
+                wparts = ()
+                if record_turns:
+                    wparts = engine._ledger_participants(
+                        managed_owned, window_end
                     )
                 for h in owned_hosts:
                     h.execute(window_end)
@@ -132,6 +148,10 @@ def _worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                     # the global window histogram)
                     engine.netobs.take_round_pops()
                     if engine.netobs is not None else 0,
+                    # device-turn ledger: (participants, staged sends)
+                    wparts,
+                    engine._ledger_take_sends(managed_owned)
+                    if record_turns else 0,
                 ))
             elif msg[0] == "finish":
                 engine.finalize()
@@ -215,8 +235,10 @@ class MpCpuEngine:
         parts = _partition(n, self.workers)
         owner_of = [hid % self.workers for hid in range(n)]
 
+        turns = self.obs.turns if self.obs is not None else None
         conns, procs = spawn_cpu_workers(
-            _worker_main, [(self.cfg, owned) for owned in parts]
+            _worker_main,
+            [(self.cfg, owned, turns is not None) for owned in parts],
         )
 
         t0 = wall_time.perf_counter()
@@ -250,8 +272,11 @@ class MpCpuEngine:
                 t_ship = wall_time.perf_counter() if obs is not None else 0.0
                 perf_lines: list[str] = []
                 round_pops = 0
+                round_parts: list[int] = []
+                round_sends = 0
                 for w, conn in enumerate(conns):
-                    next_t, outbound, mul, wlines, wpops = conn.recv()
+                    (next_t, outbound, mul, wlines, wpops, wparts,
+                     wsends) = conn.recv()
                     next_times[w] = next_t
                     if mul is not None and (
                         min_used_lat is None or mul < min_used_lat
@@ -262,8 +287,27 @@ class MpCpuEngine:
                     if wlines:
                         perf_lines.extend(wlines)
                     round_pops += wpops
+                    if wparts:
+                        round_parts.extend(wparts)
+                    round_sends += wsends
                 if netobs_on and round_pops > 0:
                     window_hist[nom.hist_bucket(round_pops)] += 1
+                if turns is not None:
+                    # the controller's ledger row (obs/turns.py): sorted
+                    # union of the workers' participant sets normalizes
+                    # the round-robin partition back to host-id order —
+                    # identical rows to the serial engine's
+                    parts_t = tuple(sorted(round_parts))
+                    if round_sends:
+                        cause = "injection"
+                    elif parts_t:
+                        cause = "host_window"
+                    else:
+                        cause = "free_run"
+                    turns.turn(
+                        cause, start, window_end,
+                        inject_rows=round_sends, participants=parts_t,
+                    )
                 # in-flight cross-partition packets lower the owners'
                 # next-event times before the next window is computed
                 for w in range(self.workers):

@@ -220,12 +220,19 @@ class _HybridWorker(_HostSideHybrid):
         self._next_hosts = self.owned_hosts
 
 
-def _hybrid_worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
+def _hybrid_worker_main(
+    cfg: ConfigOptions, owned: list[int], record_turns: bool, conn
+) -> None:
     """Worker loop: apply shipped deliveries, execute the owned hosts'
     window (syscall servicing — the parallel hot path), sweep staged
     sends back to the parent.  Protocol mirrors cpu_mp._worker_main.
     Perf-log lines buffer locally and ride the round reply to the
-    parent's locked sink (one coherent stream per run)."""
+    parent's locked sink (one coherent stream per run).  When the
+    device-turn ledger is on, the reply also carries the owned hosts
+    participating in this window (events < window_end, taken after the
+    shipped deliveries land and before execution — the identical law the
+    serial engine applies, so the parent's ledger is worker-count
+    invariant)."""
     engine = _HybridWorker(cfg, owned)
     if cfg.experimental.perf_logging:
         from ..engine.run_control import BufferedPerfLog
@@ -240,6 +247,12 @@ def _hybrid_worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                 engine.window_end = window_end
                 for t, src, dst, seq, size, payload in rows:
                     engine._apply_delivery_row(t, src, dst, seq, size, payload)
+                wparts = ()
+                if record_turns:
+                    wparts = tuple(
+                        h.host_id for h in engine.owned_hosts
+                        if h.queue.next_time() < window_end
+                    )
                 for h in engine.owned_hosts:
                     h.execute(window_end)
                 engine._barrier_merge()
@@ -251,6 +264,7 @@ def _hybrid_worker_main(cfg: ConfigOptions, owned: list[int], conn) -> None:
                     engine._min_used_lat,
                     engine.perf_log.drain()
                     if engine.perf_log is not None else (),
+                    wparts,
                 ))
             elif msg[0] == "finish":
                 engine.finalize()
@@ -324,6 +338,14 @@ class HybridEngine(_HostSideHybrid):
             "egress_rows": 0,       # delivery rows carried by those reads
             "egress_bytes": 0,      # D2H bytes (padded [span, 6] int64)
         }
+        # device-turn ledger plumbing (obs/turns.py; all inert when
+        # obs/turns are off): per-turn dispatch records buffered between
+        # _device_turn and the window law, the round's participant set,
+        # and the pending syscall_service->device_turn trace-flow anchor
+        self._ledger_dispatches = None
+        self._last_participants: tuple = ()
+        self._flow_pending = None
+        self._flow_seq = 0
 
     # -- dynamic runahead ---------------------------------------------------
 
@@ -442,11 +464,18 @@ class HybridEngine(_HostSideHybrid):
         low egress buffer.  Per completed turn the boundary costs exactly
         one injection block H2D (zero when nothing staged), one packed
         scalar D2H, and one egress slice D2H (zero when nothing
-        egressed)."""
+        egressed).
+
+        When the device-turn ledger is on (obs.turns), every dispatch is
+        buffered as ``(dev_we, inject_rows, egress_rows, is_retry)`` for
+        the window law to record with its cause — derived purely from
+        values this loop reads anyway, zero extra transfers."""
         p = self.device.params
         b = p.inject_batch
         st = self.sync_stats
         obs = self.obs
+        turns = obs.turns if obs is not None else None
+        dispatches = [] if turns is not None else None
         staged = self._staged_merged
         self._staged_merged = []
         # oversized staging: overflow blocks dispatch eagerly — JAX's
@@ -468,6 +497,7 @@ class HybridEngine(_HostSideHybrid):
             lanes.NEVER32 if self._min_used_lat is None else self._min_used_lat
         )
         host_next = next_host_fn()
+        first_dispatch = True
         while True:
             eh, el = (
                 (lanes.NEVER32, lanes.NEVER32)
@@ -492,6 +522,22 @@ class HybridEngine(_HostSideHybrid):
                     "device_turn", None, t0, t1 - t0, window_end=dev_we
                 )
                 obs.metrics.count("device_turns")
+                if (
+                    first_dispatch
+                    and self._flow_pending is not None
+                    and turns is not None
+                    and obs.tracer is not None
+                ):
+                    # trace-flow arrow: the syscall-service span that
+                    # forced this blocking turn -> the turn's span
+                    fid, anchor = self._flow_pending
+                    self._flow_pending = None
+                    tr = obs.tracer
+                    tr.flow("s", fid, "turn_cause", "turn_flow", anchor)
+                    tr.flow(
+                        "f", fid, "turn_cause", "turn_flow",
+                        t0 + (t1 - t0) / 2,
+                    )
             egress_count = int(sc[lanes.HYB_EGRESS_COUNT])
             if obs is None or egress_count == 0:
                 # empty egress is a no-op read: no span (symmetric with
@@ -509,12 +555,51 @@ class HybridEngine(_HostSideHybrid):
                 self.perf_log.hybrid_agg(
                     "device", dev_we, self.sync_stats
                 )
+            if dispatches is not None:
+                dispatches.append((
+                    dev_we,
+                    n_staged if first_dispatch else 0,
+                    egress_count,
+                    not first_dispatch,
+                ))
             if lane_min >= dev_we:
+                if dispatches is not None:
+                    self._ledger_dispatches = dispatches
                 return state, lane_min, dev_we
             # mid-window pause (egress headroom): drain and resume —
             # the cached empty block keeps the retry transfer-free
             inj = self._empty_block()
             host_next = next_host_fn()
+            first_dispatch = False
+
+    # -- device-turn ledger (obs/turns.py) -----------------------------------
+
+    def _record_turn_rows(self, turns, t_start: int, host_in: bool) -> None:
+        """Record the buffered dispatches of one completed device turn
+        with their causes (docs/observability.md taxonomy): the first
+        dispatch carries the turn's primary cause — ``injection`` when it
+        carried staged rows, else ``host_window`` when the completed
+        window has managed participation, else ``free_run`` — and every
+        egress-headroom resumption is its own ``egress_drain`` row.
+        Participants attach after the host round (the mp engine learns
+        them from the worker replies)."""
+        dispatches = self._ledger_dispatches
+        self._ledger_dispatches = None
+        if not dispatches:  # pragma: no cover - defensive
+            return
+        for dev_we, inj_rows, egr_rows, is_retry in dispatches:
+            if is_retry:
+                cause = "egress_drain"
+            elif inj_rows:
+                cause = "injection"
+            elif host_in:
+                cause = "host_window"
+            else:
+                cause = "free_run"
+            turns.turn(
+                cause, t_start, dev_we,
+                inject_rows=inj_rows, egress_rows=egr_rows,
+            )
 
     # -- the hybrid round loop ----------------------------------------------
 
@@ -522,14 +607,29 @@ class HybridEngine(_HostSideHybrid):
         """One host-side syscall-service round + barrier, timed into
         sync_stats (and per-window through the perf log / obs spans)."""
         t0 = wall_time.perf_counter()
+        obs = self.obs
+        if obs is not None and obs.turns is not None:
+            # the turn ledger's participant set, taken BEFORE execution
+            # mutates the queues: managed hosts with events inside the
+            # window — the identical law the mp workers apply, so the
+            # ledger is bit-identical at any worker count
+            self._last_participants = tuple(
+                h.host_id for h in self._next_hosts
+                if h.queue.next_time() < until
+            )
         scheduler.run_round(until)
         self._barrier_merge()
         t1 = wall_time.perf_counter()
         self.sync_stats["syscall_service_s"] += t1 - t0
-        if self.obs is not None:
-            self.obs.record(
+        if obs is not None:
+            obs.record(
                 "syscall_service", None, t0, t1 - t0, window_end=until
             )
+            if obs.turns is not None and obs.tracer is not None:
+                self._flow_seq += 1
+                self._flow_pending = (
+                    self._flow_seq, t0 + (t1 - t0) / 2,
+                )
         if self.perf_log is not None:
             self.perf_log.hybrid_agg("host", until, self.sync_stats)
 
@@ -568,6 +668,7 @@ class HybridEngine(_HostSideHybrid):
         dev_next = min(
             (t for (_lane, t, *_rest) in dev._init_events), default=NEVER
         )
+        turns = self.obs.turns if self.obs is not None else None
         while True:
             host_next = self.next_event_time()
             staged_min = min(
@@ -584,16 +685,23 @@ class HybridEngine(_HostSideHybrid):
                 state, dev_next, dev_we = self._device_turn(
                     state, hybrid_fn, inject_fn, self.next_event_time
                 )
-                if self.next_event_time() < dev_we:
+                host_in = self.next_event_time() < dev_we
+                if turns is not None:
+                    self._record_turn_rows(turns, start, host_in)
+                if host_in:
                     # host part of the device-completed window
                     self.window_end = dev_we
                     run_round(dev_we)
+                    if turns is not None:
+                        turns.attach_participants(self._last_participants)
                     if on_window is not None:
                         on_window(start, dev_we, self.next_event_time())
                 continue
             # host-only window (device idle beyond it, nothing staged)
             self.window_end = end
             run_round(end)
+            if turns is not None:
+                turns.host_round()
             self.host_rounds += 1
             if on_window is not None:
                 on_window(start, end, self.next_event_time())
@@ -718,8 +826,9 @@ class MpHybridEngine(HybridEngine):
         t_ship = wall_time.perf_counter()
         staged = self._staged_merged
         perf_lines: list[str] = []
+        parts_all: list[int] = []
         for w, conn in enumerate(conns):
-            next_t, out, mul, wlines = conn.recv()
+            next_t, out, mul, wlines, wparts = conn.recv()
             self._eff_next[w] = next_t
             if mul is not None and (
                 self._min_used_lat is None or mul < self._min_used_lat
@@ -728,8 +837,20 @@ class MpHybridEngine(HybridEngine):
             staged.extend(out)
             if wlines:
                 perf_lines.extend(wlines)
+            if wparts:
+                parts_all.extend(wparts)
         t1 = wall_time.perf_counter()
         self.sync_stats["syscall_service_s"] += t1 - t0
+        if obs is not None and obs.turns is not None:
+            # the partition interleaves host ids round-robin across
+            # workers; sorting normalizes the union to the serial
+            # engine's host-id order (ledger worker-count invariance)
+            self._last_participants = tuple(sorted(parts_all))
+            if obs.tracer is not None:
+                self._flow_seq += 1
+                self._flow_pending = (
+                    self._flow_seq, t_ship + (t1 - t_ship) / 2,
+                )
         if obs is not None:
             # disjoint attribution (same law as cpu_mp): worker_pipe is
             # the ship leg, syscall_service the collect leg — the barrier
@@ -788,8 +909,10 @@ class MpHybridEngine(HybridEngine):
         self._owner_of = {
             hid: w for w, part in enumerate(parts) for hid in part
         }
+        record_turns = self.obs is not None and self.obs.turns is not None
         conns, procs = spawn_cpu_workers(
-            _hybrid_worker_main, [(self.cfg, owned) for owned in parts]
+            _hybrid_worker_main,
+            [(self.cfg, owned, record_turns) for owned in parts],
         )
         self._mp = (conns, procs)
         self._pending_rows = [[] for _ in range(self.workers)]

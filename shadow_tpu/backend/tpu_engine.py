@@ -827,15 +827,31 @@ class TpuEngine:
             t0 = wall_time.perf_counter()
             state = self._drive_steps(round_fn, state, on_window, self.params)
             wall = wall_time.perf_counter() - t0
-        return self.collect(state, wall)
+        result = self.collect(state, wall)
+        if mode == "device" and self.obs is not None and self.obs.turns is not None:
+            # the fused driver's whole run is ONE unforced dispatch: the
+            # ledger's free-run baseline, with its actual free-run length
+            # (the windows the dispatch covered — known at collect, no
+            # extra transfer)
+            self.obs.turns.turn(
+                "free_run", 0, self.params.stop_time, windows=result.rounds
+            )
+        return result
 
     def _drive_steps(
-        self, round_fn, state: lanes.LaneState, on_window, p: lanes.LaneParams
+        self, round_fn, state: lanes.LaneState, on_window, p: lanes.LaneParams,
+        first_cause: str = "snapshot",
     ) -> lanes.LaneState:
         """The step driver's round loop (one device call per round) up to
         ``p.stop_time`` — shared by the plain run and every fault-epoch
         segment.  Each round is timed under the stall watchdog when
-        ``faults.watchdog_timeout`` is configured."""
+        ``faults.watchdog_timeout`` is configured.
+
+        Ledger causes (obs/turns.py): the step driver exists exactly so
+        run-control can pause at every boundary, so its window-advancing
+        dispatches record as ``snapshot`` turns — except the first
+        dispatch of a fault-epoch segment, which ``_run_faulted`` passes
+        in as ``fault_swap``."""
         from ..faults.watchdog import RoundWatchdog
 
         wd = (
@@ -844,6 +860,8 @@ class TpuEngine:
             else None
         )
         obs = self.obs
+        turns = obs.turns if obs is not None else None
+        turn_cause = first_cause
         while True:
             self._live_state = state
             if on_window is not None or self.perf_log is not None or obs is not None:
@@ -882,6 +900,9 @@ class TpuEngine:
                     (int(state.now_we_hi) << 31) | int(state.now_we_lo)
                 )
                 next_ev = self._next_event_np(state)
+                if turns is not None:
+                    turns.turn(turn_cause, start, window_end)
+                    turn_cause = "snapshot"
                 if obs is not None:
                     obs.metrics.count("windows")
                     obs.metrics.observe("window_span_ns", window_end - start)
@@ -946,6 +967,8 @@ class TpuEngine:
             fns = self._seg_fns = {}
         t0 = wall_time.perf_counter()
         seg_start = 0
+        turns = self.obs.turns if self.obs is not None else None
+        seg_rounds = 0
         for seg_end in bounds:
             if seg_start > 0 and ov.stall_at(seg_start):
                 raise BackendStallError(
@@ -961,10 +984,26 @@ class TpuEngine:
                 if fn is None:
                     fn = fns[key] = lanes.make_run_fn(p, tb)
                 state = jax.block_until_ready(fn(state))
+                if turns is not None:
+                    # one fused dispatch per epoch segment; the rounds
+                    # delta is its measured free-run length (faulted runs
+                    # are never the timed bench path, so this readback is
+                    # ledger-only)
+                    r = int(state.rounds)
+                    turns.turn(
+                        "free_run" if seg_start == 0 else "fault_swap",
+                        seg_start, seg_end, windows=r - seg_rounds,
+                    )
+                    seg_rounds = r
             else:
                 if fn is None:
                     fn = fns[key] = lanes.make_round_fn(p, tb)
-                state = self._drive_steps(fn, state, on_window, p)
+                state = self._drive_steps(
+                    fn, state, on_window, p,
+                    first_cause=(
+                        "snapshot" if seg_start == 0 else "fault_swap"
+                    ),
+                )
             seg_start = seg_end
         wall = wall_time.perf_counter() - t0
         return self.collect(state, wall)

@@ -105,6 +105,13 @@ class ExperimentalOptions:
     obs_jsonl: bool = False
     obs_jax_annotations: bool = False
     obs_dir: Optional[str] = None  # None = general.data_directory
+    # device-turn ledger (obs/turns.py): causal per-turn accounting
+    # (cause taxonomy + conservation law) and fusable-run-length
+    # measurement, exported as TURNS_<backend>-seed<N>.json.  Rows derive
+    # from data the host side already holds per turn — zero new
+    # host<->device transfers — and are bit-identical at any hybrid
+    # worker count
+    obs_turns: bool = False
     # simulated-network telemetry plane (obs/netobs.py): per-host
     # sent/delivered/bytes counters, drop-cause accounting, and the
     # burst-window histogram, exported as NETOBS_<backend>-seed<N>.json.

@@ -22,10 +22,13 @@ Command grammar (identical to the reference fork):
 Observability extensions (shadow_tpu/obs/, docs/observability.md):
 
 - ``stats``          print a live metrics snapshot (phase walls,
-  counters, gauges) at the current window boundary
+  counters, gauges — plus the netobs network totals when the telemetry
+  plane is on, so one verb covers both) at the current window boundary
 - ``netstats [host]``  print the simulated-network telemetry snapshot
   (per-host counters, drop causes, burst-window histogram — the netobs
   plane of obs/netobs.py); with a hostname, that host's counter row too
+- ``turns``          print the device-turn ledger snapshot (turn-cause
+  counts, fusable-run percentiles, k-fusion headroom — obs/turns.py)
 - ``trace``          tracer status; ``trace on|off`` toggles recording;
   ``trace dump [path]`` exports the Chrome trace collected so far
 
@@ -220,7 +223,7 @@ class RunControl:
             f"[run-control] paused at window boundary: sim-time "
             f"{stime.fmt(window_end)} (next event {stime.fmt(next_event_time)}); "
             "commands: c / cN / n / s / s:<pid> / r / rN / stats / "
-            "netstats [host] / trace ... / fault ... / failover"
+            "netstats [host] / turns / trace ... / fault ... / failover"
         )
         self._print_info()
         # soft-wait: block until a resuming command arrives
@@ -293,6 +296,9 @@ class RunControl:
         if cmd == "netstats" or cmd.startswith("netstats "):
             self._cmd_netstats(cmd.split()[1:])
             return False
+        if cmd == "turns":
+            self._cmd_turns()
+            return False
         if cmd == "trace" or cmd.startswith("trace "):
             self._cmd_trace(cmd.split()[1:])
             return False
@@ -316,7 +322,11 @@ class RunControl:
 
     def _cmd_stats(self) -> None:
         """``stats``: print a live metrics snapshot — phase walls,
-        counters, gauges — at the current window boundary."""
+        counters, gauges — at the current window boundary.  When the
+        netobs plane is on, the network totals (sent/delivered/bytes,
+        drop causes, burst-window histogram) fold into the same answer,
+        so one verb gives phase walls + network totals without a
+        separate ``netstats`` call."""
         if self._obs is None:
             self._print(
                 "[run-control] obs is not enabled (set "
@@ -325,6 +335,25 @@ class RunControl:
             return
         self._print("[run-control] stats:")
         for line in self._obs.metrics.snapshot_lines():
+            self._print(f"[run-control]   {line}")
+        if self._netobs_sink is not None:
+            # PR 10's net_* totals, live (finalize-time counters only
+            # land in the registry at run end)
+            for line in self._netobs_sink(None):
+                self._print(f"[run-control]   {line}")
+
+    def _cmd_turns(self) -> None:
+        """``turns``: the device-turn ledger snapshot (obs/turns.py) —
+        turn-cause counts, fusable-run percentiles, k-fusion headroom."""
+        turns = getattr(self._obs, "turns", None)
+        if turns is None:
+            self._print(
+                "[run-control] turn ledger is not enabled (set "
+                "experimental.obs_turns)"
+            )
+            return
+        self._print("[run-control] turns:")
+        for line in turns.snapshot_lines():
             self._print(f"[run-control]   {line}")
 
     def _cmd_netstats(self, tokens: list[str]) -> None:

@@ -84,9 +84,10 @@ class Simulation:
         (None = everything off = zero engine overhead)."""
         exp = self.cfg.experimental
         if not (exp.obs_metrics or exp.obs_trace or exp.obs_jsonl
-                or exp.netobs):
-            # netobs implies a Recorder: the NETOBS_*.json artifact rides
-            # the same run-id/out-dir lifecycle as METRICS_*.json
+                or exp.netobs or exp.obs_turns):
+            # netobs/obs_turns imply a Recorder: the NETOBS_/TURNS_*.json
+            # artifacts ride the same run-id/out-dir lifecycle as
+            # METRICS_*.json
             return None
         from ..obs import Recorder
 
@@ -98,6 +99,7 @@ class Simulation:
             trace=exp.obs_trace,
             jsonl=exp.obs_jsonl,
             jax_annotations=exp.obs_jax_annotations,
+            turns=exp.obs_turns,
         )
 
     def _run_logged(self, write_data: bool, t0: float) -> SimResult:
@@ -164,7 +166,7 @@ class Simulation:
                 extra["hybrid_sync"] = dict(sync)
             self._write_netobs(extra)
             fin = self.obs.finalize(extra=extra)
-            for k in ("metrics_path", "trace_path"):
+            for k in ("metrics_path", "trace_path", "turns_path"):
                 if k in fin:
                     log.info("obs artifact: %s", fin[k])
         if write_data:

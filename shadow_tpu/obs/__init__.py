@@ -22,5 +22,6 @@ from __future__ import annotations
 from .metrics import MetricsRegistry
 from .recorder import PHASES, Recorder
 from .tracer import Tracer
+from .turns import TurnLedger
 
-__all__ = ["MetricsRegistry", "PHASES", "Recorder", "Tracer"]
+__all__ = ["MetricsRegistry", "PHASES", "Recorder", "Tracer", "TurnLedger"]

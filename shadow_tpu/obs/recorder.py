@@ -101,6 +101,7 @@ class Recorder:
         jsonl: bool = False,
         jax_annotations: bool = False,
         trace_capacity: Optional[int] = None,
+        turns: bool = False,
     ) -> None:
         self.run_id = run_id
         self.out_dir = Path(out_dir) if out_dir is not None else None
@@ -115,6 +116,13 @@ class Recorder:
             self.tracer = (
                 Tracer() if trace_capacity is None else Tracer(trace_capacity)
             )
+        # device-turn ledger (obs/turns.py): causal turn accounting +
+        # fusion-headroom measurement; None = off = zero engine calls
+        self.turns: Optional["TurnLedger"] = None
+        if turns:
+            from .turns import TurnLedger
+
+            self.turns = TurnLedger()
         self._annotate = None
         if jax_annotations:
             try:
@@ -179,6 +187,11 @@ class Recorder:
         if self.tracer is not None:
             report_extra.setdefault("trace_spans", self.tracer.span_count())
             report_extra.setdefault("trace_dropped", self.tracer.dropped)
+        if self.turns is not None:
+            # the METRICS report carries the ledger aggregates; the
+            # per-turn rows live in the TURNS artifact written below
+            self.turns.finish()  # close the trailing fusable run first
+            report_extra.setdefault("device_turn_ledger", self.turns.summary())
         if self.out_dir is not None:
             self.out_dir.mkdir(parents=True, exist_ok=True)
             if self.tracer is not None:
@@ -186,6 +199,15 @@ class Recorder:
                     self.tracer.export(
                         self.out_dir / f"trace_{self.run_id}.json",
                         extra={"run_id": self.run_id},
+                    )
+                )
+            if self.turns is not None:
+                from .turns import write_report as _write_turns
+
+                out["turns_path"] = str(
+                    _write_turns(
+                        self.out_dir / f"TURNS_{self.run_id}.json",
+                        self.turns.report(self.run_id),
                     )
                 )
             out["metrics_path"] = str(

@@ -71,6 +71,39 @@ class Tracer:
             else:
                 self.events.append(ev)
 
+    def flow(
+        self,
+        phase: str,
+        flow_id: int,
+        name: str,
+        cat: str,
+        t_abs: float,
+    ) -> None:
+        """Record one flow event (``ph: "s"`` start / ``"f"`` finish),
+        binding by enclosure to the slice containing ``t_abs`` on the
+        emitting thread's row — the arrows that link a blocking device
+        turn back to the syscall-service span that forced it
+        (obs/turns.py).  ``t_abs`` is a ``wall_time.perf_counter()``
+        stamp, like :meth:`complete`."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": phase,
+            "id": flow_id,
+            "ts": (t_abs - self.t0) * 1e6,
+            "pid": 1,
+        }
+        if phase == "f":
+            ev["bp"] = "e"  # bind the finish to its enclosing slice
+        with self._lock:
+            ev["tid"] = self._tid()
+            if len(self.events) >= self.capacity:
+                self.dropped += 1
+            else:
+                self.events.append(ev)
+
     def instant(self, name: str, cat: str, args: Optional[dict] = None) -> None:
         """Record an instant marker (``ph: "i"``) at the current wall time."""
         if not self.enabled:

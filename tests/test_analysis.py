@@ -36,6 +36,20 @@ def rules_of(findings):
     return {f.rule for f in findings}
 
 
+def test_obs_turns_module_is_in_lint_scope():
+    """The device-turn ledger (shadow_tpu/obs/turns.py) sits under both
+    shadowlint scopes: SL103-style ordering rules and the SL101/SL106
+    step-path rules apply to it from day one, exactly like the rest of
+    shadow_tpu/obs/ (docs/analysis.md)."""
+    from shadow_tpu.analysis.astlint import _module_flags
+
+    ordering, step = _module_flags("shadow_tpu/obs/turns.py")
+    assert ordering and step
+    # and an in-scope hazard planted in that path is actually flagged
+    src = "import time\n\ndef run_window(self):\n    return time.time()\n"
+    assert rules_of(lint_source(src, "shadow_tpu/obs/turns.py")) == {"SL101"}
+
+
 # -- SL101: wall-clock reads -------------------------------------------------
 
 
