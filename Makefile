@@ -6,7 +6,8 @@
 # CTest gate (src/test/determinism/CMakeLists.txt).
 
 .PHONY: test gate native smoke-faults smoke-examples lint-determinism \
-	bench-hybrid obs-smoke netobs-smoke turns-smoke bench-report
+	bench-hybrid obs-smoke netobs-smoke turns-smoke fusion-smoke \
+	bench-report check-fixtures
 
 test: native
 	python -m pytest tests/ -q
@@ -14,7 +15,7 @@ test: native
 # the suite runs -m 'not slow': the only slow-marked test re-runs the
 # full two-pass shadowlint in a subprocess, which the lint-determinism
 # step above has just done — no point tracing six kernels twice
-gate: native lint-determinism
+gate: native check-fixtures lint-determinism
 	python -m pytest tests/ -q -m 'not slow'
 	SHADOW_TPU_STRESS=1 python -m pytest tests/test_stress.py -q
 	SHADOW_TPU_SCALE=1 python -m pytest tests/test_managed_scale.py -q
@@ -24,6 +25,20 @@ gate: native lint-determinism
 	$(MAKE) obs-smoke
 	$(MAKE) netobs-smoke
 	$(MAKE) turns-smoke
+	$(MAKE) fusion-smoke
+
+# Runtime fixture dirs (hermdir/, shadow.data/, pytest caches) are
+# .gitignore'd; a force-add or an ignore regression would commit
+# megabytes of run artifacts — fail the gate if any tracked path lands
+# inside them.
+check-fixtures:
+	@bad=$$(git ls-files -- 'hermdir/*' 'shadow.data/*' '*.pyc' \
+	  '.pytest_cache/*' '__pycache__/*' \
+	  '*/hermdir/*' '*/shadow.data/*' \
+	  '*/.pytest_cache/*' '*/__pycache__/*'); \
+	if [ -n "$$bad" ]; then \
+	  echo "committed runtime fixtures detected:"; echo "$$bad"; exit 1; \
+	fi
 
 # The hybrid backend's short deterministic benchmark (one JSON line):
 # the relay-chain scenario scaled down to CI size, syscall plane on 2
@@ -76,6 +91,13 @@ netobs-smoke:
 # fusable-run histogram (docs/observability.md).
 turns-smoke: native
 	JAX_PLATFORMS=cpu python scripts/turns_smoke.py
+
+# k-window fusion smoke for the gate: the gate-scale managed hybrid run
+# with the ledger on, asserting blocking device turns dropped >= 2x vs
+# the PR 11 pinned 651-turn unfused baseline with the fused-turn
+# conservation law green (docs/hybrid.md "k-window fusion law").
+fusion-smoke: native
+	JAX_PLATFORMS=cpu python scripts/fusion_smoke.py
 
 # Regenerate docs/bench-trajectory.md from the BENCH_r0N.json artifacts.
 bench-report:

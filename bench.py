@@ -260,6 +260,22 @@ def _hybrid_rate():
                 f"b{i}": int(v)
                 for i, v in enumerate(ledger.run_hist) if v
             },
+            # realized k-window fusion (ISSUE 13): dispatches that
+            # covered >= 2 validated windows, the blocking turns they
+            # eliminated (net of rollback rebuilds), and the achieved
+            # collapse vs the PR 11 headroom predictions above
+            "hybrid_fused_runs": tsum["fused_turns"],
+            "hybrid_fused_windows": tsum["fused_windows_total"],
+            "hybrid_turns_saved": tsum["turns_saved"],
+            "hybrid_fuse_rollbacks": tsum["rollbacks"],
+            "hybrid_achieved_fusion": tsum["achieved_fusion"],
+            "hybrid_unfused_turns": tsum["implied_unfused_turns"],
+            "hybrid_async_hits": int(
+                eng.sync_stats.get("async_dispatch_hits", 0)
+            ),
+            "hybrid_async_misses": int(
+                eng.sync_stats.get("async_dispatch_misses", 0)
+            ),
         }
         return {
             "hybrid_sim_s_per_wall_s": round(

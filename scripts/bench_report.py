@@ -71,6 +71,15 @@ KEY_ORDER = [
     "fusable_run_max",
     "kfusion_headroom",
     "kfusion_headroom_freerun",
+    # realized k-window fusion (ISSUE 13: backend/hybrid.py fused law)
+    "hybrid_fused_runs",
+    "hybrid_fused_windows",
+    "hybrid_turns_saved",
+    "hybrid_fuse_rollbacks",
+    "hybrid_achieved_fusion",
+    "hybrid_unfused_turns",
+    "hybrid_async_hits",
+    "hybrid_async_misses",
     # netobs telemetry keys (drop-cause / retransmit totals + the
     # burst-window histogram buckets — open item 3's evidence base;
     # mixed_window_hist.b* buckets follow in the sorted tail)

@@ -92,6 +92,51 @@ class _HostSideHybrid(CpuEngine):
     file, routing — one source of truth); ``_hybrid_host_init`` then
     strips the lane-covered hosts' host-side state."""
 
+    # -- fused-turn peek/validation primitives (shared with the mp
+    # -- syscall workers; docs/hybrid.md "k-window fusion law") -----------
+
+    def _peek_head_horizon(self, slots: int, hosts=None,
+                           floor_t: int = 0):
+        """The next ``slots - 1`` DISTINCT event times (>= ``floor_t``)
+        across this side's hosts (or an explicit host iterable — the mp
+        parent uses its replica of a worker's partition), plus the
+        horizon — the first time the list does NOT cover (NEVER when
+        exhaustive).  The device must never free-run past the horizon:
+        an uncovered external event could start a window there.  ONE
+        definition shared by the serial dispatch peek, the worker-reply
+        peeks, and the parent's initial partition replicas — the
+        schedules agreeing across replicas is a determinism
+        invariant."""
+        if hosts is None:
+            hosts = self._next_hosts
+        seen = {
+            ev.time for h in hosts for ev in h.queue._heap
+            if ev.time >= floor_t
+        }
+        times = sorted(seen)
+        head = tuple(times[: slots - 1])
+        horizon = times[slots - 1] if len(times) >= slots else NEVER
+        return head, horizon
+
+    def _range_count(self, lo: int, hi: int) -> int:
+        """Number of queued events with ``lo <= t < hi`` — the covered
+        rounds' cleanliness probe: execution only pops events below the
+        window end, so a post-round change in this count means the round
+        CREATED an event inside the still-covered fused span (a window
+        boundary the device could not have known -> rollback).
+
+        Both probes scan the raw heaps — O(total queued events) per
+        covered round, a few hundred entries at the measured scales
+        (syscall service sits at ~5% of wall; see docs/hybrid.md).  If
+        managed hosts ever hold deep timer queues, replace with
+        incremental range counters maintained at push/pop."""
+        n = 0
+        for h in self._next_hosts:
+            for ev in h.queue._heap:
+                if lo <= ev.time < hi:
+                    n += 1
+        return n
+
     def _hybrid_host_init(self) -> None:
         from ..native.process import ManagedApp
         from .tpu_engine import LaneCompatError
@@ -221,7 +266,8 @@ class _HybridWorker(_HostSideHybrid):
 
 
 def _hybrid_worker_main(
-    cfg: ConfigOptions, owned: list[int], record_turns: bool, conn
+    cfg: ConfigOptions, owned: list[int], record_turns: bool,
+    peek_slots: int, conn
 ) -> None:
     """Worker loop: apply shipped deliveries, execute the owned hosts'
     window (syscall servicing — the parallel hot path), sweep staged
@@ -232,7 +278,12 @@ def _hybrid_worker_main(
     participating in this window (events < window_end, taken after the
     shipped deliveries land and before execution — the identical law the
     serial engine applies, so the parent's ledger is worker-count
-    invariant)."""
+    invariant).  When k-window fusion is on (``peek_slots > 0``), the
+    reply additionally carries the cleanliness flag for the shipped
+    validation range (did this round create an event inside the
+    still-covered fused span?) and the partition's refreshed peek
+    schedule, so the parent can bound the next dispatch's k before any
+    further round trip (docs/hybrid.md "k-window fusion law")."""
     engine = _HybridWorker(cfg, owned)
     if cfg.experimental.perf_logging:
         from ..engine.run_control import BufferedPerfLog
@@ -243,10 +294,15 @@ def _hybrid_worker_main(
         while True:
             msg = conn.recv()
             if msg[0] == "round":
-                _, window_end, rows = msg
+                _, window_end, rows, we_final = msg
                 engine.window_end = window_end
                 for t, src, dst, seq, size, payload in rows:
                     engine._apply_delivery_row(t, src, dst, seq, size, payload)
+                probe = we_final > window_end
+                pre_range = (
+                    engine._range_count(window_end, we_final)
+                    if probe else 0
+                )
                 wparts = ()
                 if record_turns:
                     wparts = tuple(
@@ -256,6 +312,10 @@ def _hybrid_worker_main(
                 for h in engine.owned_hosts:
                     h.execute(window_end)
                 engine._barrier_merge()
+                clean = (
+                    not probe
+                    or engine._range_count(window_end, we_final) == pre_range
+                )
                 staged = engine._staged_merged
                 engine._staged_merged = []
                 conn.send((
@@ -265,6 +325,9 @@ def _hybrid_worker_main(
                     engine.perf_log.drain()
                     if engine.perf_log is not None else (),
                     wparts,
+                    clean,
+                    engine._peek_head_horizon(peek_slots)
+                    if peek_slots else (),
                 ))
             elif msg[0] == "finish":
                 engine.finalize()
@@ -337,7 +400,52 @@ class HybridEngine(_HostSideHybrid):
             "egress_reads": 0,      # D2H transfers: egress buffer slices
             "egress_rows": 0,       # delivery rows carried by those reads
             "egress_bytes": 0,      # D2H bytes (padded [span, 6] int64)
+            # k-window fusion + async dispatch (docs/hybrid.md):
+            "fused_dispatches": 0,  # dispatches covering >= 2 validated windows
+            "fused_windows": 0,     # validated windows those covered
+            "turns_saved": 0,       # blocking dispatches fusion eliminated, net
+            "fuse_rollbacks": 0,    # prefix-rebuild dispatches (mispredictions)
+            "async_dispatch_hits": 0,    # eager dispatches adopted at the barrier
+            "async_dispatch_misses": 0,  # eager dispatches discarded (inputs diverged)
         }
+        # k-window free-run fusion knobs (docs/hybrid.md "k-window fusion
+        # law"): fuse_k == 1 keeps the PR 7 one-dispatch-per-participating-
+        # window law bit-for-bit; >= 2 selects the fused kernel variant.
+        exp = cfg.experimental
+        self._fuse_k = max(1, int(exp.hybrid_fuse_k))
+        self._fuse_on = self._fuse_k >= 2
+        self._async_on = self._fuse_on and bool(exp.hybrid_async_dispatch)
+        # peeked-schedule width: enough slots that multi-event windows do
+        # not exhaust the schedule mid-span (last slot = the horizon)
+        self._ext_slots = max(2 * self._fuse_k, 9)
+        self._fuse_we_final = None  # covered-round validation range end
+        self._round_clean = True    # set by _service_round/_mp_round
+        self._eager = None          # double-buffered speculative dispatch
+        # 2-bit saturating adoption predictor for the eager dispatch:
+        # issue for real at >= 2, otherwise record a PHANTOM speculation
+        # (inputs only, no device work) whose would-have-hit outcome
+        # keeps training the predictor — so a cold predictor can re-arm.
+        # Purely an efficiency device: adopted results are bit-equal to
+        # the blocking dispatch, misses are discarded, so the predictor
+        # cannot affect any observable output
+        self._eager_pred = 2
+        # the provable external lookahead (the Chandy-Misra per-source
+        # bound, docs/hybrid.md): the min latency on any edge OUT of a
+        # managed host's node.  A send staged while servicing a covered
+        # window departs inside that window and cannot arrive earlier
+        # than departure + this bound, so a dispatch may cover about
+        # L_ext / runahead windows before speculation even begins
+        self._ext_min_lat: Optional[int] = None
+        if self._fuse_on:
+            from ..net.graph import _UNREACHABLE
+
+            idx = self.node_index
+            ext_nodes = sorted({idx[h.host_id] for h in self.external_hosts})
+            all_nodes = sorted(set(idx.values()))
+            lat = self.graph.latency_ns[np.ix_(ext_nodes, all_nodes)]
+            ok = lat != _UNREACHABLE
+            if ok.any():
+                self._ext_min_lat = int(lat[ok].min())
         # device-turn ledger plumbing (obs/turns.py; all inert when
         # obs/turns are off): per-turn dispatch records buffered between
         # _device_turn and the window law, the round's participant set,
@@ -458,6 +566,49 @@ class HybridEngine(_HostSideHybrid):
         st["egress_bytes"] += span * 6 * 8
         return np.asarray(state.egress[:span])[:count].tolist()
 
+    def _read_egress_obs(self, state, count: int, lost: int,
+                         apply: bool = False):
+        """Egress readback wrapped in the obs ``egress`` span — the span
+        covers the D2H read, plus delivery application when ``apply``
+        (the unfused law's combined semantics, docs/observability.md);
+        the fused walk applies lazily per validated window and passes
+        ``apply=False``.  Empty egress is a no-op read with no span
+        (symmetric with the injection record, no tracer-capacity
+        burn)."""
+        obs = self.obs
+        if obs is None or count == 0:
+            rows = self._read_egress(state, count, lost)
+            if apply:
+                self._apply_egress(rows)
+            return rows
+        with obs.phase("egress", rows=count):
+            rows = self._read_egress(state, count, lost)
+            if apply:
+                self._apply_egress(rows)
+        obs.metrics.count("egress_rows", count)
+        return rows
+
+    def _build_inj(self, staged, inject_fn, state):
+        """Pack staged sends into the injection block.  Oversized
+        staging: overflow blocks dispatch eagerly — JAX's async dispatch
+        overlaps their H2D + queue merge with the host-side packing of
+        the next block.  The injection span covers packing + dispatch;
+        the transfer itself overlaps the device call."""
+        b = self.device.params.inject_batch
+        obs = self.obs
+        t_inj = wall_time.perf_counter() if obs is not None else 0.0
+        n_staged = len(staged)
+        while len(staged) > b:
+            state = inject_fn(state, self._inj_block(staged[:b], b))
+            staged = staged[b:]
+        inj = self._inj_block(staged, b) if staged else self._empty_block()
+        if obs is not None and n_staged:
+            obs.record(
+                "injection", None, t_inj,
+                wall_time.perf_counter() - t_inj, rows=n_staged,
+            )
+        return state, inj, n_staged
+
     def _device_turn(self, state, hybrid_fn, inject_fn, next_host_fn):
         """Inject staged sends, run the device free-run loop, and apply
         egress — retrying while the device paused mid-window to drain a
@@ -470,29 +621,13 @@ class HybridEngine(_HostSideHybrid):
         buffered as ``(dev_we, inject_rows, egress_rows, is_retry)`` for
         the window law to record with its cause — derived purely from
         values this loop reads anyway, zero extra transfers."""
-        p = self.device.params
-        b = p.inject_batch
         st = self.sync_stats
         obs = self.obs
         turns = obs.turns if obs is not None else None
         dispatches = [] if turns is not None else None
         staged = self._staged_merged
         self._staged_merged = []
-        # oversized staging: overflow blocks dispatch eagerly — JAX's
-        # async dispatch overlaps their H2D + queue merge with the
-        # host-side packing of the next block.  The injection span covers
-        # packing + dispatch; the transfer itself overlaps the device call
-        t_inj = wall_time.perf_counter() if obs is not None else 0.0
-        n_staged = len(staged)
-        while len(staged) > b:
-            state = inject_fn(state, self._inj_block(staged[:b], b))
-            staged = staged[b:]
-        inj = self._inj_block(staged, b) if staged else self._empty_block()
-        if obs is not None and n_staged:
-            obs.record(
-                "injection", None, t_inj,
-                wall_time.perf_counter() - t_inj, rows=n_staged,
-            )
+        state, inj, n_staged = self._build_inj(staged, inject_fn, state)
         ext_used = (
             lanes.NEVER32 if self._min_used_lat is None else self._min_used_lat
         )
@@ -539,18 +674,10 @@ class HybridEngine(_HostSideHybrid):
                         t0 + (t1 - t0) / 2,
                     )
             egress_count = int(sc[lanes.HYB_EGRESS_COUNT])
-            if obs is None or egress_count == 0:
-                # empty egress is a no-op read: no span (symmetric with
-                # the injection record, and no tracer-capacity burn)
-                self._apply_egress(self._read_egress(
-                    state, egress_count, int(sc[lanes.HYB_EGRESS_LOST]),
-                ))
-            else:
-                with obs.phase("egress", rows=egress_count):
-                    self._apply_egress(self._read_egress(
-                        state, egress_count, int(sc[lanes.HYB_EGRESS_LOST]),
-                    ))
-                obs.metrics.count("egress_rows", egress_count)
+            self._read_egress_obs(
+                state, egress_count, int(sc[lanes.HYB_EGRESS_LOST]),
+                apply=True,
+            )
             if self.perf_log is not None:
                 self.perf_log.hybrid_agg(
                     "device", dev_we, self.sync_stats
@@ -601,13 +728,427 @@ class HybridEngine(_HostSideHybrid):
                 inject_rows=inj_rows, egress_rows=egr_rows,
             )
 
+    # -- k-window fused turns (docs/hybrid.md "k-window fusion law") ---------
+
+    def _ext_pairs(self, times):
+        """Encode a peeked-time schedule as device (hi, lo) int32 pairs
+        (NEVER maps to the (NEVER32, NEVER32) sentinel pair)."""
+        import jax.numpy as jnp
+
+        t = np.asarray(times, dtype=np.int64)
+        inf = t >= NEVER
+        hi = np.where(inf, lanes.NEVER32, t >> 31).astype(np.int32)
+        lo = np.where(inf, lanes.NEVER32, t & lanes.MASK31).astype(np.int32)
+        # jnp.array COPIES (same aliasing hazard as _inj_block)
+        return jnp.array(hi), jnp.array(lo)
+
+    def _peek_ext_times(self, floor_t: int = 0) -> list:
+        """The fused dispatch's external-event schedule: the next
+        ``_ext_slots - 1`` distinct host-side event times (>= floor_t),
+        padded with the horizon in the trailing slots (ascending, so the
+        device's pointer-advance law stays a prefix count)."""
+        es = self._ext_slots
+        head, horizon = self._peek_head_horizon(es, floor_t=floor_t)
+        head = list(head)
+        return head + [horizon] * (es - len(head))
+
+    def _drop_eager(self) -> None:
+        if self._eager is not None:
+            if self._eager["sc"] is not None:
+                self.sync_stats["async_dispatch_misses"] += 1
+            self._eager = None
+            self._eager_pred = max(self._eager_pred - 1, 0)
+
+    def _fuse_depth(self) -> int:
+        """The per-dispatch fusion depth: the provable external-lookahead
+        bound (windows the law covers before any speculation: a managed
+        send departing in covered window 1 arrives >= L_ext past its
+        start, i.e. about L_ext/runahead windows later) PLUS one
+        speculative window, floored at 3 and capped by
+        ``hybrid_fuse_k``.  The floor is statistical, not provable: the
+        ledger measured ~half of covered rounds staging nothing and
+        staged arrivals landing >= 1.3 windows out (TCP segments ride
+        multi-hop latencies, not the global-min edge), so two windows of
+        speculation pay for their occasional rollback; the validation
+        law makes any depth safe, this only tunes the waste.  Recomputed
+        per dispatch: dynamic runahead moves the bound."""
+        k = self._fuse_k
+        if self._ext_min_lat is not None:
+            ra = self.current_runahead()
+            k = min(k, max(3, self._ext_min_lat // ra + 1))
+        return k
+
+    def _issue_eager(self, fused_fn, state, lane_min: int,
+                     floor_t: int) -> None:
+        """Double-buffered async dispatch: while the covered rounds are
+        serviced host-side, eagerly dispatch the NEXT fused turn under
+        the speculation that they stage nothing and create no event the
+        peek (taken at the covered span's end) does not show.  Resolved
+        at the next dispatch barrier: adopted only when the real
+        dispatch inputs match the speculated ones bit-exact — the
+        provably-empty-injection condition that makes the (otherwise
+        unsound, docs/hybrid.md) double-buffering a pure overlap."""
+        ext = self._peek_ext_times(floor_t)
+        host_next = ext[0]
+        start = min(host_next, lane_min)  # staged-empty speculation
+        if start >= self.stop_time or start == NEVER:
+            return
+        end = min(start + self.current_runahead(), self.stop_time)
+        if lane_min >= end:
+            return  # next window would be host-only: nothing to overlap
+        used_enc = (
+            lanes.NEVER32 if self._min_used_lat is None
+            else self._min_used_lat
+        )
+        k_eff = self._fuse_depth()
+        if self._eager_pred < 2:
+            # cold predictor: record the speculation's inputs WITHOUT
+            # device work — its would-have-hit outcome re-trains the
+            # predictor at the next dispatch
+            self._eager = {
+                "base": state, "ext": ext, "used": used_enc, "k": k_eff,
+                "state": None, "sc": None, "t0": 0.0,
+            }
+            return
+        ehi, elo = self._ext_pairs(ext)
+        t0 = wall_time.perf_counter()
+        state2, scalars = fused_fn(
+            state, ehi, elo, used_enc, self._empty_block(),
+            np.int32(k_eff),
+        )
+        self._eager = {
+            "base": state, "ext": ext, "used": used_enc, "k": k_eff,
+            "state": state2, "sc": scalars, "t0": t0,
+        }
+
+    def _dispatch_fused(self, state, fused_fn, ext, used_enc, inj,
+                        n_staged: int, k_eff: int):
+        """Dispatch (or adopt the eagerly dispatched) fused device call
+        and block on its packed readback.  Adoption requires the real
+        inputs to equal the speculated ones bit-exact: same base state
+        object, same peeked schedule, same dynamic-runahead fold, and an
+        empty injection — then the eager result IS the dispatch result
+        by functional purity, and the readback blocks only for whatever
+        device compute the overlapped syscall servicing did not hide."""
+        st = self.sync_stats
+        e = self._eager
+        state2 = scalars = None
+        t0 = 0.0
+        if e is not None:
+            self._eager = None
+            match = (
+                e["base"] is state and e["ext"] == ext
+                and e["used"] == used_enc and e["k"] == k_eff
+                and n_staged == 0
+            )
+            self._eager_pred = min(self._eager_pred + 1, 3) if match \
+                else max(self._eager_pred - 1, 0)
+            if e["sc"] is None:
+                pass  # phantom speculation: predictor trained, no result
+            elif match:
+                st["async_dispatch_hits"] += 1
+                t0 = e["t0"]
+                state2, scalars = e["state"], e["sc"]
+            else:
+                st["async_dispatch_misses"] += 1
+        if scalars is None:
+            ehi, elo = self._ext_pairs(ext)
+            t0 = wall_time.perf_counter()
+            state2, scalars = fused_fn(
+                state, ehi, elo, used_enc, inj, np.int32(k_eff)
+            )
+        t_b0 = wall_time.perf_counter()
+        sc = jax.device_get(scalars)  # the one blocking readback
+        t1 = wall_time.perf_counter()
+        st["device_sync_s"] += t1 - t_b0
+        st["device_turns"] += 1
+        st["scalar_reads"] += 1
+        return state2, sc, t0, t1
+
+    def _fused_turn(self, state, fused_fn, inject_fn, run_round,
+                    on_window, t_start: int):
+        """One FUSED device turn: dispatch up to ``hybrid_fuse_k``
+        consecutive participating windows in one device call, then
+        service the covered syscall rounds window-by-window under the
+        arrival-frontier validation law:
+
+        - the frontier F starts unbounded; each covered round lowers it
+          to its earliest staged-send arrival, and to its own window end
+          when the round created an event inside the still-covered span
+          or moved the dynamic-runahead fold;
+        - window j+1 is accepted only while ``we_{j+1} <= F`` — a staged
+          arrival at or past the span's remaining windows cannot change
+          their boundaries or contents (it merges at the next dispatch,
+          before the window containing it is computed), so the accepted
+          prefix is bit-identical to the unfused law by construction;
+        - on a misprediction the device ROLLS BACK: one rebuild dispatch
+          from the pre-turn state with ``k_eff`` = the validated prefix
+          reproduces exactly the accepted windows (pure function, same
+          inputs), and the staged injection rides the next turn.
+
+        Egress rows apply lazily per accepted window so a rollback never
+        double-applies a delivery or double-pops a parked payload; the
+        rebuild's egress buffer (all rows below the validated frontier,
+        already applied) is deliberately never read back.  Returns
+        (state, dev_next) like the unfused turn + round sequence."""
+        st = self.sync_stats
+        obs = self.obs
+        turns = obs.turns if obs is not None else None
+        staged = self._staged_merged
+        self._staged_merged = []
+        state, inj, n_staged = self._build_inj(staged, inject_fn, state)
+        is_retry = False
+        prev_we = t_start
+        while True:
+            k_eff = self._fuse_depth()
+            ext = self._peek_ext_times()
+            used_enc = (
+                lanes.NEVER32 if self._min_used_lat is None
+                else self._min_used_lat
+            )
+            checkpoint = state
+            state, sc, t0, t1 = self._dispatch_fused(
+                state, fused_fn, ext, used_enc, inj, n_staged, k_eff
+            )
+            lane_min = int(sc[lanes.HYB_LANE_MIN])
+            dev_we = int(sc[lanes.HYB_DEV_WE])
+            dev_used = int(sc[lanes.HYB_MIN_USED])
+            self._dev_min_used = (
+                None if dev_used >= lanes.NEVER32 else dev_used
+            )
+            k_done = int(sc[lanes.HYB_K_DONE])
+            we_list = [
+                int(sc[lanes.HYB_WE_BASE + i]) for i in range(k_done)
+            ]
+            if obs is not None:
+                obs.record(
+                    "device_turn", None, t0, t1 - t0, window_end=dev_we
+                )
+                obs.metrics.count("device_turns")
+                if (
+                    not is_retry
+                    and self._flow_pending is not None
+                    and turns is not None
+                    and obs.tracer is not None
+                ):
+                    fid, anchor = self._flow_pending
+                    self._flow_pending = None
+                    tr = obs.tracer
+                    tr.flow("s", fid, "turn_cause", "turn_flow", anchor)
+                    tr.flow(
+                        "f", fid, "turn_cause", "turn_flow",
+                        t0 + (t1 - t0) / 2,
+                    )
+            egress_count = int(sc[lanes.HYB_EGRESS_COUNT])
+            rows = self._read_egress_obs(
+                state, egress_count, int(sc[lanes.HYB_EGRESS_LOST])
+            )
+            retry = lane_min < dev_we  # mid-window egress-headroom pause
+            if self._async_on and not retry and we_list:
+                self._issue_eager(fused_fn, state, lane_min, we_list[-1])
+            # ---- the validated servicing walk --------------------------
+            w_valid = 0
+            rounds_run = 0
+            frontier = NEVER
+            pend = rows
+            parts_buf = []
+            for j, we_j in enumerate(we_list):
+                if we_j > frontier:
+                    break  # a staged arrival lands inside this window
+                apply_now = [r for r in pend if int(r[0]) < we_j]
+                if apply_now:
+                    pend = [r for r in pend if int(r[0]) >= we_j]
+                    self._apply_egress(apply_now)
+                if self.next_event_time() < we_j:
+                    rounds_run += 1
+                    pre_len = len(self._staged_merged)
+                    pre_mul = self._min_used_lat
+                    self.window_end = we_j
+                    self._fuse_we_final = we_list[-1]
+                    try:
+                        run_round(we_j)
+                    finally:
+                        self._fuse_we_final = None
+                    if turns is not None:
+                        parts_buf.append(self._last_participants)
+                    new = self._staged_merged[pre_len:]
+                    if new:
+                        a = min(int(e[0]) for e in new)
+                        if a < frontier:
+                            frontier = a
+                    if not self._round_clean or (
+                        pre_mul != self._min_used_lat
+                    ):
+                        # the round created an event inside the covered
+                        # span, or moved the dynamic-runahead fold:
+                        # later window boundaries are unreproducible
+                        frontier = min(frontier, we_j)
+                w_valid = j + 1
+                if on_window is not None:
+                    on_window(prev_we, we_j, self.next_event_time())
+                prev_we = we_j
+            rolled = w_valid < k_done
+            if rolled:
+                # misprediction: rebuild the validated prefix from the
+                # checkpoint (same inputs + k_eff = prefix -> the prefix
+                # windows reproduce bit-identically); the original
+                # dispatch's unapplied egress rows are discarded (the
+                # rows its invalidated windows generated must not land)
+                # and the staged injection rides the next turn
+                if self._eager is not None:
+                    # the eager speculation rode the invalidated
+                    # timeline — discard it without training the
+                    # predictor: its miss signals "rollback", not "the
+                    # next injection will not be empty"
+                    if self._eager["sc"] is not None:
+                        st["async_dispatch_misses"] += 1
+                    self._eager = None
+                st["fuse_rollbacks"] += 1
+                if w_valid >= 2:
+                    st["fused_dispatches"] += 1
+                    st["fused_windows"] += w_valid
+                st["turns_saved"] += w_valid - 2
+                # the rebuild dispatch goes through the same timed
+                # dispatch/readback bookkeeping as a primary dispatch
+                # (the eager buffer was dropped above, so no adoption)
+                state, sc_r, t0r, t1r = self._dispatch_fused(
+                    checkpoint, fused_fn, ext, used_enc, inj, n_staged,
+                    w_valid,
+                )
+                assert int(sc_r[lanes.HYB_K_DONE]) == w_valid, (
+                    "fused prefix rebuild diverged from the original "
+                    "dispatch (determinism violation)"
+                )
+                lane_min = int(sc_r[lanes.HYB_LANE_MIN])
+                dev_we = int(sc_r[lanes.HYB_DEV_WE])
+                dev_used = int(sc_r[lanes.HYB_MIN_USED])
+                self._dev_min_used = (
+                    None if dev_used >= lanes.NEVER32 else dev_used
+                )
+                if obs is not None:
+                    obs.record(
+                        "device_turn", None, t0r, t1r - t0r,
+                        window_end=dev_we,
+                    )
+                    obs.metrics.count("device_turns")
+                # the rebuild regenerated the validated prefix
+                # bit-identically, so its egress buffer holds exactly
+                # the prefix-generated rows; those at or past the last
+                # validated window end never passed the walk's apply
+                # filter (down-bucket/CoDel queueing delays t_deliver
+                # into the invalidated span) — apply them now, like the
+                # validated path's trailing pend rows.  Invalidated-
+                # window rows exist only in the original buffer and
+                # stay dropped: the rebuilt device state still carries
+                # their packets in flight
+                egr_r = int(sc_r[lanes.HYB_EGRESS_COUNT])
+                rows_r = self._read_egress_obs(
+                    state, egr_r, int(sc_r[lanes.HYB_EGRESS_LOST])
+                )
+                late = [
+                    r for r in rows_r
+                    if int(r[0]) >= we_list[w_valid - 1]
+                ]
+                if late:
+                    self._apply_egress(late)
+                if turns is not None:
+                    self._ledger_fused_rows(
+                        turns, t_start, dev_we, w_valid, n_staged,
+                        egress_count, is_retry, parts_buf, rollback=True,
+                        rollback_egr=egr_r, rounds_run=rounds_run,
+                    )
+                return state, lane_min
+            # ---- span fully validated ----------------------------------
+            if k_done >= 2:
+                st["fused_dispatches"] += 1
+                st["fused_windows"] += k_done
+                st["turns_saved"] += k_done - 1
+            if turns is not None:
+                self._ledger_fused_rows(
+                    turns, t_start, dev_we, w_valid, n_staged,
+                    egress_count, is_retry, parts_buf, rollback=False,
+                    rounds_run=rounds_run,
+                )
+            if pend:
+                # trailing rows: deliveries of the in-progress (retry) or
+                # post-span windows — host events the next dispatch's
+                # peek schedule folds
+                self._apply_egress(pend)
+            if self.perf_log is not None:
+                self.perf_log.hybrid_agg("device", dev_we, self.sync_stats)
+            if not retry:
+                return state, lane_min
+            # drain continuation: the device paused mid-window for
+            # egress headroom; covered rounds may have staged — repack
+            # and resume (the cached empty block keeps a stage-free
+            # resume transfer-free)
+            staged = self._staged_merged
+            self._staged_merged = []
+            state, inj, n_staged = self._build_inj(staged, inject_fn, state)
+            is_retry = True
+            t_start = prev_we
+
+    def _ledger_fused_rows(self, turns, t_start, t_end, w_valid,
+                           inj_rows, egr_rows, is_retry, parts_buf,
+                           rollback, rollback_egr=0, rounds_run=0):
+        """Record one fused dispatch's ledger rows (docs/observability.md)
+        under the PR 11 cause precedence (injection > host_window >
+        free_run): a dispatch that carried staged rows is an
+        ``injection`` row even when fused — the unfused law would have
+        blocked for it, and labeling it ``free_run`` would inflate
+        ``strict_free_turns`` and the remaining free-run headroom the
+        ``hybrid_fuse_warn_fraction`` soft check compares against; an
+        injection-free dispatch covering >= 2 validated windows is a
+        ``free_run`` row.  Either way ``windows`` carries the coverage
+        (the fused accounting keys off it, not the cause).
+        Single-window dispatches keep the full PR 11 law —
+        ``host_window`` only when the window's round actually ran,
+        matching the unfused law's ``host_in`` test (a passive-inline
+        delivery consumes no round and stays a strict ``free_run``); a
+        prefix rebuild adds a ``rollback`` row with ``windows=0`` so the
+        conservation law counts every dispatch while the implied-unfused
+        accounting counts covered windows once."""
+        if inj_rows:
+            cause = "injection"
+        elif w_valid >= 2:
+            cause = "free_run"
+        elif w_valid == 1 and rounds_run:
+            cause = "host_window"
+        elif is_retry and not w_valid:
+            cause = "egress_drain"
+        else:
+            cause = "free_run"
+        turns.turn(
+            cause, t_start, t_end, windows=max(w_valid, 1),
+            inject_rows=inj_rows, egress_rows=egr_rows,
+        )
+        for parts in parts_buf:
+            if parts:
+                turns.attach_participants(parts)
+        if rollback:
+            # the rebuild's egress re-read (prefix rows re-fetched to
+            # recover post-span deliveries) rides the rollback row so
+            # ledger egress_rows_total keeps matching the engine's
+            # D2H row accounting
+            turns.turn(
+                "rollback", t_start, t_end, windows=0,
+                egress_rows=rollback_egr,
+            )
+
     # -- the hybrid round loop ----------------------------------------------
 
     def _service_round(self, scheduler, until: int) -> None:
         """One host-side syscall-service round + barrier, timed into
-        sync_stats (and per-window through the perf log / obs spans)."""
+        sync_stats (and per-window through the perf log / obs spans).
+        Inside a fused span (``_fuse_we_final`` set past the window) the
+        round also runs the cleanliness probe: a changed event count in
+        ``[until, we_final)`` means the round created an event inside the
+        still-covered span — the fused-turn walk rolls back there."""
         t0 = wall_time.perf_counter()
         obs = self.obs
+        wf = self._fuse_we_final
+        probe = wf is not None and wf > until
+        pre_range = self._range_count(until, wf) if probe else 0
         if obs is not None and obs.turns is not None:
             # the turn ledger's participant set, taken BEFORE execution
             # mutates the queues: managed hosts with events inside the
@@ -619,6 +1160,9 @@ class HybridEngine(_HostSideHybrid):
             )
         scheduler.run_round(until)
         self._barrier_merge()
+        self._round_clean = (
+            not probe or self._range_count(until, wf) == pre_range
+        )
         t1 = wall_time.perf_counter()
         self.sync_stats["syscall_service_s"] += t1 - t0
         if obs is not None:
@@ -660,11 +1204,16 @@ class HybridEngine(_HostSideHybrid):
         """The hybrid window law, shared verbatim by the serial engine
         and the multiprocess controller: only the round executor differs
         (``run_round(until)`` = threaded scheduler round vs worker-pipe
-        round).  Returns the final device state for collection."""
+        round).  Returns the final device state for collection.
+
+        ``hybrid_fuse_k >= 2`` swaps in the k-window fused law
+        (docs/hybrid.md); at 1 this loop IS the PR 7 law, bit-for-bit,
+        including the transfer pattern."""
+        if self._fuse_on:
+            return self._window_loop_fused(run_round, on_window)
         dev = self.device
         state = dev.initial_state()
-        hybrid_fn = lanes.make_hybrid_fn(dev.params, dev.tables)
-        inject_fn = lanes.make_inject_fn(dev.params, dev.tables)
+        hybrid_fn, inject_fn = dev.make_hybrid_fns()
         dev_next = min(
             (t for (_lane, t, *_rest) in dev._init_events), default=NEVER
         )
@@ -706,6 +1255,74 @@ class HybridEngine(_HostSideHybrid):
             if on_window is not None:
                 on_window(start, end, self.next_event_time())
 
+    def _window_loop_fused(self, run_round, on_window):
+        """The k-window fused hybrid window law: the same outer loop as
+        ``_window_loop`` with device turns delegated to ``_fused_turn``
+        (one dispatch covers up to ``hybrid_fuse_k`` participating
+        windows; covered rounds are serviced and validated post-hoc) and
+        the double-buffered eager dispatch resolving at adoption
+        barriers.  Host-only windows, the dynamic-runahead law, and the
+        staged-send fold are untouched — the fusion is a pure scheduling
+        change (tests/test_hybrid_fusion.py pins bit-parity with the CPU
+        oracle and the unfused engine)."""
+        dev = self.device
+        state = dev.initial_state()
+        fused_fn, inject_fn = dev.make_hybrid_fns(
+            self._fuse_k, self._ext_slots
+        )
+        dev_next = min(
+            (t for (_lane, t, *_rest) in dev._init_events), default=NEVER
+        )
+        turns = self.obs.turns if self.obs is not None else None
+        while True:
+            host_next = self.next_event_time()
+            staged_min = min(
+                (e[0] for e in self._staged_merged), default=NEVER
+            )
+            dev_eff = min(dev_next, staged_min)
+            start = min(host_next, dev_eff)
+            if start >= self.stop_time or start == NEVER:
+                self._drop_eager()
+                return state
+            end = min(start + self.current_runahead(), self.stop_time)
+            if self._staged_merged or dev_eff < end:
+                state, dev_next = self._fused_turn(
+                    state, fused_fn, inject_fn, run_round, on_window,
+                    start,
+                )
+                continue
+            # host-only window (device idle beyond it, nothing staged):
+            # an outstanding eager dispatch assumed a device window next
+            # and cannot match — discard before the round runs
+            self._drop_eager()
+            self.window_end = end
+            run_round(end)
+            if turns is not None:
+                turns.host_round()
+            self.host_rounds += 1
+            if on_window is not None:
+                on_window(start, end, self.next_event_time())
+
+    def _check_fusion_accounting(self) -> None:
+        """End-of-run ledger cross-check (ISSUE 13 satellite): the
+        fused-turn accounting must conserve — ``turns + turns_saved``
+        equals the unfused turn count implied by the cause rows — and
+        the achieved collapse is compared against the ledger's remaining
+        free-run headroom prediction (warn, never fail, below the
+        configured fraction)."""
+        obs = self.obs
+        if obs is None or obs.turns is None:
+            return
+        from ..obs import turns as tmod
+
+        tmod.check_fusion_accounting(
+            obs.turns, self.sync_stats,
+            warn_fraction=(
+                self.cfg.experimental.hybrid_fuse_warn_fraction
+                if self._fuse_on else None
+            ),
+        )
+
     def netobs_snapshot(self):
         """The combined telemetry plane: host-side counters (managed
         hosts' sends, loopback, throttles) summed with the device-side
@@ -734,6 +1351,7 @@ class HybridEngine(_HostSideHybrid):
         state = self._window_loop(
             lambda until: self._service_round(scheduler, until), on_window
         )
+        self._check_fusion_accounting()
         self.finalize()
         wall = wall_time.perf_counter() - t0
 
@@ -812,23 +1430,32 @@ class MpHybridEngine(HybridEngine):
 
     def _mp_round(self, window_end: int) -> None:
         """One parallel syscall-service round: ship (window_end, delivery
-        rows) to every worker, collect (next_t, staged sends, min-used
-        latency) — a single pipe message each way per worker.  Workers
-        execute concurrently between the two loops; staged sends merge in
-        (worker-id, host-id) order, which the device queue merge's total
-        key makes order-invariant anyway."""
+        rows, validation range) to every worker, collect (next_t, staged
+        sends, min-used latency, cleanliness, peeked schedule) — a single
+        pipe message each way per worker.  Workers execute concurrently
+        between the two loops; staged sends merge in (worker-id, host-id)
+        order, which the device queue merge's total key makes
+        order-invariant anyway.  Inside a fused span the workers run the
+        cleanliness probe over their owned partition and ship their
+        refreshed peek schedules, so the parent's next-event folds arrive
+        early enough to bound the next dispatch's k."""
         t0 = wall_time.perf_counter()
         obs = self.obs
         conns, _procs = self._mp
+        wf = self._fuse_we_final
         for w, conn in enumerate(conns):
-            conn.send(("round", window_end, self._pending_rows[w]))
+            conn.send((
+                "round", window_end, self._pending_rows[w],
+                wf if wf is not None else window_end,
+            ))
             self._pending_rows[w] = []
         t_ship = wall_time.perf_counter()
         staged = self._staged_merged
         perf_lines: list[str] = []
         parts_all: list[int] = []
+        clean = True
         for w, conn in enumerate(conns):
-            next_t, out, mul, wlines, wparts = conn.recv()
+            next_t, out, mul, wlines, wparts, wclean, wpeek = conn.recv()
             self._eff_next[w] = next_t
             if mul is not None and (
                 self._min_used_lat is None or mul < self._min_used_lat
@@ -839,6 +1466,10 @@ class MpHybridEngine(HybridEngine):
                 perf_lines.extend(wlines)
             if wparts:
                 parts_all.extend(wparts)
+            clean = clean and wclean
+            if wpeek:
+                self._worker_peeks[w] = wpeek
+        self._round_clean = clean
         t1 = wall_time.perf_counter()
         self.sync_stats["syscall_service_s"] += t1 - t0
         if obs is not None and obs.turns is not None:
@@ -870,6 +1501,50 @@ class MpHybridEngine(HybridEngine):
             self.perf_log.emit_many(perf_lines)
         if self.perf_log is not None:
             self.perf_log.hybrid_agg("host", window_end, self.sync_stats)
+
+    def _peek_partition(self, owned):
+        """A worker partition's initial (head, horizon) peek from the
+        parent replica — literally the worker's ``_peek_head_horizon``
+        law over its owned hosts (deterministic construction makes the
+        replicas agree)."""
+        return self._peek_head_horizon(
+            self._ext_slots, [self.hosts[i] for i in owned]
+        )
+
+    def _peek_ext_times(self, floor_t: int = 0) -> list:
+        """Merge the workers' shipped peek schedules: distinct times
+        below the tightest worker horizon, padded with the merged
+        horizon.  A worker's horizon marks where ITS schedule knowledge
+        ends; beyond the min of all horizons the parent knows nothing,
+        so the merged schedule must stop there too.
+
+        Deliveries the parent has APPLIED but not yet shipped (trailing
+        egress rows queued in ``_pending_rows`` for the next round
+        message) are events the workers' schedules cannot know about yet
+        — fold their times in directly, or the fused dispatch could
+        free-run past a pending host event the serial law (which reads
+        the queues) would have bounded."""
+        if self._eff_next is None:
+            return super()._peek_ext_times(floor_t)
+        es = self._ext_slots
+        merged: set = set()
+        wh = NEVER
+        for head, hz in self._worker_peeks:
+            for t in head:
+                if t >= floor_t:
+                    merged.add(t)
+            if hz < wh:
+                wh = hz
+        for rows in self._pending_rows:
+            for t, _src, dst, _seq, _size, payload in rows:
+                if t >= floor_t and not (
+                    payload is None and self.hosts[dst].passive_delivery
+                ):
+                    merged.add(t)
+        times = sorted(t for t in merged if t < wh)
+        head = times[: es - 1]
+        horizon = times[es - 1] if len(times) >= es else wh
+        return head + [horizon] * (es - len(head))
 
     def netobs_snapshot(self):
         """Worker-merged host arrays + device arrays (the window
@@ -910,19 +1585,26 @@ class MpHybridEngine(HybridEngine):
             hid: w for w, part in enumerate(parts) for hid in part
         }
         record_turns = self.obs is not None and self.obs.turns is not None
+        peek_slots = self._ext_slots if self._fuse_on else 0
         conns, procs = spawn_cpu_workers(
             _hybrid_worker_main,
-            [(self.cfg, owned, record_turns) for owned in parts],
+            [(self.cfg, owned, record_turns, peek_slots)
+             for owned in parts],
         )
         self._mp = (conns, procs)
         self._pending_rows = [[] for _ in range(self.workers)]
         # initial next-event times from the parent replica (identical
-        # deterministic construction — no startup round trip needed)
+        # deterministic construction — no startup round trip needed);
+        # same for the fused path's initial per-worker peek schedules
         self._eff_next = [
             min((self.hosts[i].queue.next_time() for i in owned),
                 default=NEVER)
             for owned in parts
         ]
+        if self._fuse_on:
+            self._worker_peeks = [
+                self._peek_partition(owned) for owned in parts
+            ]
         t0 = wall_time.perf_counter()
         try:
             return self._mp_loop(on_window, t0)
@@ -938,6 +1620,7 @@ class MpHybridEngine(HybridEngine):
     def _mp_loop(self, on_window, t0) -> SimResult:
         conns, _procs = self._mp
         state = self._window_loop(self._mp_round, on_window)
+        self._check_fusion_accounting()
 
         event_log: list = []
         counters: dict[str, int] = {}

@@ -3289,3 +3289,205 @@ def make_inject_fn(p: LaneParams, tb: LaneTables):
         return _inject_merge(p, tb, s, inj)
 
     return jax.jit(inject)
+
+
+# fused-readback layout (make_hybrid_fused_fn): slots 0..4 are the HYB_*
+# indices above, then the consumed-window count and the per-window ends
+HYB_K_DONE = 5
+HYB_WE_BASE = 6
+
+
+def _build_hybrid_fused_run(p: LaneParams, tb: LaneTables, k_cap: int,
+                            ext_slots: int):
+    """The k-window FUSED hybrid device call (docs/hybrid.md "k-window
+    fusion law"): the identical window law to :func:`_build_hybrid_run`,
+    but instead of returning at the FIRST window with external
+    participation, the loop consumes up to ``k_eff`` participating
+    windows from a host-provided schedule of peeked next-event times,
+    recording each consumed window's end for the post-hoc host round
+    servicing (the arrival-frontier validation law lives host-side in
+    backend/hybrid.py; a misprediction rolls back by re-running this
+    kernel from the pre-dispatch state with ``k_eff`` = the validated
+    prefix, which reproduces the prefix bit-identically).
+
+    ``ext_times`` ([ext_slots] int32 hi/lo pairs, ascending) carries the
+    host side's next distinct event times; the LAST slot is the
+    **horizon** — the first external time the schedule does NOT cover
+    (NEVER when the schedule is exhaustive).  Participation at or past
+    the horizon ends the dispatch without consuming, so the device never
+    free-runs past an external event it was not told about.  Between
+    consumed windows the ``egress_min`` free-run guard is RE-ARMED as the
+    min pending DELIVERED egress time at or past the consumed frontier —
+    the running-min law of the single-window kernel generalized to a
+    popped fold, so an unserviced host delivery keeps bounding the
+    window law exactly as the oracle's DELIVERY event would.
+
+    Returns (state, scalars[6 + k_cap] int64): the HYB_* slots, the
+    consumed-window count (HYB_K_DONE), and the consumed window ends
+    (HYB_WE_BASE + i).  With ``k_eff = 1`` the dispatch is input- and
+    output-equivalent to :func:`_build_hybrid_run` (the PR 7 law)."""
+    iter_fn = _build_iter(p, tb, pure_dataflow=True)
+    stop_hi, stop_lo = p.stop_time >> 31, p.stop_time & MASK31
+    room_floor = p.egress_capacity - p.ext_per_iter
+    eg_idx = jnp.arange(p.egress_capacity, dtype=jnp.int32)
+    never64 = (NEVER32 << 31) | NEVER32  # the (NEVER32, NEVER32) pair
+
+    def ext_bound(st, ext_hi, ext_lo):
+        lt = pair_lt(ext_hi, ext_lo, st.egress_min_hi, st.egress_min_lo)
+        return (
+            jnp.where(lt, ext_hi, st.egress_min_hi),
+            jnp.where(lt, ext_lo, st.egress_min_lo),
+        )
+
+    def egress_refold(st, thr_hi, thr_lo):
+        """Min pending DELIVERED egress time >= the consumed frontier:
+        rows below it were applied host-side with their windows."""
+        t = st.egress[:, 0]
+        thr = t_join(thr_hi, thr_lo)
+        live = (
+            (eg_idx < st.egress_count)
+            & (st.egress[:, 5] == DELIVERED)
+            & (t >= thr)
+        )
+        tmin = jnp.min(jnp.where(live, t, jnp.int64(never64)))
+        return (tmin >> 31).astype(jnp.int32), (
+            tmin & MASK31
+        ).astype(jnp.int32)
+
+    def fused_run(s: LaneState, ext_thi, ext_tlo, ext_used, inj, k_eff):
+        ext_thi = jnp.asarray(ext_thi, dtype=jnp.int32)
+        ext_tlo = jnp.asarray(ext_tlo, dtype=jnp.int32)
+        k_eff = jnp.asarray(k_eff, dtype=jnp.int32)
+        if p.dynamic_runahead:
+            s = s._replace(
+                min_used_lat=jnp.minimum(
+                    s.min_used_lat, jnp.asarray(ext_used, dtype=jnp.int32)
+                )
+            )
+        # previous call's egress was consumed by the host
+        s = s._replace(
+            egress_count=jnp.int32(0), egress_lost=jnp.int32(0),
+            egress_min_hi=jnp.int32(NEVER32),
+            egress_min_lo=jnp.int32(NEVER32),
+        )
+        s = _inject_merge(p, tb, s, inj)
+        horizon_hi, horizon_lo = ext_thi[ext_slots - 1], ext_tlo[ext_slots - 1]
+
+        def inner(pk, ptr):
+            """One fused segment: the single-window kernel's while loop
+            verbatim, bounded by the current schedule slot."""
+            e_hi = ext_thi[jnp.minimum(ptr, ext_slots - 1)]
+            e_lo = ext_tlo[jnp.minimum(ptr, ext_slots - 1)]
+
+            def cond(carry):
+                st = unpack_state(carry)
+                mh, ml = _queue_min(p, st)
+                in_window = pair_lt(mh, ml, st.now_we_hi, st.now_we_lo)
+                bh, bl = ext_bound(st, e_hi, e_lo)
+                host_in_cur = pair_lt(bh, bl, st.now_we_hi, st.now_we_lo)
+                nsh, nsl = pair_sel(pair_lt(mh, ml, bh, bl), mh, ml, bh, bl)
+                fresh_ok = (~host_in_cur) & pair_lt(nsh, nsl, stop_hi, stop_lo)
+                room = st.egress_count < room_floor
+                return room & (in_window | fresh_ok)
+
+            def body(carry):
+                st = unpack_state(carry)
+                mn_hi, mn_lo = _queue_min(p, st)
+                bh, bl = ext_bound(st, e_hi, e_lo)
+                mn_hi, mn_lo = pair_sel(
+                    pair_lt(mn_hi, mn_lo, bh, bl), mn_hi, mn_lo, bh, bl
+                )
+                live = pair_lt(mn_hi, mn_lo, stop_hi, stop_lo)
+                fresh = pair_ge(mn_hi, mn_lo, st.now_we_hi, st.now_we_lo) & live
+                if p.netobs:
+                    st = _flush_hist(p, st, fresh)
+                c_hi, c_lo = pair_sel(live, mn_hi, mn_lo, stop_hi, stop_lo)
+                c_hi, c_lo = pair_add32(c_hi, c_lo, _effective_runahead(p, st))
+                c_hi, c_lo = pair_sel(
+                    pair_lt(c_hi, c_lo, stop_hi, stop_lo),
+                    c_hi, c_lo, stop_hi, stop_lo,
+                )
+                st = st._replace(
+                    now_we_hi=jnp.where(fresh, c_hi, st.now_we_hi),
+                    now_we_lo=jnp.where(fresh, c_lo, st.now_we_lo),
+                    rounds=st.rounds + fresh.astype(st.rounds.dtype),
+                )
+                return pack_state(iter_fn(st))
+
+            pk2 = lax.while_loop(cond, body, pk)
+            return pk2, e_hi, e_lo
+
+        def seg_cond(carry):
+            _pk, _ptr, _kd, _we, run = carry
+            return run
+
+        def seg_body(carry):
+            pk, ptr, kd, we_arr, _run = carry
+            pk, e_hi, e_lo = inner(pk, ptr)
+            st = unpack_state(pk)
+            mh, ml = _queue_min(p, st)
+            in_window = pair_lt(mh, ml, st.now_we_hi, st.now_we_lo)
+            room = st.egress_count < room_floor
+            bh, bl = ext_bound(st, e_hi, e_lo)
+            host_in_cur = pair_lt(bh, bl, st.now_we_hi, st.now_we_lo)
+            # a consumable participation lies strictly below the horizon:
+            # at or past it the host's schedule ran out — return instead
+            below_h = pair_lt(bh, bl, horizon_hi, horizon_lo)
+            consume = host_in_cur & room & (~in_window) & below_h
+            we64 = t_join(st.now_we_hi, st.now_we_lo)
+            we_arr = jnp.where(
+                consume,
+                we_arr.at[jnp.minimum(kd, k_cap - 1)].set(we64),
+                we_arr,
+            )
+            kd2 = kd + consume.astype(jnp.int32)
+            # advance the schedule pointer past times the consumed window
+            # covered (its round will execute them host-side)
+            done_t = pair_lt(ext_thi, ext_tlo, st.now_we_hi, st.now_we_lo)
+            ptr2 = jnp.where(
+                consume, jnp.sum(done_t, dtype=jnp.int32), ptr
+            )
+            # re-arm the free-run guard for the next segment
+            ref_hi, ref_lo = egress_refold(st, st.now_we_hi, st.now_we_lo)
+            st2 = st._replace(
+                egress_min_hi=jnp.where(consume, ref_hi, st.egress_min_hi),
+                egress_min_lo=jnp.where(consume, ref_lo, st.egress_min_lo),
+            )
+            run2 = consume & (kd2 < k_eff)
+            return (pack_state(st2), ptr2, kd2, we_arr, run2)
+
+        carry = (
+            pack_state(s), jnp.int32(0), jnp.int32(0),
+            jnp.zeros((k_cap,), dtype=jnp.int64), jnp.bool_(True),
+        )
+        pk, _ptr, kd, we_arr, _run = lax.while_loop(
+            seg_cond, seg_body, carry
+        )
+        s = unpack_state(pk)
+        lane_min = t_join(*_queue_min(p, s))
+        scalars = jnp.concatenate([
+            jnp.stack([
+                lane_min,
+                t_join(s.now_we_hi, s.now_we_lo),
+                (s.min_used_lat if p.dynamic_runahead
+                 else jnp.int32(NEVER32)).astype(jnp.int64),
+                s.egress_count.astype(jnp.int64),
+                s.egress_lost.astype(jnp.int64),
+                kd.astype(jnp.int64),
+            ]),
+            we_arr,
+        ])
+        return s, scalars
+
+    return fused_run
+
+
+def make_hybrid_fused_fn(p: LaneParams, tb: LaneTables, k_cap: int,
+                         ext_slots: int):
+    """Jitted k-window fused hybrid device call: (state, ext_times_hi,
+    ext_times_lo, ext_used_lat, inject_block, k_eff) -> (state,
+    scalars[6 + k_cap] int64) — the HYB_* slots plus HYB_K_DONE and the
+    consumed window ends at HYB_WE_BASE + i.  ``k_cap`` and ``ext_slots``
+    are static (array widths); ``k_eff`` is a traced scalar, so varying
+    the per-dispatch fusion depth never recompiles."""
+    return jax.jit(_build_hybrid_fused_run(p, tb, k_cap, ext_slots))

@@ -590,6 +590,28 @@ class TpuEngine:
             return p.runahead
         return max(used, max(p.runahead_floor, 1))
 
+    # -- hybrid kernel variants --------------------------------------------
+
+    def make_hybrid_fns(self, fuse_k: int = 1, ext_slots: int = 0):
+        """The hybrid backend's jitted device entry points, built against
+        this engine's params/tables: ``(turn_fn, inject_fn)``.
+
+        ``fuse_k == 1`` returns the single-window law
+        (:func:`lanes.make_hybrid_fn` signature); ``fuse_k >= 2`` returns
+        the k-window fused variant (:func:`lanes.make_hybrid_fused_fn`,
+        docs/hybrid.md "k-window fusion law") whose dispatch covers up to
+        ``fuse_k`` participating windows against a host-peeked
+        ``ext_slots``-wide event-time schedule."""
+        inject_fn = lanes.make_inject_fn(self.params, self.tables)
+        if fuse_k >= 2:
+            return (
+                lanes.make_hybrid_fused_fn(
+                    self.params, self.tables, fuse_k, ext_slots
+                ),
+                inject_fn,
+            )
+        return lanes.make_hybrid_fn(self.params, self.tables), inject_fn
+
     # -- state construction ------------------------------------------------
 
     def initial_state(self) -> lanes.LaneState:

@@ -145,6 +145,25 @@ class ExperimentalOptions:
     # injection block rows per device turn (B): staged managed-host sends
     # coalesce into blocks of this size for the host->device hop
     tpu_inject_batch: int = 512
+    # k-window free-run fusion on the hybrid path (docs/hybrid.md
+    # "k-window fusion law"): one device dispatch may cover up to this
+    # many consecutive host-participating windows, with the covered
+    # syscall rounds serviced post-hoc under the arrival-frontier
+    # validation law (rollback to the validated prefix on a late staged
+    # injection).  1 disables fusion — the exact PR 7 one-dispatch-per-
+    # participating-window law, bit-for-bit.
+    hybrid_fuse_k: int = 8
+    # double-buffered async dispatch (hybrid, requires fusion): when the
+    # next fused turn's injection is provably empty so far, dispatch it
+    # eagerly and overlap syscall servicing with device compute,
+    # resolving (adopt or discard) at the readback barrier.  The
+    # UNCONDITIONAL version is unsound (docs/hybrid.md); this one only
+    # adopts a result whose inputs were validated bit-exact.
+    hybrid_async_dispatch: bool = True
+    # fusion-effectiveness floor: warn (never fail) when the achieved
+    # turn collapse falls below this fraction of the ledger's remaining
+    # kfusion_headroom_freerun prediction (obs_turns runs only)
+    hybrid_fuse_warn_fraction: float = 0.5
 
 
 @dataclasses.dataclass
@@ -392,6 +411,8 @@ class ConfigOptions:
                         )
                     elif isinstance(current, int):
                         value = int(value)
+                    elif isinstance(current, float):
+                        value = float(value)
             setattr(target, field, value)
 
     def validate(self) -> None:
@@ -415,6 +436,12 @@ class ConfigOptions:
                     f"host {h.hostname!r}: congestion must be reno|cubic, "
                     f"got {h.congestion!r}"
                 )
+        if self.experimental.hybrid_fuse_k < 1:
+            raise ConfigError("experimental.hybrid_fuse_k must be >= 1")
+        if not 0.0 <= self.experimental.hybrid_fuse_warn_fraction <= 1.0:
+            raise ConfigError(
+                "experimental.hybrid_fuse_warn_fraction must be in [0, 1]"
+            )
         if self.experimental.interface_qdisc not in ("fifo", "round-robin"):
             raise ConfigError(
                 "experimental.interface_qdisc must be fifo|round-robin, "

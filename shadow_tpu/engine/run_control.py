@@ -28,7 +28,10 @@ Observability extensions (shadow_tpu/obs/, docs/observability.md):
   (per-host counters, drop causes, burst-window histogram — the netobs
   plane of obs/netobs.py); with a hostname, that host's counter row too
 - ``turns``          print the device-turn ledger snapshot (turn-cause
-  counts, fusable-run percentiles, k-fusion headroom — obs/turns.py)
+  counts, fusable-run percentiles, k-fusion headroom, and the REALIZED
+  fusion stats — fused dispatches, windows covered, turns saved,
+  rollbacks — so a paused session can confirm the k-window fusion law
+  is engaging; obs/turns.py)
 - ``trace``          tracer status; ``trace on|off`` toggles recording;
   ``trace dump [path]`` exports the Chrome trace collected so far
 
@@ -344,7 +347,11 @@ class RunControl:
 
     def _cmd_turns(self) -> None:
         """``turns``: the device-turn ledger snapshot (obs/turns.py) —
-        turn-cause counts, fusable-run percentiles, k-fusion headroom."""
+        turn-cause counts, fusable-run percentiles, k-fusion headroom,
+        and the realized fused-run stats (dispatches, windows covered,
+        turns saved, rollbacks) — live at any pause point, so a session
+        can confirm fusion is engaging without waiting for the TURNS
+        artifact."""
         turns = getattr(self._obs, "turns", None)
         if turns is None:
             self._print(

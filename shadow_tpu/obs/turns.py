@@ -82,15 +82,23 @@ unchanged with the ledger on).
 from __future__ import annotations
 
 import json
+import logging
 from pathlib import Path
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 from .netobs import HIST_BUCKETS as _NETOBS_HIST_BUCKETS
 from .netobs import hist_bucket as _hist_bucket
 
 SCHEMA_VERSION = 1
 
-#: the turn-cause taxonomy, in report order (docs/observability.md)
+#: the turn-cause taxonomy, in report order (docs/observability.md).
+#: ``rollback`` (PR 13) marks a fused-prefix rebuild dispatch: a k-window
+#: fused turn whose speculation failed validation re-ran its validated
+#: prefix from the checkpoint — the dispatch is real (counted by the
+#: conservation law) but covers no windows the primary row did not
+#: already account for (``windows=0``)
 CAUSES = (
     "host_window",
     "injection",
@@ -98,6 +106,7 @@ CAUSES = (
     "snapshot",
     "fault_swap",
     "free_run",
+    "rollback",
 )
 
 #: causes carrying NO managed participation at all — the strict 1(a)
@@ -160,6 +169,13 @@ class TurnLedger:
         self._run_sample: list[int] = []
         self._open_run = 0
         self._finished = False
+        # realized-fusion accounting (PR 13): windows_covered_total is
+        # the unfused turn count the rows imply (every non-rollback row
+        # counts max(windows, 1)); fused rows are dispatches that
+        # covered >= 2 validated windows
+        self.windows_covered_total = 0
+        self.fused_turns = 0
+        self.fused_windows_total = 0
 
     # -- recording ---------------------------------------------------------
 
@@ -180,7 +196,15 @@ class TurnLedger:
         self.cause_counts[cause] += 1
         self.inject_rows_total += inject_rows
         self.egress_rows_total += egress_rows
-        if inject_rows == 0:
+        if cause != "rollback":
+            # rollback rebuilds re-run windows their primary row already
+            # covers: they count as turns (conservation) but neither as
+            # fusable evidence nor toward the implied-unfused total
+            self.windows_covered_total += max(int(windows), 1)
+            if int(windows) >= 2:
+                self.fused_turns += 1
+                self.fused_windows_total += int(windows)
+        if inject_rows == 0 and cause != "rollback":
             self.empty_injection_turns += 1
         for hid in participants:
             self.participation[int(hid)] = (
@@ -200,9 +224,10 @@ class TurnLedger:
             strict = True
         else:
             strict = False
-        if cause != "egress_drain":
-            # a turn's PRIMARY row (resumptions are never primary):
-            # attach_participants retro-corrects this one
+        if cause not in ("egress_drain", "rollback"):
+            # a turn's PRIMARY row (resumptions and rollback rebuilds
+            # are never primary): attach_participants retro-corrects
+            # this one
             self._last_primary_idx = len(self.rows) - 1 if stored else None
             self._last_primary_strict = strict
         if inject_rows == 0:
@@ -218,17 +243,20 @@ class TurnLedger:
         hosts that participated in its completed window (the
         multiprocess hybrid engine learns the set from the worker round
         replies, *after* the turn rows are recorded; egress-drain
-        resumption rows cover participation-free partial windows and are
-        never amended).  Participation retro-corrects the strict
-        free-turn count; the fusable (empty-injection) run is unaffected
-        — participation alone does not force an injection."""
+        resumption and rollback rows cover participation-free or
+        re-run windows and are never amended).  A fused turn attaches
+        once per covered round: the row accumulates the sorted union.
+        Participation retro-corrects the strict free-turn count; the
+        fusable (empty-injection) run is unaffected — participation
+        alone does not force an injection."""
         participants = tuple(int(h) for h in participants)
         if not participants:
             return
         for hid in participants:
             self.participation[hid] = self.participation.get(hid, 0) + 1
         if self._last_primary_idx is not None:
-            self.rows[self._last_primary_idx][6] = list(participants)
+            row = self.rows[self._last_primary_idx]
+            row[6] = sorted(set(row[6]) | set(participants))
         if self._last_primary_strict:
             self.strict_free_turns -= 1
             self._last_primary_strict = False
@@ -296,6 +324,21 @@ class TurnLedger:
             self.turns / max(self.turns - self.strict_free_turns, 1), 4
         )
 
+    def turns_saved(self) -> int:
+        """Blocking dispatches the realized fusion eliminated, NET of
+        rollback rebuilds: the unfused law would have spent one dispatch
+        per covered window (``windows_covered_total``); the fused run
+        spent ``turns`` (rebuilds included).  0 on unfused runs."""
+        return self.windows_covered_total - self.turns
+
+    def achieved_fusion(self) -> float:
+        """The realized turn collapse: implied unfused turns per actual
+        dispatch — the achieved counterpart of the kfusion_headroom
+        predictions (1.0 when fusion is off or ineffective)."""
+        if not self.turns:
+            return 1.0
+        return round(self.windows_covered_total / self.turns, 4)
+
     def summary(self) -> dict:
         """Aggregates only (live-safe: includes the open run without
         closing it) — what bench.py and the ``turns`` verb read."""
@@ -318,6 +361,12 @@ class TurnLedger:
             "fusable_run_max": max(self.run_max, self._open_run),
             "kfusion_headroom": self.kfusion_headroom(),
             "kfusion_headroom_freerun": self.kfusion_headroom_freerun(),
+            "fused_turns": self.fused_turns,
+            "fused_windows_total": self.fused_windows_total,
+            "implied_unfused_turns": self.windows_covered_total,
+            "turns_saved": self.turns_saved(),
+            "achieved_fusion": self.achieved_fusion(),
+            "rollbacks": self.cause_counts["rollback"],
         }
 
     def report(self, run_id: str) -> dict:
@@ -354,6 +403,14 @@ class TurnLedger:
             },
             "kfusion_headroom": self.kfusion_headroom(),
             "kfusion_headroom_freerun": self.kfusion_headroom_freerun(),
+            "fused": {
+                "turns": self.fused_turns,
+                "windows_total": self.fused_windows_total,
+                "implied_unfused_turns": self.windows_covered_total,
+                "turns_saved": self.turns_saved(),
+                "achieved_fusion": self.achieved_fusion(),
+                "rollbacks": self.cause_counts["rollback"],
+            },
             "rows_dropped": self.rows_dropped,
             "rows": [list(r) for r in self.rows],
         }
@@ -379,6 +436,11 @@ class TurnLedger:
             f"k-fusion headroom: {s['kfusion_headroom']}x speculative "
             f"(empty injection), {s['kfusion_headroom_freerun']}x "
             "provable (free-run)",
+            f"fused runs: {s['fused_turns']} dispatch(es) covering "
+            f"{s['fused_windows_total']} window(s), "
+            f"{s['turns_saved']} turn(s) saved, "
+            f"{s['rollbacks']} rollback(s); achieved "
+            f"{s['achieved_fusion']}x collapse",
         ]
         if not s["turns"]:
             return ["no device turns recorded yet"]
@@ -394,6 +456,61 @@ def write_report(path: str | Path, report: dict) -> Path:
 
 def load_report(path: str | Path) -> dict:
     return json.loads(Path(path).read_text())
+
+
+def check_fusion_accounting(
+    ledger: "TurnLedger", sync_stats: dict,
+    warn_fraction: Optional[float] = None,
+) -> None:
+    """The fused-turn conservation cross-check (ISSUE 13 satellite),
+    run by the hybrid engines at end of run when the ledger is on:
+
+    1. HARD: the engine's independently-counted ``turns_saved`` must
+       agree with the ledger aggregates, and ``turns`` plus that engine
+       count must equal the unfused turn count recomputed from the
+       cause rows themselves — the aggregate ``turns + turns_saved ==
+       implied`` identity holds by construction (``turns_saved`` IS
+       ``windows_covered - turns``), so the engine counter and the
+       per-row recompute are the two independent sides that can
+       actually catch a mis-recorded dispatch;
+    2. SOFT: the achieved collapse should reach ``warn_fraction`` of the
+       ledger's REMAINING free-run headroom prediction — if fusion
+       silently disengages, rows revert to the unfused pattern, the
+       remaining headroom climbs while achieved collapses to 1.0, and
+       this warns (never fails)."""
+    saved = ledger.turns_saved()
+    engine_saved = sync_stats.get("turns_saved", 0)
+    if engine_saved != saved:
+        raise AssertionError(
+            "fused-turn accounting drift: engine counted "
+            f"turns_saved={engine_saved} but the ledger aggregates "
+            f"imply {saved}"
+        )
+    if not ledger.rows_dropped:
+        # recompute the implied-unfused total from the rows themselves —
+        # independent of both the aggregate counters and the engine's
+        # turns_saved, so a dispatch recorded with a drifted
+        # windows/cause value cannot self-consistently hide
+        implied_rows = sum(
+            max(r[3], 1) for r in ledger.rows if r[0] != "rollback"
+        )
+        if ledger.turns + engine_saved != implied_rows:
+            raise AssertionError(
+                f"fused-turn conservation violated: turns="
+                f"{ledger.turns} + engine turns_saved={engine_saved} "
+                f"!= {implied_rows} unfused turns implied by the rows"
+            )
+    if warn_fraction:
+        predicted = ledger.kfusion_headroom_freerun()
+        achieved = ledger.achieved_fusion()
+        if achieved < warn_fraction * predicted:
+            log.warning(
+                "k-window fusion underperforming: achieved %.2fx "
+                "collapse vs %.2fx remaining free-run headroom "
+                "(floor fraction %.2f) — check hybrid_fuse_k and the "
+                "scenario's external lookahead",
+                achieved, predicted, warn_fraction,
+            )
 
 
 def check_conservation(report: dict) -> Optional[str]:
