@@ -7,7 +7,7 @@
 
 .PHONY: test gate native smoke-faults smoke-examples lint-determinism \
 	bench-hybrid obs-smoke netobs-smoke turns-smoke fusion-smoke \
-	bench-report check-fixtures
+	checkpoint-smoke chaos-smoke bench-report check-fixtures
 
 test: native
 	python -m pytest tests/ -q
@@ -26,6 +26,8 @@ gate: native check-fixtures lint-determinism
 	$(MAKE) netobs-smoke
 	$(MAKE) turns-smoke
 	$(MAKE) fusion-smoke
+	$(MAKE) checkpoint-smoke
+	$(MAKE) chaos-smoke
 
 # Runtime fixture dirs (hermdir/, shadow.data/, pytest caches) are
 # .gitignore'd; a force-add or an ignore regression would commit
@@ -98,6 +100,21 @@ turns-smoke: native
 # conservation law green (docs/hybrid.md "k-window fusion law").
 fusion-smoke: native
 	JAX_PLATFORMS=cpu python scripts/fusion_smoke.py
+
+# Crash-safety smoke for the gate: the checkpoint -> resume ->
+# byte-compare round trip on the cpu and tpu backends through the CLI,
+# with every retained checkpoint passing the checkpoint-inspect
+# validator (docs/robustness.md "deterministic replay from the newest
+# valid state").
+checkpoint-smoke:
+	JAX_PLATFORMS=cpu python scripts/checkpoint_smoke.py
+
+# Kill-a-worker chaos smoke for the gate: the flagship mesh on the
+# 4-worker MpCpuEngine with a seeded mid-run SIGKILL (respawn + journal
+# replay, byte-identical) and a repeated-hang escalation to the serial
+# oracle (also byte-identical) — docs/robustness.md "supervision model".
+chaos-smoke:
+	JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
 # Regenerate docs/bench-trajectory.md from the BENCH_r0N.json artifacts.
 bench-report:

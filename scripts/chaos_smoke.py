@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Kill-a-worker chaos smoke at gate scale (``make chaos-smoke``, wired
+into ``make gate``; docs/robustness.md "supervision model").
+
+The flagship tgen mesh on the 4-worker MpCpuEngine, three times:
+
+1. clean — the parallel baseline, checked against the serial oracle
+   (the parallelism-invariance law);
+2. chaos — a seeded-random worker is SIGKILLed mid-run; the supervisor
+   respawns it and replays its round journal, and the event log plus
+   counters must byte-match the clean run (``worker_restarts == 1``);
+3. escalation — the same worker hangs again after every respawn, the
+   restart budget exhausts, and the engine falls back to the serial
+   oracle from t=0 — still byte-identical.
+
+Exit 0 = all assertions hold; any failure raises (nonzero exit).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+N_HOSTS = 24
+SIM_SECONDS = 2
+WORKERS = 4
+
+
+def _cfg():
+    from shadow_tpu.config.presets import flagship_mesh_config
+
+    return flagship_mesh_config(
+        N_HOSTS, sim_seconds=SIM_SECONDS, backend="cpu"
+    )
+
+
+def main() -> int:
+    from shadow_tpu.backend.cpu_engine import CpuEngine
+    from shadow_tpu.backend.cpu_mp import MpCpuEngine
+
+    serial = CpuEngine(_cfg()).run()
+
+    clean_eng = MpCpuEngine(_cfg(), workers=WORKERS)
+    clean = clean_eng.run()
+    assert clean.log_tuples() == serial.log_tuples(), (
+        "parallel baseline diverged from the serial oracle"
+    )
+    assert clean_eng.worker_restarts == 0
+
+    rng = random.Random(16)  # the seeded chaos schedule
+    wid = rng.randrange(WORKERS)
+    t_kill = rng.randrange(
+        SIM_SECONDS * 250, SIM_SECONDS * 750
+    ) * 1_000_000  # mid-run, ns
+    os.environ["SHADOW_TPU_TEST_WORKER_KILL"] = f"{wid}:{t_kill}"
+    try:
+        chaos_eng = MpCpuEngine(_cfg(), workers=WORKERS)
+        chaos = chaos_eng.run()
+    finally:
+        del os.environ["SHADOW_TPU_TEST_WORKER_KILL"]
+    assert chaos_eng.worker_restarts == 1, chaos_eng.worker_restarts
+    assert not chaos_eng.escalated
+    assert chaos.log_tuples() == clean.log_tuples(), (
+        "SIGKILL recovery diverged from the clean run"
+    )
+    assert chaos.counters == clean.counters
+
+    os.environ["SHADOW_TPU_TEST_WORKER_HANG"] = f"{wid}:{t_kill}"
+    try:
+        esc_cfg = _cfg()
+        esc_cfg.experimental.worker_restart_max = 1
+        # generous deadline: first-round replies at gate scale carry
+        # worker spawn + world build and must not trip a false positive
+        esc_cfg.experimental.worker_heartbeat_s = 5.0
+        esc_eng = MpCpuEngine(esc_cfg, workers=WORKERS)
+        esc = esc_eng.run()
+    finally:
+        del os.environ["SHADOW_TPU_TEST_WORKER_HANG"]
+    assert esc_eng.escalated, "hang did not escalate to serial"
+    assert esc.log_tuples() == clean.log_tuples(), (
+        "escalate-to-serial replay diverged"
+    )
+
+    print(
+        f"chaos-smoke OK: {N_HOSTS}-host mesh, {WORKERS} workers — "
+        f"SIGKILL worker {wid} at {t_kill} ns recovered bit-identically "
+        f"(1 respawn); repeated hang escalated to the serial oracle "
+        "bit-identically"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--network-backend": "experimental.network_backend",
         "--runahead": "experimental.runahead",
         "--tpu-mesh-shape": "experimental.tpu_mesh_shape",
+        "--resume": "experimental.resume_from",
+        "--checkpoint-every-windows": "experimental.checkpoint_every_windows",
+        "--checkpoint-dir": "experimental.checkpoint_dir",
     }
     for flag, key in flag_map.items():
         p.add_argument(flag, dest=key, default=None, metavar="V")
@@ -172,9 +175,21 @@ def main(argv: list[str] | None = None) -> int:
         print(report.describe(), file=sys.stderr)
         return 0 if report.identical else 1
 
+    from shadow_tpu.engine.checkpoint import GracefulShutdown
+
     sim = Simulation(cfg)
     try:
         result = sim.run()
+    except GracefulShutdown as g:
+        # SIGINT/SIGTERM: the run stopped cleanly at a window boundary
+        # (final checkpoint written, artifacts flushed, workers reaped);
+        # exit 75 (EX_TEMPFAIL) marks the run as resumable
+        print(
+            f"graceful shutdown (signal {g.signum}): resume with "
+            "--resume <checkpoint>",
+            file=sys.stderr,
+        )
+        return GracefulShutdown.EXIT_CODE
     except Exception as e:  # surface backend errors with a nonzero exit
         print(f"simulation failed: {e}", file=sys.stderr)
         return 1

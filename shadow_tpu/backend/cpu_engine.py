@@ -124,6 +124,22 @@ class Host:
     _ledger_managed = False
     _ledger_sends = 0
 
+    # -- checkpoint pickling (engine/checkpoint.py) ------------------------
+    # the inbox lock is the one unpicklable object in the engine's
+    # transitive state graph; at a checkpoint boundary the inbox is
+    # empty and no worker threads are live, so drop it and recreate
+
+    def __getstate__(self) -> dict:
+        d = self.__dict__.copy()
+        d.pop("inbox_lock", None)
+        return d
+
+    def __setstate__(self, d: dict) -> None:
+        import threading
+
+        self.__dict__.update(d)
+        self.inbox_lock = threading.Lock()
+
     # -- HostApi ----------------------------------------------------------
 
     @property
@@ -388,6 +404,59 @@ class CpuEngine:
             from ..faults.overlay import build_fault_runtime
 
             self.faults = build_fault_runtime(cfg, self.graph, self.routing)
+
+    # -- checkpointing (engine/checkpoint.py) ------------------------------
+    # The engine's whole state graph is host-picklable (cloudpickle for
+    # the app-closure Tasks in the event queue) except for facade-owned
+    # attachments: obs and perf_log carry locks/streams and belong to
+    # the *run*, not the simulation state — the facade re-attaches them
+    # on resume.  run() performs no state reset, so a restored engine's
+    # run() continues the simulation exactly where the checkpoint left
+    # it (docs/robustness.md "resume law").
+
+    def __getstate__(self) -> dict:
+        d = self.__dict__.copy()
+        d["obs"] = None
+        d["perf_log"] = None
+        return d
+
+    def checkpoint_unsupported_reason(self) -> Optional[str]:
+        """None when this engine's state is fully serializable; else the
+        reason checkpoints must stay off (managed OS processes hold
+        kernel state, pcap writers hold open streams)."""
+        from ..native.process import ManagedApp
+
+        if any(
+            isinstance(a, ManagedApp) for h in self.hosts for a in h.apps
+        ):
+            return ("managed (real-binary) processes hold live OS state"
+                    " that cannot be snapshotted")
+        if any(h.pcap is not None for h in self.hosts):
+            return "pcap capture streams cannot be snapshotted"
+        return None
+
+    def checkpoint_payload(self) -> bytes:
+        """Serialize the complete simulation state (hosts, event queue,
+        RNG counters, transport stacks, fault runtime, event log) as
+        one cloudpickle blob."""
+        import cloudpickle
+
+        reason = self.checkpoint_unsupported_reason()
+        if reason is not None:
+            raise RuntimeError(f"checkpoint unsupported: {reason}")
+        return cloudpickle.dumps(self)
+
+    @staticmethod
+    def from_checkpoint(blob: bytes) -> "CpuEngine":
+        import cloudpickle
+
+        engine = cloudpickle.loads(blob)
+        if not isinstance(engine, CpuEngine):
+            raise RuntimeError(
+                f"checkpoint payload is {type(engine).__name__},"
+                " not a CpuEngine"
+            )
+        return engine
 
     # -- netobs telemetry plane (obs/netobs.py) ----------------------------
 

@@ -57,6 +57,7 @@ surfaced per window through the perf-log plumbing when
 
 from __future__ import annotations
 
+import logging
 import os
 import time as wall_time
 from typing import Optional
@@ -68,10 +69,13 @@ from ..config.options import ConfigOptions
 from ..core import time as stime
 from ..core.event import Event, EventKind
 from ..core.event_queue import EventQueue
+from ..engine.supervisor import recv_with_deadline, worker_recv
 from . import lanes
 from .cpu_engine import DELIVERED, CpuEngine, Delivery, Host, SimResult
 
 NEVER = stime.NEVER
+
+log = logging.getLogger("shadow_tpu.hybrid")
 
 
 def config_has_managed(cfg: ConfigOptions) -> bool:
@@ -292,7 +296,10 @@ def _hybrid_worker_main(
     finished = False
     try:
         while True:
-            msg = conn.recv()
+            # poll-sliced recv: a dead/vanished parent EOFs instead of
+            # blocking forever, so the finally below still reaps the
+            # managed OS processes this worker launched (no orphans)
+            msg = worker_recv(conn)
             if msg[0] == "round":
                 _, window_end, rows, we_final = msg
                 engine.window_end = window_end
@@ -347,6 +354,10 @@ def _hybrid_worker_main(
                 return
             else:  # pragma: no cover - protocol error
                 return
+    except (EOFError, OSError):
+        # parent tore the pipe down (normal teardown after an error on
+        # its side, or parent death): exit quietly — the finally reaps
+        return
     finally:
         if not finished:
             # abnormal teardown (parent died / raised): still reap the
@@ -407,11 +418,38 @@ class HybridEngine(_HostSideHybrid):
             "fuse_rollbacks": 0,    # prefix-rebuild dispatches (mispredictions)
             "async_dispatch_hits": 0,    # eager dispatches adopted at the barrier
             "async_dispatch_misses": 0,  # eager dispatches discarded (inputs diverged)
+            "dispatch_retries": 0,  # failed fused dispatches re-dispatched
         }
         # k-window free-run fusion knobs (docs/hybrid.md "k-window fusion
         # law"): fuse_k == 1 keeps the PR 7 one-dispatch-per-participating-
         # window law bit-for-bit; >= 2 selects the fused kernel variant.
         exp = cfg.experimental
+        # dispatch retry-with-backoff law (docs/robustness.md): a failed
+        # fused device dispatch re-dispatches from the pre-turn device
+        # checkpoint (purity makes the retry bit-identical) up to this
+        # many times before escalating to the watchdog/failover boundary
+        self._dispatch_retry_max = max(0, int(exp.dispatch_retry_max))
+        # injected backend_stall support (docs/faults.md): the hybrid
+        # window loop raises BackendStallError when the sim clock crosses
+        # the earliest scheduled stall — the facade's failover boundary
+        # then replays on the CPU engine (managed hosts run there
+        # natively).  Other fault kinds stay gated off this backend.
+        self._stall_after = NEVER
+        if cfg.faults.events:
+            from .tpu_engine import LaneCompatError
+
+            sched = cfg.faults.schedule()
+            stalls = [
+                ev.at for ev in sched.events if ev.kind == "backend_stall"
+            ]
+            if len(stalls) != len(sched.events):
+                raise LaneCompatError(
+                    "only backend_stall fault events are supported on the "
+                    "hybrid tpu backend; use the cpu backend for "
+                    "link/host fault schedules"
+                )
+            if stalls:
+                self._stall_after = min(stalls)
         self._fuse_k = max(1, int(exp.hybrid_fuse_k))
         self._fuse_on = self._fuse_k >= 2
         self._async_on = self._fuse_on and bool(exp.hybrid_async_dispatch)
@@ -865,6 +903,49 @@ class HybridEngine(_HostSideHybrid):
         st["scalar_reads"] += 1
         return state2, sc, t0, t1
 
+    def _dispatch_retrying(self, checkpoint, fused_fn, ext, used_enc, inj,
+                           n_staged: int, k_eff: int):
+        """The dispatch retry-with-backoff law (docs/robustness.md): a
+        failed fused dispatch (device runtime error raised at dispatch or
+        at the blocking readback) re-dispatches from the pre-turn device
+        checkpoint — ``fused_fn`` is pure, so a successful retry is
+        bit-identical to a first-try success — with exponential backoff,
+        up to ``experimental.dispatch_retry_max`` times.  Exhausted
+        retries escalate to the watchdog/failover boundary as
+        :class:`BackendStallError`; an injected stall passes through
+        untouched (retrying an injected fault would defeat the test)."""
+        from ..faults.watchdog import BackendStallError
+
+        attempt = 0
+        while True:
+            try:
+                return self._dispatch_fused(
+                    checkpoint, fused_fn, ext, used_enc, inj, n_staged,
+                    k_eff,
+                )
+            except BackendStallError:
+                raise
+            except Exception as e:
+                attempt += 1
+                # any outstanding speculation rode the failed timeline
+                self._drop_eager()
+                if attempt > self._dispatch_retry_max:
+                    raise BackendStallError(
+                        f"fused device dispatch failed after "
+                        f"{attempt - 1} retr"
+                        f"{'y' if attempt - 1 == 1 else 'ies'}: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
+                self.sync_stats["dispatch_retries"] += 1
+                backoff = min(0.05 * 2 ** (attempt - 1), 1.0)
+                log.warning(
+                    "fused dispatch failed (%s: %s); re-dispatching from "
+                    "the pre-turn checkpoint in %.2fs (attempt %d/%d)",
+                    type(e).__name__, e, backoff, attempt,
+                    self._dispatch_retry_max,
+                )
+                wall_time.sleep(backoff)
+
     def _fused_turn(self, state, fused_fn, inject_fn, run_round,
                     on_window, t_start: int):
         """One FUSED device turn: dispatch up to ``hybrid_fuse_k``
@@ -907,7 +988,7 @@ class HybridEngine(_HostSideHybrid):
                 else self._min_used_lat
             )
             checkpoint = state
-            state, sc, t0, t1 = self._dispatch_fused(
+            state, sc, t0, t1 = self._dispatch_retrying(
                 state, fused_fn, ext, used_enc, inj, n_staged, k_eff
             )
             lane_min = int(sc[lanes.HYB_LANE_MIN])
@@ -1011,7 +1092,7 @@ class HybridEngine(_HostSideHybrid):
                 # the rebuild dispatch goes through the same timed
                 # dispatch/readback bookkeeping as a primary dispatch
                 # (the eager buffer was dropped above, so no adoption)
-                state, sc_r, t0r, t1r = self._dispatch_fused(
+                state, sc_r, t0r, t1r = self._dispatch_retrying(
                     checkpoint, fused_fn, ext, used_enc, inj, n_staged,
                     w_valid,
                 )
@@ -1200,6 +1281,22 @@ class HybridEngine(_HostSideHybrid):
             self.finalize()
             raise
 
+    def _maybe_stall(self, start: int) -> None:
+        """Raise the injected ``backend_stall`` once the sim clock
+        crosses its epoch (same law as the TPU step driver): the facade's
+        failover boundary catches it and replays on the CPU engine, where
+        the managed hosts run natively."""
+        if start >= self._stall_after:
+            from ..faults.watchdog import BackendStallError
+
+            epoch = self._stall_after
+            self._stall_after = NEVER  # raise once
+            self._drop_eager()
+            raise BackendStallError(
+                f"injected backend stall at {epoch} ns "
+                "(fault schedule backend_stall event)"
+            )
+
     def _window_loop(self, run_round, on_window):
         """The hybrid window law, shared verbatim by the serial engine
         and the multiprocess controller: only the round executor differs
@@ -1227,6 +1324,7 @@ class HybridEngine(_HostSideHybrid):
             start = min(host_next, dev_eff)
             if start >= self.stop_time or start == NEVER:
                 return state
+            self._maybe_stall(start)
             end = min(start + self.current_runahead(), self.stop_time)
             if self._staged_merged or dev_eff < end:
                 # device turn: complete every window up to (and including)
@@ -1284,6 +1382,7 @@ class HybridEngine(_HostSideHybrid):
             if start >= self.stop_time or start == NEVER:
                 self._drop_eager()
                 return state
+            self._maybe_stall(start)
             end = min(start + self.current_runahead(), self.stop_time)
             if self._staged_merged or dev_eff < end:
                 state, dev_next = self._fused_turn(
@@ -1403,6 +1502,14 @@ class MpHybridEngine(HybridEngine):
         self._eff_next: Optional[list[int]] = None
         self._pending_rows: Optional[list[list]] = None
         self._owner_of: dict[int, int] = {}
+        # supervision (engine/supervisor.py): deadline-bounded pipe reads
+        # so a dead or hung worker surfaces as a diagnostic
+        # WorkerDiedError instead of an indefinite hang.  No respawn on
+        # this backend — workers hold live managed OS processes whose
+        # kernel state cannot be resnapshotted — so a worker death
+        # escalates straight to the facade's failover boundary.
+        self._heartbeat_s = float(cfg.experimental.worker_heartbeat_s)
+        self._round_no = 0
 
     # -- controller-side bookkeeping ---------------------------------------
 
@@ -1441,7 +1548,8 @@ class MpHybridEngine(HybridEngine):
         early enough to bound the next dispatch's k."""
         t0 = wall_time.perf_counter()
         obs = self.obs
-        conns, _procs = self._mp
+        conns, procs = self._mp
+        self._round_no += 1
         wf = self._fuse_we_final
         for w, conn in enumerate(conns):
             conn.send((
@@ -1455,7 +1563,12 @@ class MpHybridEngine(HybridEngine):
         parts_all: list[int] = []
         clean = True
         for w, conn in enumerate(conns):
-            next_t, out, mul, wlines, wparts, wclean, wpeek = conn.recv()
+            next_t, out, mul, wlines, wparts, wclean, wpeek = (
+                recv_with_deadline(
+                    conn, procs[w], self._heartbeat_s, w, self._round_no,
+                    "round",
+                )
+            )
             self._eff_next[w] = next_t
             if mul is not None and (
                 self._min_used_lat is None or mul < self._min_used_lat
@@ -1618,7 +1731,7 @@ class MpHybridEngine(HybridEngine):
                     p.terminate()
 
     def _mp_loop(self, on_window, t0) -> SimResult:
-        conns, _procs = self._mp
+        conns, procs = self._mp
         state = self._window_loop(self._mp_round, on_window)
         self._check_fusion_accounting()
 
@@ -1629,9 +1742,12 @@ class MpHybridEngine(HybridEngine):
         self._worker_nb = None
         for conn in conns:
             conn.send(("finish",))
-        for conn in conns:
-            log, cnt, per, errs, wsnap = conn.recv()
-            event_log.extend(log)
+        for w, conn in enumerate(conns):
+            wlog, cnt, per, errs, wsnap = recv_with_deadline(
+                conn, procs[w], self._heartbeat_s, w, self._round_no,
+                "finish",
+            )
+            event_log.extend(wlog)
             for k, v in cnt.items():
                 counters[k] = counters.get(k, 0) + v
             for hid, c in per.items():

@@ -246,13 +246,25 @@ class TpuEngine:
         self._watchdog_timeout = cfg.faults.watchdog_timeout
         if cfg.faults.events:
             if ext_mask.any():
-                raise LaneCompatError(
-                    "fault schedules are not supported on the hybrid tpu "
-                    "backend; use the cpu backend"
-                )
-            from ..faults.overlay import build_overlay
+                # hybrid backend: backend_stall-only schedules are owned
+                # by the hybrid window loop (backend/hybrid.py raises at
+                # the stall epoch for the failover boundary to catch) —
+                # no overlay tables to build.  Link/host fault schedules
+                # stay gated off the device lane tables.
+                if any(
+                    ev.get("kind") != "backend_stall"
+                    for ev in cfg.faults.events
+                ):
+                    raise LaneCompatError(
+                        "link/host fault schedules are not supported on "
+                        "the hybrid tpu backend; use the cpu backend"
+                    )
+            else:
+                from ..faults.overlay import build_overlay
 
-            self._fault_overlay = build_overlay(cfg, self.graph, self.routing)
+                self._fault_overlay = build_overlay(
+                    cfg, self.graph, self.routing
+                )
 
         capacity = cfg.experimental.tpu_lane_queue_capacity
         if cfg.experimental.tpu_cross_capacity < 0:
@@ -787,7 +799,8 @@ class TpuEngine:
 
     def run(
         self, mode: str = "device", precompile: bool = False, on_window=None,
-        cache_salt: int = 0,
+        cache_salt: int = 0, resume_state=None, resume_epoch: int = 0,
+        disarm_stalls: bool = False,
     ) -> SimResult:
         """``mode='device'``: one fused while_loop on the accelerator;
         ``mode='step'``: one device call per round (debuggable, pausable —
@@ -799,15 +812,32 @@ class TpuEngine:
         (a NEVER-keyed empty slot's aux word — never popped, dropped by
         the first merge, zero effect on results) so repeat timings cannot
         be served from the tunneled runtime's cross-process execution
-        cache, which keys on (program, input buffers)."""
+        cache, which keys on (program, input buffers).
+        ``resume_state``/``resume_epoch``: continue from a checkpointed
+        lane state (engine/checkpoint.py) — the lane pytree carries the
+        whole simulation, so running it to stop_time reproduces the
+        uninterrupted run's suffix exactly.  ``disarm_stalls`` skips
+        injected ``backend_stall`` raises on the faulted path: the
+        checkpoint-anchored failover resume must replay *through* the
+        epoch that killed the first attempt."""
+        if resume_state is not None and (precompile or cache_salt):
+            raise LaneCompatError(
+                "precompile/cache_salt are bench affordances; they are "
+                "not supported together with checkpoint resume"
+            )
         if self._fault_overlay is not None:
             if precompile or cache_salt:
                 raise LaneCompatError(
                     "precompile/cache_salt are bench affordances; they are "
                     "not supported together with a fault schedule"
                 )
-            return self._run_faulted(mode, on_window=on_window)
-        state = self.initial_state()
+            return self._run_faulted(
+                mode, on_window=on_window, resume_state=resume_state,
+                resume_epoch=resume_epoch, disarm_stalls=disarm_stalls,
+            )
+        state = (
+            resume_state if resume_state is not None else self.initial_state()
+        )
         self._iters_salt = 0
         if cache_salt:
             state = state._replace(
@@ -860,6 +890,21 @@ class TpuEngine:
             )
         return result
 
+    def checkpoint_payload(self):
+        """The live lane state as a host-side (numpy) pytree — the whole
+        simulation (queues, clocks, RNG counters, flows, device log) in
+        one NamedTuple, directly picklable and directly feedable back
+        into ``run(resume_state=...)``.  Only meaningful from the step
+        driver's ``on_window`` seam, where the handle is post-round
+        (see ``_drive_steps``)."""
+        state = getattr(self, "_live_state", None)
+        if state is None:
+            raise RuntimeError(
+                "no live lane state to checkpoint (the step driver has"
+                " not completed a round yet)"
+            )
+        return jax.device_get(state)
+
     def _drive_steps(
         self, round_fn, state: lanes.LaneState, on_window, p: lanes.LaneParams,
         first_cause: str = "snapshot",
@@ -904,6 +949,13 @@ class TpuEngine:
             t_round = wall_time.perf_counter()
             state, done = round_fn(state)
             done = bool(done)  # forces the device sync the timing needs
+            # refresh the live-state handle POST-round: netobs_lines and
+            # checkpoint capture both read it at on_window time, when the
+            # obs accumulators already reflect this round — a stale
+            # pre-round handle would desynchronize a checkpoint's lane
+            # state from its obs state (one window double-counted on
+            # resume)
+            self._live_state = state
             t_done = wall_time.perf_counter()
             if wd is not None:
                 wd.observe(t_done - t_round)
@@ -968,13 +1020,23 @@ class TpuEngine:
             )
         return self.tables._replace(**kw)
 
-    def _run_faulted(self, mode: str, on_window=None) -> SimResult:
+    def _run_faulted(
+        self, mode: str, on_window=None, resume_state=None,
+        resume_epoch: int = 0, disarm_stalls: bool = False,
+    ) -> SimResult:
         """Run the simulation segmented at fault epochs: each segment is
         an ordinary (fused or step-wise) run whose stop time is the next
         epoch, against that epoch's tables.  Windows therefore never
         straddle a fault — the identical clamp law the CPU engine applies
         — and the lane state (queues, buckets, RNG counters, flows)
-        carries across segments untouched."""
+        carries across segments untouched.
+
+        Resume (engine/checkpoint.py): segments whose end lies at or
+        before ``resume_epoch`` already happened inside ``resume_state``
+        and are skipped; the first live segment continues from the
+        resumed state mid-segment.  Its first ledger row records as
+        ``snapshot`` — the segment's ``fault_swap`` row predates the
+        checkpoint and lives in the restored ledger."""
         import dataclasses as _dc
 
         from ..faults.watchdog import BackendStallError
@@ -982,7 +1044,8 @@ class TpuEngine:
         ov = self._fault_overlay
         stop = self.params.stop_time
         bounds = [t for t in ov.epoch_times() if 0 < t < stop] + [stop]
-        state = self.initial_state()
+        resumed = resume_state is not None
+        state = resume_state if resumed else self.initial_state()
         self._iters_salt = 0
         fns = getattr(self, "_seg_fns", None)
         if fns is None:
@@ -990,9 +1053,13 @@ class TpuEngine:
         t0 = wall_time.perf_counter()
         seg_start = 0
         turns = self.obs.turns if self.obs is not None else None
-        seg_rounds = 0
+        seg_rounds = int(np.asarray(state.rounds)) if resumed else 0
+        first_live = True
         for seg_end in bounds:
-            if seg_start > 0 and ov.stall_at(seg_start):
+            if resumed and seg_end <= resume_epoch:
+                seg_start = seg_end  # the checkpoint already covers it
+                continue
+            if seg_start > 0 and not disarm_stalls and ov.stall_at(seg_start):
                 raise BackendStallError(
                     f"injected backend stall at {seg_start} ns "
                     "(fault schedule backend_stall event)"
@@ -1002,6 +1069,12 @@ class TpuEngine:
             p = _dc.replace(self.params, stop_time=seg_end)
             key = (seg_start, seg_end, mode)
             fn = fns.get(key)
+            swap_cause = (
+                "snapshot"
+                if seg_start == 0 or (resumed and first_live)
+                else "fault_swap"
+            )
+            first_live = False
             if mode == "device":
                 if fn is None:
                     fn = fns[key] = lanes.make_run_fn(p, tb)
@@ -1013,7 +1086,8 @@ class TpuEngine:
                     # ledger-only)
                     r = int(state.rounds)
                     turns.turn(
-                        "free_run" if seg_start == 0 else "fault_swap",
+                        "free_run" if swap_cause == "snapshot"
+                        else "fault_swap",
                         seg_start, seg_end, windows=r - seg_rounds,
                     )
                     seg_rounds = r
@@ -1021,10 +1095,7 @@ class TpuEngine:
                 if fn is None:
                     fn = fns[key] = lanes.make_round_fn(p, tb)
                 state = self._drive_steps(
-                    fn, state, on_window, p,
-                    first_cause=(
-                        "snapshot" if seg_start == 0 else "fault_swap"
-                    ),
+                    fn, state, on_window, p, first_cause=swap_cause,
                 )
             seg_start = seg_end
         wall = wall_time.perf_counter() - t0

@@ -164,6 +164,29 @@ class ExperimentalOptions:
     # turn collapse falls below this fraction of the ledger's remaining
     # kfusion_headroom_freerun prediction (obs_turns runs only)
     hybrid_fuse_warn_fraction: float = 0.5
+    # --- crash safety (engine/checkpoint.py, docs/robustness.md) ---------
+    # write an on-disk checkpoint every N window-clamp boundaries
+    # (0 = checkpointing off); pure-lane backends only (cpu, cpu_mp, tpu)
+    checkpoint_every_windows: int = 0
+    # checkpoint directory (None = <data_directory>/checkpoints)
+    checkpoint_dir: Optional[str] = None
+    # bounded retention: keep the newest N checkpoints of a run
+    checkpoint_keep: int = 3
+    # resume a run from this checkpoint file (the --resume CLI flag);
+    # the resumed run is bit-identical to the uninterrupted one
+    resume_from: Optional[str] = None
+    # worker supervision (engine/supervisor.py): reply deadline for
+    # multiprocess workers (wall seconds) — a worker that misses it is
+    # diagnosed dead/hung instead of blocking the parent forever
+    worker_heartbeat_s: float = 30.0
+    # respawn+replay budget: consecutive failures of one worker before
+    # escalating to the serial engine (0 = supervision off: a dead
+    # worker raises WorkerDiedError)
+    worker_restart_max: int = 2
+    # hybrid device path: fused-dispatch retries (from the pre-turn
+    # device checkpoint, exponential backoff) before the failure
+    # escalates to the watchdog/failover boundary
+    dispatch_retry_max: int = 2
 
 
 @dataclasses.dataclass
@@ -442,6 +465,20 @@ class ConfigOptions:
             raise ConfigError(
                 "experimental.hybrid_fuse_warn_fraction must be in [0, 1]"
             )
+        if self.experimental.checkpoint_every_windows < 0:
+            raise ConfigError(
+                "experimental.checkpoint_every_windows must be >= 0"
+            )
+        if self.experimental.checkpoint_keep < 1:
+            raise ConfigError("experimental.checkpoint_keep must be >= 1")
+        if self.experimental.worker_heartbeat_s <= 0:
+            raise ConfigError(
+                "experimental.worker_heartbeat_s must be > 0 (wall seconds)"
+            )
+        if self.experimental.worker_restart_max < 0:
+            raise ConfigError("experimental.worker_restart_max must be >= 0")
+        if self.experimental.dispatch_retry_max < 0:
+            raise ConfigError("experimental.dispatch_retry_max must be >= 0")
         if self.experimental.interface_qdisc not in ("fifo", "round-robin"):
             raise ConfigError(
                 "experimental.interface_qdisc must be fifo|round-robin, "

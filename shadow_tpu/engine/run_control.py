@@ -35,6 +35,16 @@ Observability extensions (shadow_tpu/obs/, docs/observability.md):
 - ``trace``          tracer status; ``trace on|off`` toggles recording;
   ``trace dump [path]`` exports the Chrome trace collected so far
 
+Crash-safety extensions (engine/checkpoint.py, docs/robustness.md):
+
+- ``checkpoint``        write a checkpoint at the current window boundary
+  (requested now, written when the boundary hook resumes — the engine is
+  parked at a consistent epoch either way)
+- ``resume <path>``     abandon this run and resume deterministically
+  from an on-disk checkpoint: unwinds a :class:`ResumeRequest` to the
+  facade, which validates the checkpoint against the config and
+  continues bit-identically to an uninterrupted run
+
 Fault-injection extensions (shadow_tpu/faults/):
 
 - ``fault <verb> ...``  schedule a fault at the current window boundary
@@ -120,6 +130,9 @@ class RunControl:
         # netobs seam: `netstats [host]` answers from the engine's live
         # network-telemetry counters (obs/netobs.py)
         self._netobs_sink: Optional[Callable[[Optional[str]], list[str]]] = None
+        # checkpoint seam (engine/checkpoint.py): the `checkpoint` verb
+        # requests a write at the current boundary through this callback
+        self._checkpoint_sink: Optional[Callable[[], str]] = None
 
     # -- command input -----------------------------------------------------
 
@@ -144,6 +157,12 @@ class RunControl:
         """Register the engine's network-telemetry snapshot callback:
         ``sink(host_or_None)`` returns the ``netstats`` answer lines."""
         self._netobs_sink = sink
+
+    def set_checkpoint_sink(self, sink: Callable[[], str]) -> None:
+        """Register the facade's checkpoint-request callback: ``sink()``
+        marks the current window boundary for a checkpoint write and
+        returns a confirmation line."""
+        self._checkpoint_sink = sink
 
     def start_stdin_thread(self) -> None:
         """Read commands from stdin on a daemon thread (interactive use)."""
@@ -226,7 +245,8 @@ class RunControl:
             f"[run-control] paused at window boundary: sim-time "
             f"{stime.fmt(window_end)} (next event {stime.fmt(next_event_time)}); "
             "commands: c / cN / n / s / s:<pid> / r / rN / stats / "
-            "netstats [host] / turns / trace ... / fault ... / failover"
+            "netstats [host] / turns / trace ... / fault ... / failover / "
+            "checkpoint / resume <ckpt>"
         )
         self._print_info()
         # soft-wait: block until a resuming command arrives
@@ -293,6 +313,23 @@ class RunControl:
                 "is already on the cpu engine)"
             )
             return False
+        if cmd == "checkpoint":
+            if self._checkpoint_sink is None:
+                self._print(
+                    "[run-control] checkpointing is not available on this "
+                    "backend/run (see docs/robustness.md)"
+                )
+                return False
+            self._print(f"[run-control] {self._checkpoint_sink()}")
+            return False
+        if cmd == "resume" or cmd.startswith("resume "):
+            parts = cmd.split(None, 1)
+            if len(parts) < 2 or not parts[1].strip():
+                self._print("[run-control] usage: resume <checkpoint-path>")
+                return False
+            from .checkpoint import ResumeRequest
+
+            raise ResumeRequest(parts[1].strip())
         if cmd == "stats":
             self._cmd_stats()
             return False

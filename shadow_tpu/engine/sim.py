@@ -24,9 +24,71 @@ from typing import Optional
 from ..backend.cpu_engine import OUTCOME_NAMES, CpuEngine, SimResult
 from ..config.options import ConfigOptions
 from ..core import time as stime
+from .checkpoint import (
+    CheckpointError,
+    CheckpointManager,
+    GracefulShutdown,
+    ResumeRequest,
+    read_checkpoint,
+    validate_for_config,
+)
 from .run_control import PerfLog, RestartRequest, RunControl
 
 log = logging.getLogger("shadow_tpu")
+
+
+class _CkptHook:
+    """Facade-side checkpoint trigger, composed into the per-window
+    callback (docs/robustness.md): counts window-clamp epochs, writes a
+    checkpoint every ``checkpoint_every_windows`` boundaries and/or when
+    the run-control ``checkpoint`` verb requested one, and provides the
+    forced final write the graceful-shutdown path takes."""
+
+    def __init__(self, mgr: CheckpointManager, every: int, payload_fn,
+                 backend_kind: str, resume_windows: int = 0) -> None:
+        self.mgr = mgr
+        self.every = max(0, int(every))
+        self.payload_fn = payload_fn
+        self.kind = backend_kind
+        self.windows = resume_windows  # continues the interrupted count
+        self.request = False
+        self.last_epoch: Optional[int] = None
+
+    def request_checkpoint(self) -> str:
+        """The run-control ``checkpoint`` verb sink: the write happens
+        at this boundary, when the hook runs after the console returns."""
+        self.request = True
+        return "checkpoint requested: written at this window boundary"
+
+    def at_window(self, window_end: int) -> None:
+        self.windows += 1
+        if not (
+            self.request
+            or (self.every > 0 and self.windows % self.every == 0)
+        ):
+            return
+        self.request = False
+        self._save(window_end)
+
+    def final(self, window_end: int) -> None:
+        """The graceful-shutdown write: skip only if this exact boundary
+        was already checkpointed by the periodic law."""
+        if self.last_epoch != window_end:
+            self._save(window_end)
+
+    def _save(self, window_end: int) -> None:
+        path = self.mgr.save(
+            self.payload_fn(),
+            backend_kind=self.kind,
+            epoch_ns=window_end,
+            windows=self.windows,
+            summary={"epoch": stime.fmt(window_end)},
+        )
+        self.last_epoch = window_end
+        log.info(
+            "checkpoint written: %s (epoch %s, %d windows)",
+            path, stime.fmt(window_end), self.windows,
+        )
 
 
 class Simulation:
@@ -50,6 +112,15 @@ class Simulation:
         self.failovers = 0  # TPU->CPU graceful degradations this run
         self.engine = None  # the backend engine of the most recent run()
         self.obs = None  # the run's obs Recorder (shadow_tpu/obs/)
+        # crash-safety state (docs/robustness.md): pending resume source
+        # (--resume / experimental.resume_from / run-control `resume`),
+        # the run's checkpoint manager, the sim-time a checkpoint-anchored
+        # failover did NOT have to replay, and the pending shutdown signal
+        self._resume_path: Optional[str] = cfg.experimental.resume_from
+        self._ckpt_mgr: Optional[CheckpointManager] = None
+        self.restart_work_saved = 0  # ns of prefix recovered from a ckpt
+        self._shutdown_signum: Optional[int] = None
+        self._signals_armed = False
 
     # -- running -----------------------------------------------------------
 
@@ -70,14 +141,70 @@ class Simulation:
         if self.obs is not None and self.run_control is not None:
             # the stats/trace console verbs answer from the live recorder
             self.run_control.set_obs(self.obs)
+        prev_handlers = self._install_signals()
         try:
             return self._run_logged(write_data, t0)
         finally:
+            self._restore_signals(prev_handlers)
             shadow_log.set_sim_time_provider(None)
             if self.obs is not None and self.obs.finalized is None:
                 # failed/aborted run: still flush the partial artifacts —
                 # a crash is exactly when the phase breakdown matters
                 self.obs.finalize()
+
+    # -- graceful shutdown (docs/robustness.md) ----------------------------
+
+    def _install_signals(self):
+        """Arm SIGINT/SIGTERM for a graceful stop: the first signal asks
+        the round loop to stop at the next window boundary (final
+        checkpoint + artifact flush + worker reap); a second signal
+        restores the default disposition and re-raises itself — an
+        immediate, non-graceful exit.  Main thread only (the signal
+        module refuses handlers elsewhere); returns the previous handlers
+        for the paired ``_restore_signals``."""
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return None
+
+        def handler(signum, frame):
+            if self._shutdown_signum is not None:
+                # second signal: force immediate exit via the default
+                # disposition (resume from the last checkpoint later)
+                signal.signal(signum, signal.SIG_DFL)
+                import os
+
+                os.kill(os.getpid(), signum)
+                return
+            self._shutdown_signum = signum
+            log.warning(
+                "received %s: stopping at the next window boundary "
+                "(final checkpoint + artifact flush; signal again to "
+                "force immediate exit)",
+                signal.Signals(signum).name,
+            )
+
+        prev = {}
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                prev[sig] = signal.signal(sig, handler)
+            except (ValueError, OSError):  # pragma: no cover - exotic env
+                pass
+        self._signals_armed = bool(prev)
+        return prev
+
+    def _restore_signals(self, prev) -> None:
+        if not prev:
+            return
+        import signal
+
+        self._signals_armed = False
+        for sig, old in prev.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
 
     def _make_obs(self):
         """Build the run's obs Recorder from ``experimental.obs_*``
@@ -119,7 +246,9 @@ class Simulation:
             cfg.general.seed,
         )
         # in-process restart loop: a RestartRequest aborts the round loop,
-        # the engine is torn down, and a fresh deterministic run begins
+        # the engine is torn down, and a fresh deterministic run begins;
+        # a ResumeRequest (run-control `resume <ckpt>`) aborts it too and
+        # the next iteration loads the named checkpoint instead
         while True:
             try:
                 if backend == "tpu":
@@ -136,6 +265,15 @@ class Simulation:
                 )
                 if self.run_control is not None:
                     self.run_control.arm_after_restart(rr.run_until_ns)
+            except ResumeRequest as rq:
+                self.restarts += 1
+                self._resume_path = rq.path
+                log.info(
+                    "resuming simulation from checkpoint %s (restart #%d)",
+                    rq.path, self.restarts,
+                )
+                if self.run_control is not None:
+                    self.run_control.arm_after_restart(None)
         total = wall_time.perf_counter() - t0
         for err in result.process_errors:
             log.error("process final-state mismatch: %s", err)
@@ -159,6 +297,7 @@ class Simulation:
                 "rounds": result.rounds,
                 "restarts": self.restarts,
                 "failovers": self.failovers,
+                "restart_work_saved": self.restart_work_saved,
                 "sim_counters": dict(sorted(result.counters.items())),
             }
             sync = getattr(self.engine, "sync_stats", None)
@@ -213,14 +352,17 @@ class Simulation:
             "windows": report["window_hist"]["windows"],
         }
 
-    def _make_on_window(self, describe_source, runahead, t0: float):
+    def _make_on_window(self, describe_source, runahead, t0: float,
+                        ckpt: Optional[_CkptHook] = None):
         """Compose the per-round callback: heartbeat lines + run-control
-        boundary processing.  ``describe_source(until)`` names the hosts
-        with events before ``until`` (for the pause console).  ``runahead``
-        is an int or a live callable (dynamic runahead widens it)."""
+        boundary processing + checkpoint writes + the graceful-shutdown
+        check.  ``describe_source(until)`` names the hosts with events
+        before ``until`` (for the pause console).  ``runahead`` is an int
+        or a live callable (dynamic runahead widens it)."""
         heartbeat = self.cfg.general.heartbeat_interval
         rc = self.run_control
-        if not heartbeat and rc is None:
+        if not heartbeat and rc is None and ckpt is None \
+                and not self._signals_armed:
             return None  # no consumer: keep the round loop free of the hook
         state = {"next_beat": heartbeat or 0, "rounds": 0}
         stop_time = self.cfg.general.stop_time
@@ -254,25 +396,112 @@ class Simulation:
                     terminal=next_ev >= stop_time,
                 )
                 rc.consume_run_for(window_end)
+            if ckpt is not None:
+                # runs AFTER the console: a `checkpoint` verb typed at a
+                # pause lands at this very boundary on resume
+                ckpt.at_window(window_end)
+            if self._shutdown_signum is not None:
+                if ckpt is not None:
+                    ckpt.final(window_end)
+                raise GracefulShutdown(self._shutdown_signum)
 
         return on_window
 
+    # -- checkpoint/resume plumbing (docs/robustness.md) -------------------
+
+    def _take_resume(self, kind: str):
+        """Consume the pending resume source (``--resume`` /
+        ``experimental.resume_from`` / run-control ``resume``): load,
+        verify, and validate the checkpoint against this config and
+        backend.  Returns ``(header, payload)`` or None.  Consuming means
+        a later in-process restart runs fresh from t=0, as restarts
+        always have."""
+        path = self._resume_path
+        self._resume_path = None
+        if path is None:
+            return None
+        hdr, payload = read_checkpoint(path)
+        validate_for_config(hdr, self.cfg)
+        if hdr.get("backend_kind") != kind:
+            raise CheckpointError(
+                f"{path}: checkpoint was written by the"
+                f" {hdr.get('backend_kind')!r} backend; this run uses"
+                f" {kind!r} — resume on the matching backend"
+            )
+        log.info(
+            "resuming from checkpoint %s: epoch %s, %d windows",
+            path, stime.fmt(hdr["epoch_ns"]), hdr["windows"],
+        )
+        return hdr, payload
+
+    def _make_ckpt_hook(self, kind: str, payload_fn,
+                        resume_windows: int = 0,
+                        unsupported: Optional[str] = None):
+        """Build the per-run checkpoint hook, or None when checkpointing
+        is off.  Armed when periodic checkpointing is configured, when a
+        checkpoint directory is named, or when a run-control console is
+        live (so its ``checkpoint`` verb has somewhere to write) — an
+        armed-but-idle hook costs one int increment per window."""
+        exp = self.cfg.experimental
+        configured = (
+            exp.checkpoint_every_windows > 0 or exp.checkpoint_dir is not None
+        )
+        if not configured and self.run_control is None:
+            return None
+        if unsupported:
+            if configured:
+                log.warning("checkpointing disabled: %s", unsupported)
+            return None
+        ckdir = (
+            Path(exp.checkpoint_dir) if exp.checkpoint_dir
+            else self.data_dir / "checkpoints"
+        )
+        run_id = f"{exp.network_backend}-seed{self.cfg.general.seed}"
+        mgr = self._ckpt_mgr = CheckpointManager(
+            ckdir, run_id, self.cfg, keep=exp.checkpoint_keep
+        )
+        hook = _CkptHook(
+            mgr, exp.checkpoint_every_windows, payload_fn, kind,
+            resume_windows,
+        )
+        if self.run_control is not None:
+            self.run_control.set_checkpoint_sink(hook.request_checkpoint)
+        return hook
+
+    def _obs_payload(self):
+        return self.obs.checkpoint_state() if self.obs is not None else None
+
+    def _restore_obs(self, payload: dict) -> None:
+        """Reset the live accumulators and restore the checkpointed ones
+        (replace, not merge): the resumed run's deterministic counters
+        then byte-match an uninterrupted run's, and nothing from an
+        abandoned attempt lingers."""
+        if self.obs is None:
+            return
+        self.obs.reset_for_replay()
+        if payload.get("obs") is not None:
+            self.obs.restore_checkpoint_state(payload["obs"])
+
     def _run_tpu_guarded(self) -> SimResult:
-        """The graceful-degradation boundary (docs/faults.md): when
-        ``faults.failover`` is enabled, any failure of the TPU path — an
-        injected ``backend_stall``, a watchdog-detected stall, a
-        run-control ``failover`` command, or a real backend error —
-        degrades to a **deterministic CPU replay from t=0**.  Replay is
-        exact recovery: the CPU engine executes the identical window
-        sequence and event order (the cross-backend parity contract), so
-        the failed run's prefix is reproduced bit-for-bit and the run
-        completes with the same event log an unfaulted CPU-only run of
-        the same config yields."""
+        """The graceful-degradation boundary (docs/faults.md,
+        docs/robustness.md): when ``faults.failover`` is enabled, any
+        failure of the TPU path — an injected ``backend_stall``, a
+        watchdog-detected stall, a run-control ``failover`` command, or a
+        real backend error — degrades to a **deterministic replay from
+        the newest valid checkpoint**, or from t=0 when none exists.
+        Replay is exact recovery: determinism makes the replayed suffix
+        (or whole run) reproduce the event log an unfaulted CPU-only run
+        of the same config yields, bit-for-bit.  A checkpointed pure-lane
+        run replays on a fresh TPU engine with the injected stalls
+        disarmed (the fault already fired; cross-backend parity makes the
+        result identical to the CPU replay), reporting the recovered
+        prefix as ``restart_work_saved``; the hybrid backend and
+        checkpoint-less runs replay on the CPU engine from t=0."""
         from ..faults.watchdog import BackendStallError, FailoverRequest
 
         try:
             return self._run_tpu()
-        except RestartRequest:
+        except (RestartRequest, ResumeRequest):
             raise
         except (BackendStallError, FailoverRequest) as e:
             if not self.cfg.faults.failover_enabled:
@@ -283,16 +512,92 @@ class Simulation:
                 raise
             reason = e
         self.failovers += 1
+        # (c) checkpoint-anchored failover: scan for the newest valid
+        # tpu checkpoint and replay only the suffix
+        if self._ckpt_mgr is not None:
+            got = self._ckpt_mgr.newest_valid(backend_kind="tpu")
+            if got is not None:
+                hdr, payload, path = got
+                log.warning(
+                    "tpu backend failed (%s: %s); replaying from "
+                    "checkpoint %s (epoch %s — restart_work_saved=%d ns)",
+                    type(reason).__name__, reason, path,
+                    stime.fmt(hdr["epoch_ns"]), hdr["epoch_ns"],
+                )
+                try:
+                    return self._failover_resume_tpu(hdr, payload)
+                except (RestartRequest, ResumeRequest, GracefulShutdown):
+                    raise
+                except Exception as e:
+                    log.warning(
+                        "checkpoint-anchored failover failed (%s: %s); "
+                        "falling back to a cpu replay from t=0",
+                        type(e).__name__, e,
+                    )
         log.warning(
             "tpu backend failed (%s: %s); degrading to the cpu engine "
             "(deterministic replay from t=0)",
             type(reason).__name__,
             reason,
         )
+        self.restart_work_saved = 0
+        if self.obs is not None:
+            # the replay re-earns every accumulator from t=0
+            self.obs.reset_for_replay()
         return self._run_cpu()
 
+    def _failover_resume_tpu(self, hdr: dict, payload: dict) -> SimResult:
+        """Replay the run's suffix on a fresh TPU engine from a verified
+        checkpoint, stalls disarmed (the injected fault already fired —
+        replaying it would livelock the recovery law)."""
+        from ..backend.tpu_engine import TpuEngine
+
+        epoch = int(hdr["epoch_ns"])
+        self.restart_work_saved = epoch
+        engine = self.engine = TpuEngine(self.cfg)
+        engine.obs = self.obs
+        if self.cfg.experimental.perf_logging:
+            engine.perf_log = PerfLog()
+        self._restore_obs(payload)
+        if self.obs is not None:
+            m = self.obs.metrics
+            m.count("failovers")
+            m.count("restart_work_saved", epoch)
+        t0 = wall_time.perf_counter()
+        ckpt = self._make_ckpt_hook(
+            "tpu",
+            lambda: {
+                "state": engine.checkpoint_payload(),
+                "obs": self._obs_payload(),
+            },
+            resume_windows=int(hdr["windows"]),
+        )
+        on_window = self._make_on_window(
+            None, engine.current_runahead, t0, ckpt
+        )
+        return engine.run(
+            mode="step",
+            on_window=on_window,
+            resume_state=payload["state"],
+            resume_epoch=epoch,
+            disarm_stalls=True,
+        )
+
     def _run_cpu(self) -> SimResult:
-        engine = self.engine = CpuEngine(self.cfg)
+        resume = self._take_resume("cpu")
+        if resume is not None:
+            hdr, payload = resume
+            # the whole-engine pickle IS the run prefix: hosts, queues,
+            # in-flight transport state, RNG counters, fault runtime —
+            # run() on the restored engine simply continues
+            engine = self.engine = CpuEngine.from_checkpoint(
+                payload["engine"]
+            )
+            self._restore_obs(payload)
+            resume_windows = int(hdr["windows"])
+        else:
+            engine = self.engine = CpuEngine(self.cfg)
+            resume_windows = 0
         if self.run_control is not None:
             # the `fault ...` console verb schedules faults at the next
             # window boundary (cpu backend only: the device program's
@@ -305,13 +610,25 @@ class Simulation:
             engine.perf_log = PerfLog()
         engine.obs = self.obs
         t0 = wall_time.perf_counter()
+        ckpt = self._make_ckpt_hook(
+            "cpu",
+            lambda: {
+                "engine": engine.checkpoint_payload(),
+                "obs": self._obs_payload(),
+            },
+            resume_windows=resume_windows,
+            unsupported=engine.checkpoint_unsupported_reason(),
+        )
         on_window = self._make_on_window(
-            engine.describe_next_window, engine.current_runahead, t0
+            engine.describe_next_window, engine.current_runahead, t0, ckpt
         )
         try:
             return engine.run(on_window=on_window)
-        except RestartRequest:
+        except (RestartRequest, ResumeRequest):
             engine.finalize()  # reap managed processes before the re-run
+            raise
+        except GracefulShutdown:
+            engine.finalize()  # reap managed processes before exiting
             raise
 
     def _run_tpu(self) -> SimResult:
@@ -319,12 +636,18 @@ class Simulation:
         from ..backend.tpu_engine import LaneCompatError, TpuEngine
 
         if config_has_managed(self.cfg):
-            if self.cfg.faults.events:
+            if self.cfg.faults.events and any(
+                ev.get("kind") != "backend_stall"
+                for ev in self.cfg.faults.events
+            ):
                 # the guarded caller degrades this to a CPU replay when
-                # failover is enabled — managed hosts run there natively
+                # failover is enabled — managed hosts run there natively.
+                # backend_stall-only schedules ARE supported: the hybrid
+                # window loop raises at the stall epoch and the failover
+                # boundary replays on the CPU engine (docs/robustness.md)
                 raise LaneCompatError(
-                    "fault schedules are not supported on the hybrid tpu "
-                    "backend; use the cpu backend"
+                    "link/host fault schedules are not supported on the "
+                    "hybrid tpu backend; use the cpu backend"
                 )
             # the HYBRID backend: managed hosts' syscall plane on the host
             # CPU, the packet data plane (theirs included) on the device.
@@ -342,6 +665,20 @@ class Simulation:
                 log.warning(
                     "tpu_mesh_shape is not supported on the hybrid tpu "
                     "backend; running single-device"
+                )
+            if self._resume_path is not None:
+                raise CheckpointError(
+                    "the hybrid tpu backend does not support resume: "
+                    "managed (real-binary) processes hold live OS state "
+                    "that cannot be snapshotted (docs/robustness.md); "
+                    "use the cpu backend to resume this checkpoint"
+                )
+            if (self.cfg.experimental.checkpoint_every_windows > 0
+                    or self.cfg.experimental.checkpoint_dir is not None):
+                log.warning(
+                    "checkpointing disabled on the hybrid tpu backend: "
+                    "managed (real-binary) processes hold live OS state "
+                    "that cannot be snapshotted (docs/robustness.md)"
                 )
             # parallel syscall servicing: hybrid_workers != 1 spawns the
             # multiprocess engine (0 = one worker per core); results are
@@ -381,6 +718,12 @@ class Simulation:
                     "driver (fused on-device loop); drop tpu_mesh_shape or "
                     "use the cpu backend"
                 )
+            if self._resume_path is not None:
+                raise CheckpointError(
+                    "checkpoint resume is not supported on the sharded-"
+                    "mesh driver (fused on-device loop); drop "
+                    "tpu_mesh_shape to resume"
+                )
             import jax
 
             from .. import parallel
@@ -404,23 +747,56 @@ class Simulation:
             t0 = wall_time.perf_counter()
             final = jax.block_until_ready(run_fn(state))
             return engine.collect(final, wall_time.perf_counter() - t0)
-        # run-control / perf logging force the step-wise driver (one device
-        # call per round, pausable); otherwise the fused on-device loop
-        needs_steps = self.run_control is not None or self.cfg.experimental.perf_logging
+        # run-control / perf logging / checkpointing / resume force the
+        # step-wise driver (one device call per round, pausable, with
+        # host-visible lane state at every boundary); otherwise the
+        # fused on-device loop
+        exp = self.cfg.experimental
+        resume = self._take_resume("tpu")
+        needs_steps = (
+            self.run_control is not None
+            or exp.perf_logging
+            or resume is not None
+            or exp.checkpoint_every_windows > 0
+            or exp.checkpoint_dir is not None
+        )
         if not needs_steps:
             return engine.run(mode="device")
         t0 = wall_time.perf_counter()
-        on_window = self._make_on_window(None, engine.current_runahead, t0)
+        resume_state = resume_epoch = None
+        resume_windows = 0
+        if resume is not None:
+            hdr, payload = resume
+            resume_state = payload["state"]
+            resume_epoch = int(hdr["epoch_ns"])
+            resume_windows = int(hdr["windows"])
+            self._restore_obs(payload)
+        ckpt = self._make_ckpt_hook(
+            "tpu",
+            lambda: {
+                "state": engine.checkpoint_payload(),
+                "obs": self._obs_payload(),
+            },
+            resume_windows=resume_windows,
+        )
+        on_window = self._make_on_window(
+            None, engine.current_runahead, t0, ckpt
+        )
         if self.run_control is not None:
             # the `failover` console verb is live on the pausable tpu
             # driver: it unwinds a FailoverRequest to the guarded caller
             self.run_control.failover_armed = True
-            if self.cfg.experimental.netobs:
+            if exp.netobs:
                 # `netstats` reads the live device counters at a paused
                 # boundary (a snapshot epoch, not a new per-window sync)
                 self.run_control.set_netobs_sink(engine.netobs_lines)
-        if self.cfg.experimental.perf_logging:
+        if exp.perf_logging:
             engine.perf_log = PerfLog()
+        if resume is not None:
+            return engine.run(
+                mode="step", on_window=on_window,
+                resume_state=resume_state, resume_epoch=resume_epoch,
+            )
         return engine.run(mode="step", on_window=on_window)
 
     # -- output ------------------------------------------------------------
@@ -435,6 +811,7 @@ class Simulation:
             "rounds": result.rounds,
             "restarts": self.restarts,
             "failovers": self.failovers,
+            "restart_work_saved": self.restart_work_saved,
             "backend": self.cfg.experimental.network_backend,
             "num_hosts": len(self.cfg.hosts),
             "seed": self.cfg.general.seed,

@@ -1,0 +1,360 @@
+"""Worker supervision: poll+deadline pipe reads, death diagnosis, and
+deterministic round replay for the multiprocess engines
+(docs/robustness.md "supervision model").
+
+Before this layer, every parent-side ``conn.recv()`` was a bare
+blocking read: a worker that crashed (OOM-kill, SIGKILL, segfault) or
+hung left the parent blocked **forever** with no diagnostic.  The
+primitives here replace those reads:
+
+- :func:`recv_with_deadline` — parent side: poll in short slices,
+  checking worker liveness between slices; raises a diagnostic
+  :class:`WorkerDiedError` (worker id, round, last message kind, died
+  vs hung) instead of blocking.
+- :func:`worker_recv` — child side: poll in 1s slices with an orphan
+  check (original parent gone → exit), so a crashed parent never
+  leaves zombie workers behind.
+- :class:`CpuWorkerPool` — the supervised worker set for
+  ``MpCpuEngine``: it journals every round message (the messages are
+  deterministic, so the journal IS the worker's state transcript),
+  respawns a dead worker, replays its journal from the last checkpoint
+  blob, and re-issues the in-flight round — bit-identical recovery.
+  After ``worker_restart_max`` consecutive failures of the same worker
+  it raises :class:`EscalateToSerial`; the engine then falls back to
+  the serial oracle from t=0, which is *also* bit-identical (the
+  parallelism-invariance law).
+
+The hybrid engine's workers own live managed OS processes, which cannot
+be resurrected by respawning the Python worker — ``MpHybridEngine``
+therefore uses only the deadline reads: a dead hybrid worker surfaces
+as :class:`WorkerDiedError` and recovery belongs to the failover
+boundary (engine/sim.py).
+
+Test fault-injection knobs (test-only; documented in
+docs/robustness.md):
+
+- ``SHADOW_TPU_TEST_WORKER_HANG="<wid>:<t_ns>"`` — worker ``wid``
+  sleeps indefinitely on its first *live* round whose window end
+  reaches ``t_ns`` (replayed rounds are exempt, so a respawned worker
+  hangs again → drives escalation).
+- ``SHADOW_TPU_TEST_WORKER_KILL="<wid>:<t_ns>"`` — the parent SIGKILLs
+  worker ``wid`` once, right after dispatching the first round whose
+  window end reaches ``t_ns`` (the worker dies mid-round → drives the
+  respawn+replay recovery path).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import time as wall_time
+from typing import Optional
+
+log = logging.getLogger("shadow_tpu.supervisor")
+
+_POLL_SLICE_S = 0.05  # parent-side liveness poll granularity
+
+
+class WorkerDiedError(RuntimeError):
+    """A multiprocess worker died or missed its reply deadline.
+
+    Carries the diagnosis the bare ``conn.recv()`` hang never gave:
+    which worker, which round, what the parent was waiting for, and
+    whether the process is dead or merely unresponsive."""
+
+    def __init__(
+        self,
+        worker_id: int,
+        round_no: int,
+        last_msg_kind: str,
+        reason: str,
+        exitcode: Optional[int] = None,
+    ) -> None:
+        self.worker_id = worker_id
+        self.round_no = round_no
+        self.last_msg_kind = last_msg_kind
+        self.reason = reason
+        self.exitcode = exitcode
+        detail = f" (exitcode {exitcode})" if exitcode is not None else ""
+        super().__init__(
+            f"worker {worker_id} {reason}{detail} during round {round_no}"
+            f" (awaiting reply to {last_msg_kind!r})"
+        )
+
+
+class EscalateToSerial(RuntimeError):
+    """A worker exceeded its restart budget: the parallel run is
+    abandoned and the engine must replay serially from t=0."""
+
+    def __init__(self, worker_id: int, failures: int, cause: Exception):
+        self.worker_id = worker_id
+        self.failures = failures
+        self.cause = cause
+        super().__init__(
+            f"worker {worker_id} failed {failures} consecutive time(s)"
+            f" (last: {cause}); escalating to the serial engine"
+        )
+
+
+def recv_with_deadline(
+    conn,
+    proc,
+    timeout_s: float,
+    worker_id: int,
+    round_no: int,
+    last_msg_kind: str,
+):
+    """Receive one message with liveness checks and a deadline.
+
+    Polls in :data:`_POLL_SLICE_S` slices; between slices the worker
+    process's liveness is checked so a crash surfaces in at most one
+    slice, not after the full deadline.  ``proc`` may be ``None`` (no
+    liveness source; deadline only)."""
+    waited = 0.0
+    while True:
+        try:
+            if conn.poll(_POLL_SLICE_S):
+                return conn.recv()
+        except (EOFError, OSError) as e:
+            raise WorkerDiedError(
+                worker_id, round_no, last_msg_kind,
+                "closed its pipe",
+                proc.exitcode if proc is not None else None,
+            ) from e
+        if proc is not None and not proc.is_alive():
+            # drain a reply that raced the death
+            try:
+                if conn.poll(0):
+                    return conn.recv()
+            except (EOFError, OSError):
+                pass
+            raise WorkerDiedError(
+                worker_id, round_no, last_msg_kind, "died", proc.exitcode
+            )
+        waited += _POLL_SLICE_S
+        if waited >= timeout_s:
+            raise WorkerDiedError(
+                worker_id, round_no, last_msg_kind,
+                f"missed its {timeout_s:.1f}s reply deadline (hung)",
+            )
+
+
+def worker_recv(conn):
+    """Child-side receive: poll in 1s slices forever (a worker
+    legitimately idles between rounds), but exit if the parent is gone
+    (reparented to init) — a crashed parent must not strand workers."""
+    ppid = os.getppid()
+    while True:
+        if conn.poll(1.0):
+            return conn.recv()
+        if os.getppid() != ppid:
+            raise EOFError("parent process exited")
+
+
+# -- test fault-injection knobs ----------------------------------------------
+
+def parse_test_knob(env_name: str) -> Optional[tuple[int, int]]:
+    """Parse ``"<wid>:<t_ns>"`` from the environment; None when unset
+    or malformed (the knobs are test-only and must never break a run)."""
+    raw = os.environ.get(env_name)
+    if not raw:
+        return None
+    try:
+        wid_s, t_s = raw.split(":", 1)
+        return int(wid_s), int(t_s)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", env_name, raw)
+        return None
+
+
+def maybe_test_hang(worker_id: int, window_end: int, armed: list) -> None:
+    """Worker-side hang knob: sleep indefinitely once the trigger
+    window is reached (live rounds only — the caller skips replay)."""
+    knob = parse_test_knob("SHADOW_TPU_TEST_WORKER_HANG")
+    if knob is None or armed:
+        return
+    wid, t_ns = knob
+    if worker_id == wid and window_end >= t_ns:
+        armed.append(True)
+        while True:  # hang until killed by the supervisor
+            wall_time.sleep(0.5)
+
+
+class CpuWorkerPool:
+    """Supervised worker set for :class:`~shadow_tpu.backend.cpu_mp.
+    MpCpuEngine`: spawn, journal, deadline reads, respawn+replay, and
+    the worker-side checkpoint/restore protocol.
+
+    The journal holds, per worker, every ``("round", window_end,
+    incoming)`` message sent since the last checkpoint.  Round messages
+    are the worker's *only* input, and the worker is deterministic, so
+    ``restore(blob) ; replay(journal[:-1]) ; round(journal[-1])``
+    reconstructs a dead worker's state exactly and re-earns the reply
+    the parent was waiting for."""
+
+    def __init__(
+        self,
+        cfg,
+        parts: list[list[str]],
+        record_turns: bool,
+        *,
+        heartbeat_s: float = 30.0,
+        restart_max: int = 2,
+        resume_blobs: Optional[list] = None,
+    ) -> None:
+        from ..backend.cpu_mp import _worker_main, spawn_cpu_workers
+
+        self.cfg = cfg
+        self.parts = parts
+        self.record_turns = record_turns
+        self.heartbeat_s = heartbeat_s
+        self.restart_max = int(restart_max)
+        n = len(parts)
+        self.conns, self.procs = spawn_cpu_workers(
+            _worker_main,
+            [(cfg, parts[w], record_turns, w) for w in range(n)],
+        )
+        #: per-worker (window_end, incoming) transcript since last ckpt
+        self.journal: list[list] = [[] for _ in range(n)]
+        #: last checkpoint blob per worker (None = fresh construction)
+        self.blobs: list = list(resume_blobs) if resume_blobs else [None] * n
+        self.fail_streak = [0] * n
+        self.round_no = 0
+        self.restarts = 0
+        self._kill_knob = parse_test_knob("SHADOW_TPU_TEST_WORKER_KILL")
+        if resume_blobs:
+            for w in range(n):
+                self.conns[w].send(("restore", self.blobs[w]))
+
+    # -- round protocol ------------------------------------------------------
+
+    def send_round(self, w: int, window_end: int, incoming: list) -> None:
+        self.journal[w].append((window_end, incoming))
+        self.conns[w].send(("round", window_end, incoming))
+        knob = self._kill_knob
+        if knob is not None and knob[0] == w and window_end >= knob[1]:
+            self._kill_knob = None
+            log.warning(
+                "TEST KNOB: SIGKILLing worker %d at window %d", w, window_end
+            )
+            os.kill(self.procs[w].pid, signal.SIGKILL)
+
+    def recv_round(self, w: int):
+        try:
+            reply = recv_with_deadline(
+                self.conns[w], self.procs[w], self.heartbeat_s,
+                w, self.round_no, "round",
+            )
+        except WorkerDiedError as err:
+            return self._recover(w, err)
+        self.fail_streak[w] = 0
+        return reply
+
+    def _recover(self, w: int, err: WorkerDiedError):
+        """Respawn worker ``w``, rebuild its state (restore + replay),
+        re-issue the in-flight round, and return its reply.  Retries
+        until the reply lands or the restart budget is exhausted."""
+        from ..backend.cpu_mp import _worker_main, spawn_cpu_workers
+
+        while True:
+            self.fail_streak[w] += 1
+            if self.restart_max <= 0:
+                self._reap(w)
+                raise err
+            if self.fail_streak[w] > self.restart_max:
+                raise EscalateToSerial(w, self.fail_streak[w], err)
+            log.warning(
+                "supervision: %s; respawning worker %d (attempt %d/%d)"
+                " and replaying %d journaled round(s)",
+                err, w, self.fail_streak[w], self.restart_max,
+                max(0, len(self.journal[w]) - 1),
+            )
+            self._reap(w)
+            conns, procs = spawn_cpu_workers(
+                _worker_main,
+                [(self.cfg, self.parts[w], self.record_turns, w)],
+            )
+            self.conns[w], self.procs[w] = conns[0], procs[0]
+            self.restarts += 1
+            try:
+                if self.blobs[w] is not None:
+                    self.conns[w].send(("restore", self.blobs[w]))
+                # every journaled round except the in-flight one is a
+                # silent replay (outbound was already routed by the
+                # parent); the in-flight round is re-issued live
+                self.conns[w].send(("replay", self.journal[w][:-1]))
+                we, incoming = self.journal[w][-1]
+                self.conns[w].send(("round", we, incoming))
+                reply = recv_with_deadline(
+                    self.conns[w], self.procs[w], self.heartbeat_s,
+                    w, self.round_no, "round",
+                )
+            except WorkerDiedError as again:
+                err = again
+                continue
+            self.fail_streak[w] = 0
+            return reply
+
+    # -- checkpoint protocol -------------------------------------------------
+
+    def checkpoint(self) -> list:
+        """Ask every worker for its state blob; on success the journal
+        is truncated (the blobs subsume it)."""
+        for w, conn in enumerate(self.conns):
+            conn.send(("checkpoint",))
+        blobs = []
+        for w in range(len(self.conns)):
+            blobs.append(
+                recv_with_deadline(
+                    self.conns[w], self.procs[w], self.heartbeat_s,
+                    w, self.round_no, "checkpoint",
+                )
+            )
+        self.blobs = blobs
+        self.journal = [[] for _ in self.conns]
+        return blobs
+
+    # -- teardown ------------------------------------------------------------
+
+    def finish(self) -> list:
+        """Send the finish message and collect every worker's final
+        reply (event log, counters, errors, netobs)."""
+        for conn in self.conns:
+            conn.send(("finish",))
+        out = []
+        for w in range(len(self.conns)):
+            out.append(
+                recv_with_deadline(
+                    self.conns[w], self.procs[w], self.heartbeat_s,
+                    w, self.round_no, "finish",
+                )
+            )
+        return out
+
+    def _reap(self, w: int) -> None:
+        try:
+            self.conns[w].close()
+        except OSError:
+            pass
+        p = self.procs[w]
+        if p.is_alive():
+            p.terminate()
+        p.join(timeout=5)
+        if p.is_alive():  # pragma: no cover - terminate() sufficed so far
+            p.kill()
+            p.join(timeout=5)
+
+    def close(self) -> None:
+        for conn in self.conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for p in self.procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+                if p.is_alive():  # pragma: no cover
+                    p.kill()

@@ -11,11 +11,15 @@ Two signals cross it:
 
 The simulation facade (engine/sim.py) catches both — plus any other
 exception escaping the TPU path while ``faults.failover`` is enabled —
-and **replays the run deterministically on the CPU engine from t=0**.
-Replay is the recovery mechanism because determinism makes it exact: the
-CPU run executes the identical window sequence and event order the TPU
-run would have produced (the cross-backend parity contract), so the
-failed run's prefix is reproduced bit-for-bit and the run completes with
+and **replays deterministically from the newest valid state**
+(docs/robustness.md).  When checkpointing is on and a valid checkpoint
+exists, only the suffix past its epoch replays (a fresh TPU engine
+resumes the lane state with injected stalls disarmed; the recovered
+prefix is reported as ``restart_work_saved``); otherwise the whole run
+replays on the CPU engine from t=0.  Replay is the recovery mechanism
+because determinism makes it exact: the replayed run executes the
+identical window sequence and event order the failed run would have
+produced (the cross-backend parity contract), so the run completes with
 the event log an unfaulted CPU run of the same config yields.  No device
 state needs to survive the failure for the result to be correct.
 """

@@ -211,6 +211,50 @@ class MetricsRegistry:
             lines.append("no metrics recorded yet")
         return lines
 
+    # -- checkpoint state (engine/checkpoint.py) ---------------------------
+    # Counters/gauges/hists/phases are the resumable accumulator state;
+    # the lock, wall t0, and JSONL stream belong to the live run and are
+    # never serialized.  restore replaces (not merges): a resumed run's
+    # registry starts from exactly the checkpointed accumulators so the
+    # final deterministic counters byte-match the uninterrupted run.
+
+    def checkpoint_state(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "hists": {
+                    k: (h.count, h.total, h.vmin, h.vmax, list(h.sample))
+                    for k, h in self._hists.items()
+                },
+                "phases": {k: list(p) for k, p in self._phases.items()},
+            }
+
+    def restore_checkpoint_state(self, st: dict) -> None:
+        with self._lock:
+            self._counters = dict(st.get("counters", {}))
+            self._gauges = dict(st.get("gauges", {}))
+            self._hists = {}
+            for k, (count, total, vmin, vmax, sample) in st.get(
+                "hists", {}
+            ).items():
+                h = _Hist()
+                h.count, h.total = count, total
+                h.vmin, h.vmax = vmin, vmax
+                h.sample = list(sample)
+                self._hists[k] = h
+            self._phases = {k: list(p) for k, p in st.get("phases", {}).items()}
+
+    def reset_accumulators(self) -> None:
+        """Zero every accumulator: the escalate-to-serial replay starts
+        the run over from t=0, so the registry must too (otherwise the
+        abandoned parallel prefix double-counts)."""
+        with self._lock:
+            self._counters = {}
+            self._gauges = {}
+            self._hists = {}
+            self._phases = {}
+
     def close(self) -> None:
         f = self._jsonl_f
         if f is not None:

@@ -172,6 +172,37 @@ class Recorder:
             self.tracer.instant(name, "mark", args or None)
         self.metrics.stream({"ev": "mark", "name": name, **args})
 
+    # -- checkpoint state (engine/checkpoint.py) ---------------------------
+
+    def checkpoint_state(self) -> dict:
+        """The resumable observability state: metrics accumulators plus
+        the device-turn ledger (plain-data, picklable).  Trace spans are
+        wall-clock artifacts and deliberately excluded — a resumed run's
+        trace covers the resumed segment only.  The ledger is deep-copied
+        so the checkpoint is a true snapshot even when the payload is
+        held in memory while the live ledger keeps accumulating."""
+        import copy
+
+        return {
+            "metrics": self.metrics.checkpoint_state(),
+            "turns": copy.deepcopy(self.turns),
+        }
+
+    def restore_checkpoint_state(self, st: dict) -> None:
+        self.metrics.restore_checkpoint_state(st.get("metrics", {}))
+        if st.get("turns") is not None and self.turns is not None:
+            self.turns = st["turns"]
+
+    def reset_for_replay(self) -> None:
+        """Zero the accumulators for a from-t=0 replay (serial
+        escalation, checkpoint-less failover): the replay re-earns every
+        count, so the abandoned prefix must not linger."""
+        self.metrics.reset_accumulators()
+        if self.turns is not None:
+            from .turns import TurnLedger
+
+            self.turns = TurnLedger()
+
     # -- finalize ----------------------------------------------------------
 
     def finalize(self, extra: Optional[dict] = None) -> dict:

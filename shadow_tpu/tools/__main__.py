@@ -1,6 +1,13 @@
-"""``python -m shadow_tpu.tools [options] -- CMD [ARGS...]`` — shadow-exec."""
+"""``python -m shadow_tpu.tools [options] -- CMD [ARGS...]`` — shadow-exec,
+plus ``python -m shadow_tpu.tools checkpoint-inspect <ckpt> [...]`` — the
+STCKPT1 checkpoint validator (docs/robustness.md)."""
 
 import sys
+
+if len(sys.argv) > 1 and sys.argv[1] == "checkpoint-inspect":
+    from ..engine.checkpoint import inspect_main
+
+    sys.exit(inspect_main(sys.argv[2:]))
 
 from .exec import main
 

@@ -1,0 +1,147 @@
+"""Worker supervision (engine/supervisor.py, docs/robustness.md):
+deadline-bounded pipe reads, SIGKILL chaos recovery, hung-worker
+diagnosis, and the escalate-to-serial fallback.
+
+The recovery law under test: worker round messages are deterministic,
+so the journal of messages IS the worker's state transcript — a dead
+worker respawns, replays its journal from the last checkpoint blob,
+and re-executes the in-flight round **bit-identically**.  After
+``worker_restart_max`` consecutive failures the engine escalates to the
+serial oracle from t=0, which the parallelism-invariance law makes
+bit-identical too.  Either way the run completes with byte-identical
+outputs; the only thing supervision may change is wall time.
+
+The ``chaos`` marker tags the seeded kill-a-worker tests (also run at
+gate scale by ``make chaos-smoke``).
+"""
+
+import json
+import random
+import time as wall_time
+
+import pytest
+
+from shadow_tpu.backend.cpu_engine import CpuEngine
+from shadow_tpu.backend.cpu_mp import MpCpuEngine
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.engine.supervisor import WorkerDiedError
+from shadow_tpu.obs import Recorder
+from shadow_tpu.obs import netobs as nom
+
+PHOLD = """
+general: {stop_time: 500ms, seed: 7}
+experimental: {netobs: true, obs_turns: true}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "2 ms" ]
+        edge [ source 0 target 1 latency "5 ms" ]
+        edge [ source 1 target 1 latency "2 ms" ]
+      ]
+hosts:
+  a: {network_node_id: 0, processes: [{path: phold, args: [--messages, "3"]}]}
+  b: {network_node_id: 1, processes: [{path: phold, args: [--messages, "3"]}]}
+  c: {network_node_id: 1, processes: [{path: phold, args: [--messages, "2"]}]}
+  d: {network_node_id: 0, processes: [{path: phold, args: [--messages, "2"]}]}
+"""
+
+
+def _cfg():
+    return ConfigOptions.from_yaml(PHOLD)
+
+
+def _run_mp(workers):
+    """Run MpCpuEngine with a Recorder attached; return the engine, the
+    result, and the deterministic NETOBS/TURNS artifact bytes (built
+    exactly the way the facade writes them)."""
+    cfg = _cfg()
+    eng = MpCpuEngine(cfg, workers=workers)
+    rec = Recorder(run_id="sup", turns=True)
+    eng.obs = rec
+    res = eng.run()
+    snap = eng.netobs_snapshot()
+    names = [h.hostname for h in cfg.hosts]
+    report = nom.build_report(
+        "sup", "cpu", cfg.general.seed, names,
+        snap["arrays"], snap["window_hist"],
+        host_window_hist=snap.get("host_window_hist"),
+        log_lost=snap.get("log_lost", 0),
+    )
+    netobs_bytes = json.dumps(report, sort_keys=True).encode()
+    turns_bytes = json.dumps(
+        rec.turns.report("sup"), sort_keys=True
+    ).encode()
+    return eng, res, netobs_bytes, turns_bytes
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("workers", [2, 4])
+def test_sigkill_chaos_recovery_bit_identical(workers, monkeypatch):
+    """SIGKILL a seeded-random worker mid-run: the supervisor respawns
+    it, replays its journal, and the event log plus the NETOBS/TURNS
+    artifacts byte-match the unfaulted run."""
+    _, clean, clean_netobs, clean_turns = _run_mp(workers)
+    serial = CpuEngine(_cfg()).run()
+    assert clean.log_tuples() == serial.log_tuples()
+
+    rng = random.Random(1000 + workers)  # the seeded chaos schedule
+    wid = rng.randrange(workers)
+    t_kill = rng.randrange(100, 400) * 1_000_000  # mid-run, ns
+    monkeypatch.setenv("SHADOW_TPU_TEST_WORKER_KILL", f"{wid}:{t_kill}")
+    eng, res, netobs_bytes, turns_bytes = _run_mp(workers)
+    assert eng.worker_restarts == 1
+    assert not eng.escalated
+    assert res.log_tuples() == clean.log_tuples()
+    assert res.counters == clean.counters
+    assert netobs_bytes == clean_netobs
+    assert turns_bytes == clean_turns
+
+
+def test_hung_worker_raises_diagnostic_within_deadline(monkeypatch):
+    """A hung worker must surface a diagnostic WorkerDiedError within
+    the heartbeat deadline — never the indefinite ``conn.recv()`` hang —
+    even with supervision (respawn) disabled."""
+    monkeypatch.setenv("SHADOW_TPU_TEST_WORKER_HANG", "0:100000000")
+    cfg = _cfg()
+    cfg.experimental.worker_restart_max = 0  # diagnosis only, no respawn
+    cfg.experimental.worker_heartbeat_s = 1.0
+    eng = MpCpuEngine(cfg, workers=2)
+    t0 = wall_time.perf_counter()
+    with pytest.raises(WorkerDiedError) as ei:
+        eng.run()
+    elapsed = wall_time.perf_counter() - t0
+    assert elapsed < 30.0  # deadline-bounded, not a hang
+    err = ei.value
+    assert err.worker_id == 0
+    assert err.round_no >= 0
+    assert err.last_msg_kind == "round"
+    assert "worker 0" in str(err)
+
+
+def test_hung_worker_escalates_to_serial_bit_identical(monkeypatch):
+    """A worker that hangs again after respawn (the journal replays it
+    into the same hang) exhausts worker_restart_max and the engine
+    escalates to the serial oracle — still bit-identical."""
+    serial = CpuEngine(_cfg()).run()
+    monkeypatch.setenv("SHADOW_TPU_TEST_WORKER_HANG", "0:100000000")
+    cfg = _cfg()
+    cfg.experimental.worker_restart_max = 1
+    cfg.experimental.worker_heartbeat_s = 1.0
+    eng = MpCpuEngine(cfg, workers=2)
+    res = eng.run()
+    assert eng.escalated
+    assert res.log_tuples() == serial.log_tuples()
+    assert res.counters == serial.counters
+
+
+def test_clean_run_has_no_restarts():
+    eng, res, _, _ = _run_mp(2)
+    assert eng.worker_restarts == 0
+    assert not eng.escalated
+    serial = CpuEngine(_cfg()).run()
+    assert res.log_tuples() == serial.log_tuples()
