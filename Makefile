@@ -6,8 +6,8 @@
 # CTest gate (src/test/determinism/CMakeLists.txt).
 
 .PHONY: test gate native smoke-faults smoke-examples lint-determinism \
-	bench-hybrid obs-smoke netobs-smoke turns-smoke fusion-smoke \
-	checkpoint-smoke chaos-smoke bench-report check-fixtures
+	bench-hybrid obs-smoke netobs-smoke flows-smoke turns-smoke \
+	fusion-smoke checkpoint-smoke chaos-smoke bench-report check-fixtures
 
 test: native
 	python -m pytest tests/ -q
@@ -24,6 +24,7 @@ gate: native check-fixtures lint-determinism
 	$(MAKE) smoke-examples
 	$(MAKE) obs-smoke
 	$(MAKE) netobs-smoke
+	$(MAKE) flows-smoke
 	$(MAKE) turns-smoke
 	$(MAKE) fusion-smoke
 	$(MAKE) checkpoint-smoke
@@ -85,6 +86,14 @@ obs-smoke:
 # sent == delivered + drops conservation (docs/observability.md).
 netobs-smoke:
 	JAX_PLATFORMS=cpu python scripts/netobs_smoke.py
+
+# Flowtrace smoke for the gate: a faulted loss-ramp stream run through
+# the CLI with --flowtrace --netobs, asserting a valid FLOWS_*.json
+# artifact, a sampled flow exhibiting the full send -> drop ->
+# retransmit -> delivery lifecycle, and event counts conserving against
+# the netobs counter plane (docs/observability.md).
+flows-smoke:
+	JAX_PLATFORMS=cpu python scripts/flows_smoke.py
 
 # Device-turn-ledger smoke for the gate: a gate-scale managed hybrid run
 # (relay chains, 2 syscall workers, CPU-JAX lanes) with --obs-turns

@@ -49,6 +49,9 @@ Env knobs (for local runs; the driver uses the defaults):
   SHADOW_TPU_BENCH_HYBRID_CHAINS hybrid relay chains (default 25 -> 151 procs)
   SHADOW_TPU_BENCH_HYBRID_SIM_SECONDS  hybrid simulated duration (default 10)
   SHADOW_TPU_BENCH_HYBRID_WORKERS  hybrid syscall workers (default 0 = cores)
+  SHADOW_TPU_BENCH_FLOWS         1 = run the untimed flowtrace evidence
+                                 pass on the mixed mesh (default 1)
+  SHADOW_TPU_BENCH_FLOWS_SAMPLE  flowtrace sampling fraction (default 0.02)
 """
 
 import json
@@ -94,6 +97,11 @@ HYBRID_WORKERS = int(os.environ.get("SHADOW_TPU_BENCH_HYBRID_WORKERS", "0"))
 # one extra UNTIMED mixed-mesh run with the telemetry plane on — the
 # timed best-of runs stay netobs-off so the headline numbers are clean
 NETOBS = os.environ.get("SHADOW_TPU_BENCH_NETOBS", "1") == "1"
+# and one with the flowtrace plane on: which flow classes populate the
+# busy mixed_window_hist buckets (untimed — flowtrace forces the
+# untiered stream path, an equivalent but slower execution)
+FLOWS = os.environ.get("SHADOW_TPU_BENCH_FLOWS", "1") == "1"
+FLOWS_SAMPLE = float(os.environ.get("SHADOW_TPU_BENCH_FLOWS_SAMPLE", "0.02"))
 
 
 # the tunneled runtime caches EXECUTIONS across processes keyed on
@@ -149,6 +157,56 @@ def _netobs_evidence(cfg, salt0):
         },
         "windows": int(hist.sum()),
         "throttled": int(snap["arrays"]["throttled"].sum()),
+    }
+
+
+def _flows_evidence(cfg, salt0):
+    """One flowtrace-enabled run of ``cfg``: the burst-attribution
+    ranking — which flow classes (mesh->mesh, stream->stream, ...)
+    populate which mixed_window_hist occupancy buckets — from the
+    per-flow lifecycle plane (obs/flowtrace.py).  Untimed: flowtrace
+    drops the stream tier (bit-identical results, slower execution), so
+    this run never mixes with the best-of timing samples.  Sampled
+    (FLOWS_SAMPLE of flow pairs) with ``events_lost`` reported, so a
+    truncated ring is visible rather than silently biased."""
+    import copy as _copy
+
+    from shadow_tpu.obs import flowtrace as ftr
+
+    cfg = _copy.deepcopy(cfg)
+    cfg.experimental.flowtrace = True
+    cfg.experimental.flowtrace_sample = FLOWS_SAMPLE
+    cfg.experimental.flowtrace_capacity = 1 << 20
+    # untiered stream packets ride the main [N] queue: the tiered shape
+    # (capacity 16) is far too narrow for a 2 MB stream's in-flight win
+    cfg.experimental.tpu_lane_queue_capacity = 4096
+    eng = TpuEngine(cfg, log_capacity=0)
+    eng.run(mode="device", cache_salt=salt0)
+    snap = eng.flowtrace_snapshot()
+    events, trunc = ftr.canonical_events(
+        snap["raw"], cfg.experimental.flowtrace_capacity
+    )
+    names = [h.hostname for h in cfg.hosts]
+    report = ftr.build_report(
+        "bench", "tpu", cfg.general.seed, names, events,
+        trunc + snap["ring_lost"], *ftr.sample_thresh(FLOWS_SAMPLE),
+        cfg.experimental.flowtrace_capacity,
+    )
+    return {
+        "sample": FLOWS_SAMPLE,
+        "num_events": report["num_events"],
+        "num_flows": report["num_flows"],
+        "events_lost": report["events_lost"],
+        "buckets": [
+            {
+                "bucket": b["bucket"],
+                "windows": b["windows"],
+                "top": {
+                    tc["class"]: tc["arrivals"] for tc in b["top_classes"]
+                },
+            }
+            for b in report["burst_attribution"]["buckets"]
+        ],
     }
 
 
@@ -367,6 +425,12 @@ def main() -> None:
             out["mixed_window_hist"] = ev["window_hist"]
             out["mixed_windows"] = ev["windows"]
             out["mixed_throttled"] = ev["throttled"]
+        if FLOWS:
+            # burst ATTRIBUTION: which flow classes fill those buckets
+            out["mixed_flow_attribution"] = _flows_evidence(
+                mixed_flagship_config(MIXED_HOSTS, sim_seconds=5),
+                _SALT + 600,
+            )
 
     # BASELINE.md ladder configs 1-3 (4 is above, 5 is the managed run)
     if LADDER:

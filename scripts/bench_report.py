@@ -92,6 +92,14 @@ KEY_ORDER = [
     "mixed_retransmits",
     "mixed_windows",
     "mixed_throttled",
+    # flowtrace burst attribution (obs/flowtrace.py — which flow classes
+    # fill the busy mixed_window_hist buckets; the per-bucket class
+    # ranking stays machine-readable in the BENCH json's
+    # mixed_flow_attribution.buckets list)
+    "mixed_flow_attribution.sample",
+    "mixed_flow_attribution.num_events",
+    "mixed_flow_attribution.num_flows",
+    "mixed_flow_attribution.events_lost",
 ]
 
 KEY_LABEL = {

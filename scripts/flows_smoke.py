@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""End-to-end flowtrace smoke (``make flows-smoke``, wired into ``make gate``).
+
+One CLI run of a faulted loss-ramp stream scenario (a lane-TCP transfer
+over a link whose loss spikes mid-run) with BOTH telemetry planes on:
+
+1. a valid ``FLOWS_*.json`` artifact (schema keys, canonical event
+   ordering, per-flow docs, burst-attribution buckets);
+2. a sampled flow that exhibits the full lifecycle — send, drop (loss),
+   retransmit, delivery — i.e. the loss ramp is visible per packet, not
+   just as totals;
+3. conservation against the netobs counter plane (sample = 1.0, so the
+   two planes observe the same population): flowtrace sends equal the
+   netobs ``sent`` total, deliveries equal ``delivered``, and loss/codel
+   drop events equal the netobs drop-cause totals.
+
+Exit 0 = all assertions hold; any failure raises (nonzero exit).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+# a 1 MB lane-TCP stream over a link whose loss ramps to 30% at 200 ms
+# and heals at 1.2 s: the transfer (~400 ms clean at 20 Mbit) straddles
+# the ramp, so data segments drop mid-flight AND recover (retransmit ->
+# delivery) before the run ends
+FAULTED_CFG = """
+general: {stop_time: 20s, seed: 9, heartbeat_interval: null,
+          bootstrap_end_time: 100ms}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        node [ id 1 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        edge [ source 0 target 1 latency "10 ms" packet_loss 0.02 ]
+      ]
+experimental: {network_backend: cpu}
+faults:
+  events:
+    - {kind: loss, at: 200ms, source: 0, target: 1, loss: 0.3}
+    - {kind: loss, at: 1200ms, source: 0, target: 1, loss: 0.02}
+hosts:
+  c:
+    network_node_id: 0
+    processes:
+      - path: stream-client
+        args: --server s --size 1000000
+  s:
+    network_node_id: 1
+    processes:
+      - path: stream-server
+"""
+
+
+def main() -> int:
+    from shadow_tpu.__main__ import main as cli_main
+    from shadow_tpu.obs import flowtrace as ftr
+
+    tmp = Path(tempfile.mkdtemp(prefix="shadow_flows_smoke_"))
+    try:
+        cfg_path = tmp / "faulted.yaml"
+        cfg_path.write_text(FAULTED_CFG)
+        data = tmp / "run"
+        rc = cli_main([
+            str(cfg_path),
+            "--data-directory", str(data),
+            "--flowtrace",
+            "--netobs",
+        ])
+        assert rc == 0, f"faulted run exited {rc}"
+
+        arts = sorted(data.glob("FLOWS_*.json"))
+        assert arts, f"no FLOWS_*.json in {data}"
+        rep = json.loads(arts[0].read_text())
+        for key in ("schema", "run_id", "backend", "seed", "events",
+                    "events_by_kind", "flows", "burst_attribution",
+                    "events_lost", "num_events"):
+            assert key in rep, f"FLOWS report missing {key!r}"
+        assert rep["events_lost"] == 0, "smoke ring overflowed"
+        events = [tuple(e) for e in rep["events"]]
+        assert events == sorted(events), "events not in canonical order"
+
+        # 2. the full lifecycle on one sampled flow: some packet was
+        # sent, lost to the ramp, re-sent as a new wire unit, delivered
+        kinds = rep["events_by_kind"]
+        for k in ("send", "tb_wait", "queue_enter", "drop",
+                  "retransmit", "delivery"):
+            assert kinds.get(k, 0) > 0, f"no {k!r} events: {kinds}"
+        fl = rep["flows"]["c->s"]
+        assert fl["drops"]["loss"] > 0, f"no loss drops on c->s: {fl}"
+        assert fl["retransmits"] > 0, f"no retransmits on c->s: {fl}"
+        assert fl["delivered"] > 0
+        # a retransmitted wire packet that went on to deliver
+        retx = {(e[3], e[4], e[5]) for e in events
+                if e[2] == ftr.FT_RETRANSMIT}
+        deliv = {(e[3], e[4], e[5]) for e in events
+                 if e[2] == ftr.FT_DELIVERY}
+        assert retx & deliv, "no retransmit->delivery join"
+
+        # 3. conservation vs the netobs plane (sample=1.0: both planes
+        # see every packet)
+        nrep = json.loads(next(data.glob("NETOBS_*.json")).read_text())
+        tot = nrep["totals"]
+        sends = kinds.get("send", 0) + kinds.get("retransmit", 0)
+        assert sends == tot["sent"], (sends, tot["sent"])
+        assert kinds.get("delivery", 0) == tot["delivered"]
+        loss = sum(1 for e in events
+                   if e[2] == ftr.FT_DROP and e[7] == ftr.CAUSE_LOSS)
+        codel = sum(1 for e in events
+                    if e[2] == ftr.FT_DROP and e[7] == ftr.CAUSE_CODEL)
+        assert loss == tot["drop_loss"], (loss, tot["drop_loss"])
+        assert codel == tot["drop_codel"], (codel, tot["drop_codel"])
+
+        print(
+            "flows-smoke OK: "
+            f"{rep['num_events']} events / {rep['num_flows']} flows; "
+            f"c->s lifecycle sends={fl['sends']} "
+            f"loss_drops={fl['drops']['loss']} "
+            f"retransmits={fl['retransmits']} delivered={fl['delivered']}"
+            " (artifact valid, netobs conservation holds)"
+        )
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,14 @@ def build_parser() -> argparse.ArgumentParser:
         "NETOBS_*.json run report (docs/observability.md)",
     )
     p.add_argument(
+        "--flowtrace",
+        action="store_true",
+        help="record per-flow packet-lifecycle events (send, bucket "
+        "wait, queue-enter, drop-with-cause, retransmit, delivery) and "
+        "write a FLOWS_*.json run report with burst attribution "
+        "(docs/observability.md)",
+    )
+    p.add_argument(
         "--obs-turns",
         action="store_true",
         help="record the device-turn ledger (turn-cause accounting + "
@@ -143,6 +151,8 @@ def main(argv: list[str] | None = None) -> int:
             overrides["experimental.obs_trace"] = True
         if ns.netobs:
             overrides["experimental.netobs"] = True
+        if ns.flowtrace:
+            overrides["experimental.flowtrace"] = True
         if ns.obs_turns:
             overrides["experimental.obs_turns"] = True
         cfg.apply_overrides(overrides)

@@ -32,6 +32,7 @@ from ..net.token_bucket import (
     TokenBucket,
     bucket_params,
 )
+from ..obs import flowtrace as ftr
 
 # event-log outcome codes (SEMANTICS.md)
 DELIVERED = 0
@@ -147,9 +148,19 @@ class Host:
         return len(self.engine.hosts)
 
     def send(self, dst: int, size_bytes: int, payload: object = None,
-             loopback: bool = False) -> int:
+             loopback: bool = False, retx: bool = False) -> int:
         return self.engine.send_packet(self, dst, size_bytes, payload,
-                                       loopback=loopback)
+                                       loopback=loopback, retx=retx)
+
+    def ft_giveup(self, dst: int) -> None:
+        """Flowtrace hook: a stream retry budget exhausted toward ``dst``
+        (oracle-only — the device's pump retries unboundedly, so this
+        event is structurally absent from parity scenarios)."""
+        ft = self.engine.flowtrace
+        if ft is not None and ft.sampled(self.host_id, dst):
+            ft.emit(self.host_id, self.now, self.engine.window_end,
+                    ftr.FT_DROP, self.host_id, dst, -1, 0,
+                    ftr.CAUSE_RETRY_GIVEUP)
 
     def set_timer(self, t_abs_ns: int) -> None:
         app = self._current_app
@@ -386,6 +397,15 @@ class CpuEngine:
             from ..obs.netobs import NetObs
 
             self.netobs = NetObs(len(self.hosts))
+        # flowtrace lifecycle plane (obs/flowtrace.py): per-event traces
+        # of deterministically-sampled flows; None = off = zero overhead
+        self.flowtrace = None
+        if cfg.experimental.flowtrace:
+            self.flowtrace = ftr.FlowTrace(
+                len(self.hosts), cfg.general.seed,
+                cfg.experimental.flowtrace_sample,
+                cfg.experimental.flowtrace_capacity,
+            )
         # [window-agg]/[host-exec-agg] telemetry sink (set by the facade
         # when experimental.perf_logging is on; None = zero overhead)
         self.perf_log = None
@@ -499,6 +519,31 @@ class CpuEngine:
             snap["arrays"], snap["window_hist"], names, host
         )
 
+    # -- flowtrace plane (obs/flowtrace.py) --------------------------------
+
+    def flowtrace_snapshot(self):
+        """The run's raw flow events (None when flowtrace is off).  The
+        oracle has no ring, so ``ring_lost`` is structurally 0; the
+        device capacity law is applied at export by
+        ``flowtrace.canonical_events``."""
+        ft = self.flowtrace
+        if ft is None:
+            return None
+        return {"raw": ft.raw_events(), "ring_lost": 0}
+
+    def flowtrace_lines(self, host=None) -> list[str]:
+        """Run-control ``flows [host]`` answer from live state."""
+        snap = self.flowtrace_snapshot()
+        if snap is None:
+            return ["flowtrace is not enabled (set experimental.flowtrace)"]
+        events, lost = ftr.canonical_events(
+            snap["raw"], self.flowtrace.capacity
+        )
+        names = [h.hostname for h in self.hosts]
+        return ftr.snapshot_lines(
+            events, lost + snap["ring_lost"], names, host=host
+        )
+
     def console_fault_sink(self, tokens: list[str]) -> str:
         """Run-control ``fault ...`` verb: schedule a fault at the current
         window boundary (effective for all subsequent sends).  Dynamic
@@ -521,14 +566,19 @@ class CpuEngine:
     # -- packet path (SEMANTICS.md lifecycle) ------------------------------
 
     def _packet_source_half(
-        self, src_host: Host, dst: int, size_bytes: int, payload: object
+        self, src_host: Host, dst: int, size_bytes: int, payload: object,
+        retx: bool = False,
     ) -> tuple[int, Optional[int]]:
         """The source half of the packet lifecycle (steps 1-4: seq, up
         bucket, outbound pcap, dynamic-runahead record, Bernoulli loss,
         arrival-time bump).  Returns ``(seq, arrival_time)`` — arrival is
         ``None`` when the packet was lost.  Shared verbatim by the CPU
         push sink below and the hybrid backend's device-injection sink
-        (backend/hybrid.py), so the law cannot drift between them."""
+        (backend/hybrid.py), so the law cannot drift between them.
+
+        ``retx`` marks a retransmitted stream segment: the flowtrace
+        send-stage event becomes FT_RETRANSMIT (same wire lifecycle
+        otherwise)."""
         t = src_host.now
         seq = src_host.send_seq
         src_host.send_seq += 1
@@ -536,9 +586,20 @@ class CpuEngine:
         no = self.netobs
         if no is not None:
             no.on_send(s, size_bytes)
+        ft = self.flowtrace
+        ft_on = ft is not None and ft.sampled(s, d)
+        if ft_on:
+            we = self.window_end
+            ft.emit(s, t, we, ftr.FT_RETRANSMIT if retx else ftr.FT_SEND,
+                    s, d, seq, size_bytes)
 
         bits = (size_bytes + FRAME_OVERHEAD_BYTES) * 8
         t_dep = src_host.up_bucket.charge(t, bits)
+        if ft_on and t_dep != t:
+            # the up bucket is charged before the loss draw on both
+            # backends, so the wait event lands for lost sends too
+            ft.emit(s, t_dep, we, ftr.FT_TB_WAIT, s, d, seq, size_bytes,
+                    ftr.TB_UP)
 
         if src_host.pcap is not None:  # outbound capture at departure
             src_host.pcap.capture(
@@ -558,18 +619,25 @@ class CpuEngine:
             if u < thresh:
                 if no is not None:
                     no.on_loss(s)
+                if ft_on:
+                    ft.emit(s, t, we, ftr.FT_DROP, s, d, seq, size_bytes,
+                            ftr.CAUSE_LOSS)
                 src_host.log_buf.append(LogRecord(t, s, d, seq, size_bytes, DROP_LOSS))
                 return seq, None
 
-        return seq, max(t_dep + lat_ns, self.window_end)
+        arr = max(t_dep + lat_ns, self.window_end)
+        if ft_on:
+            ft.emit(s, arr, we, ftr.FT_QUEUE_ENTER, s, d, seq, size_bytes)
+        return seq, arr
 
     def send_packet(
         self, src_host: Host, dst: int, size_bytes: int,
-        payload: object = None, loopback: bool = False,
+        payload: object = None, loopback: bool = False, retx: bool = False,
     ) -> int:
         if loopback:
             return self._loopback_send(src_host, size_bytes, payload)
-        seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload)
+        seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload,
+                                            retx=retx)
         if arr is None:
             return seq
         if self._turns_sends and src_host._ledger_managed:
@@ -604,6 +672,12 @@ class CpuEngine:
             # lo is both halves on one host: a send and a delivery
             no.on_send(host.host_id, size_bytes)
             no.on_delivered(host.host_id, size_bytes)
+        ft = self.flowtrace
+        if ft is not None and ft.sampled(host.host_id, host.host_id):
+            we = self.window_end
+            h = host.host_id
+            ft.emit(h, host.now, we, ftr.FT_SEND, h, h, seq, size_bytes)
+            ft.emit(h, t_deliver, we, ftr.FT_DELIVERY, h, h, seq, size_bytes)
         host.log_buf.append(
             LogRecord(t_deliver, host.host_id, host.host_id, seq,
                       size_bytes, DELIVERED)
@@ -632,15 +706,27 @@ class CpuEngine:
         t_deliver = dst_host.down_bucket.charge(ev.time, bits)
         sojourn = t_deliver - ev.time
         no = self.netobs
+        ft = self.flowtrace
+        d = dst_host.host_id
+        ft_on = ft is not None and ft.sampled(ev.src_host, d)
+        if ft_on and t_deliver != ev.time:
+            ft.emit(d, t_deliver, self.window_end, ftr.FT_TB_WAIT,
+                    ev.src_host, d, ev.seq, size_bytes, ftr.TB_DN)
         if dst_host.codel.offer(t_deliver, sojourn):
             if no is not None:
                 no.on_codel(dst_host.host_id)
+            if ft_on:
+                ft.emit(d, t_deliver, self.window_end, ftr.FT_DROP,
+                        ev.src_host, d, ev.seq, size_bytes, ftr.CAUSE_CODEL)
             dst_host.log_buf.append(
                 LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DROP_CODEL)
             )
             return
         if no is not None:
             no.on_delivered(dst_host.host_id, size_bytes)
+        if ft_on:
+            ft.emit(d, t_deliver, self.window_end, ftr.FT_DELIVERY,
+                    ev.src_host, d, ev.seq, size_bytes)
         dst_host.log_buf.append(
             LogRecord(t_deliver, ev.src_host, dst_host.host_id, ev.seq, size_bytes, DELIVERED)
         )

@@ -237,6 +237,14 @@ def _worker_main(
                     # executed here, so the parent's elementwise sum
                     # over workers reconstructs the full plane
                     engine.netobs_snapshot(),
+                    # flowtrace events: each event is emitted by exactly
+                    # one worker (the owner of the executing host), so
+                    # the parent's concatenation + canonical sort equals
+                    # the serial engine's stream
+                    (
+                        engine.flowtrace.raw_events()
+                        if engine.flowtrace is not None else None
+                    ),
                 ))
                 return
             else:  # pragma: no cover - protocol error
@@ -279,6 +287,9 @@ class MpCpuEngine:
         # netobs (obs/netobs.py): the parent owns the global window
         # histogram and the merged per-host arrays; populated by run()
         self._netobs = None
+        # flowtrace (obs/flowtrace.py): concatenated worker event
+        # streams; populated by run()
+        self._flowtrace = None
         # checkpoint/resume (engine/checkpoint.py): set a CheckpointManager
         # before run() to checkpoint every
         # ``experimental.checkpoint_every_windows`` rounds; run(...,
@@ -296,6 +307,25 @@ class MpCpuEngine:
         """The merged telemetry snapshot of the last run (None when
         netobs is off)."""
         return self._netobs
+
+    def flowtrace_snapshot(self):
+        """The merged flow-event snapshot of the last run (None when
+        flowtrace is off)."""
+        return self._flowtrace
+
+    def flowtrace_lines(self, host=None) -> list[str]:
+        from ..obs import flowtrace as ftr
+
+        snap = self._flowtrace
+        if snap is None:
+            return ["flowtrace is not enabled (set experimental.flowtrace)"]
+        events, lost = ftr.canonical_events(
+            snap["raw"], self.cfg.experimental.flowtrace_capacity
+        )
+        names = [h.hostname for h in self.cfg.hosts]
+        return ftr.snapshot_lines(
+            events, lost + snap["ring_lost"], names, host=host
+        )
 
     # -- escalation (supervisor.EscalateToSerial) --------------------------
 
@@ -317,6 +347,7 @@ class MpCpuEngine:
         eng.obs = self.obs
         result = eng.run(on_window=on_window)
         self._netobs = eng.netobs_snapshot()
+        self._flowtrace = eng.flowtrace_snapshot()
         return result
 
     # -- checkpoint assembly -----------------------------------------------
@@ -375,6 +406,7 @@ class MpCpuEngine:
             eng.obs = self.obs
             result = eng.run(on_window=on_window)
             self._netobs = eng.netobs_snapshot()
+            self._flowtrace = eng.flowtrace_snapshot()
             return result
         # the parent's replica serves the Controller role: initial
         # next-event times, runahead, stop time (no host ever executes
@@ -540,7 +572,9 @@ class MpCpuEngine:
             per_host: list[dict] = [{} for _ in range(n)]
             process_errors: list[str] = []
             nb_arrays = None
-            for logw, cnt, per, errs, wsnap in pool.finish():
+            ft_raw: list = []
+            flowtrace_on = self.cfg.experimental.flowtrace
+            for logw, cnt, per, errs, wsnap, wflows in pool.finish():
                 event_log.extend(logw)
                 for k, v in cnt.items():
                     counters[k] = counters.get(k, 0) + v
@@ -551,12 +585,16 @@ class MpCpuEngine:
                     if nb_arrays is None:
                         nb_arrays = nom.empty_arrays(n)
                     nom.merge_arrays(nb_arrays, wsnap["arrays"])
+                if wflows:
+                    ft_raw.extend(tuple(e) for e in wflows)
             if netobs_on and nb_arrays is not None:
                 self._netobs = {
                     "arrays": nb_arrays,
                     "window_hist": window_hist,
                     "log_lost": 0,
                 }
+            if flowtrace_on:
+                self._flowtrace = {"raw": ft_raw, "ring_lost": 0}
         except EscalateToSerial as esc:
             pool.close()
             self.worker_restarts = pool.restarts

@@ -177,7 +177,7 @@ class _HostSideHybrid(CpuEngine):
     # -- host-side packet source half (the law IS CpuEngine's) -------------
 
     def send_packet(self, src_host, dst, size_bytes, payload=None,
-                    loopback=False):
+                    loopback=False, retx=False):
         """The shared source half (``CpuEngine._packet_source_half``: up
         bucket, outbound pcap, dynamic-runahead record, Bernoulli loss)
         with a device-injection sink: the surviving packet is STAGED for
@@ -187,7 +187,8 @@ class _HostSideHybrid(CpuEngine):
         device: the lo interface is host-local by definition."""
         if loopback:
             return self._loopback_send(src_host, size_bytes, payload)
-        seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload)
+        seq, arr = self._packet_source_half(src_host, dst, size_bytes, payload,
+                                            retx=retx)
         if arr is None:
             return seq
         src_host.staged.append(
@@ -350,6 +351,12 @@ def _hybrid_worker_main(
                     list(getattr(engine, "process_errors", [])),
                     # netobs host-side arrays (owned hosts only executed)
                     engine.netobs_snapshot(),
+                    # flowtrace host-side events (each managed send's
+                    # source half is emitted by exactly one worker)
+                    (
+                        engine.flowtrace.raw_events()
+                        if engine.flowtrace is not None else None
+                    ),
                 ))
                 return
             else:  # pragma: no cover - protocol error
@@ -1446,6 +1453,23 @@ class HybridEngine(_HostSideHybrid):
             "log_lost": 0,
         }
 
+    def flowtrace_snapshot(self):
+        """The combined flow-event stream: host-side events (managed
+        sends' source half, loopback) concatenated with the device ring
+        (arrival halves, lane-model hosts' full lifecycles).  Each
+        lifecycle stage is emitted by exactly one side, so the
+        concatenation + canonical sort is the complete stream.  Drained
+        here only — at collect — never per turn, so ``sync_stats``
+        transfer counts are untouched by tracing."""
+        host = super().flowtrace_snapshot()
+        dev = self.device.flowtrace_snapshot()
+        if host is None or dev is None:
+            return None
+        return {
+            "raw": list(host["raw"]) + list(dev["raw"]),
+            "ring_lost": host["ring_lost"] + dev["ring_lost"],
+        }
+
     def _hybrid_loop(self, scheduler, on_window, t0) -> SimResult:
         state = self._window_loop(
             lambda until: self._service_round(scheduler, until), on_window
@@ -1680,6 +1704,21 @@ class MpHybridEngine(HybridEngine):
             "log_lost": 0,
         }
 
+    def flowtrace_snapshot(self):
+        """Worker-merged host events + device ring events (see
+        HybridEngine.flowtrace_snapshot for the split law)."""
+        wft = getattr(self, "_worker_ft", None)
+        if wft is None:
+            # serial / degenerate (workers == 1) path ran in-process
+            return super().flowtrace_snapshot()
+        dev = self.device.flowtrace_snapshot()
+        if dev is None:
+            return None
+        return {
+            "raw": list(wft) + list(dev["raw"]),
+            "ring_lost": dev["ring_lost"],
+        }
+
     # -- run ---------------------------------------------------------------
 
     def run(self, on_window=None) -> SimResult:
@@ -1740,10 +1779,11 @@ class MpHybridEngine(HybridEngine):
         per_host: list[dict] = [{} for _ in range(len(self.hosts))]
         process_errors: list[str] = []
         self._worker_nb = None
+        self._worker_ft = None
         for conn in conns:
             conn.send(("finish",))
         for w, conn in enumerate(conns):
-            wlog, cnt, per, errs, wsnap = recv_with_deadline(
+            wlog, cnt, per, errs, wsnap, wflows = recv_with_deadline(
                 conn, procs[w], self._heartbeat_s, w, self._round_no,
                 "finish",
             )
@@ -1759,6 +1799,10 @@ class MpHybridEngine(HybridEngine):
                 if self._worker_nb is None:
                     self._worker_nb = nom.empty_arrays(len(self.hosts))
                 nom.merge_arrays(self._worker_nb, wsnap["arrays"])
+            if wflows is not None:
+                if self._worker_ft is None:
+                    self._worker_ft = []
+                self._worker_ft.extend(tuple(e) for e in wflows)
         wall = wall_time.perf_counter() - t0
 
         dev_result = self.device.collect(state, wall)

@@ -56,6 +56,7 @@ from ..core import rng as rng_mod
 from ..core import time as stime
 from ..net import codel as codel_mod
 from ..net.token_bucket import DEFAULT_INTERVAL_NS, FRAME_OVERHEAD_BYTES
+from ..obs import flowtrace as ftr
 from . import lanes_pairs as _pairs
 from . import lanes_stream as lstr
 
@@ -260,6 +261,16 @@ class LaneState(NamedTuple):
     nb_shed: Any = ()  # [N] int32: cross-block sheds (subset of n_queue)
     nb_hist: Any = ()  # [NB_HIST_BUCKETS] int32 packet-arrival histogram
     nb_win: Any = ()  # int32 scalar: packets popped in the current window
+    # flowtrace event ring (LaneParams.flowtrace; obs/flowtrace.py): a
+    # bounded [FL, FT_COLS] int32 buffer of per-flow lifecycle events for
+    # deterministically-sampled (src, dst) flows, drained only at
+    # snapshot epochs / end-of-run.  Same zero-overhead law as nb_*:
+    # () when off, every append behind `if p.flowtrace`.  The ring NEVER
+    # wraps — overflow stops recording and counts into fl_lost (the
+    # log_lost law), so artifacts stay byte-stable.
+    fl_buf: Any = ()  # [FL, flowtrace.FT_COLS] int32 event rows
+    fl_count: Any = ()  # int32 scalar: rows appended
+    fl_lost: Any = ()  # int32 scalar: events dropped on ring overflow
 
 
 @dataclasses.dataclass(frozen=True)
@@ -332,6 +343,15 @@ class LaneParams:
     # netobs telemetry plane (obs/netobs.py): static — off compiles every
     # counter update away (the LaneState nb_* fields stay ())
     netobs: bool = False
+    # flowtrace plane (obs/flowtrace.py): static — off compiles every
+    # event append away (the LaneState fl_* fields stay ()).  Sampling is
+    # the seeded-hash law shared with the CPU oracle: a flow (src, dst)
+    # records iff flow_all or flow_hash < flow_thresh (uint32 compare).
+    flowtrace: bool = False
+    flow_capacity: int = 0  # FL (ring rows)
+    flow_thresh: int = 0  # uint32 sampling threshold (flowtrace.sample_thresh)
+    flow_all: bool = False  # sample == 1.0: every flow records
+    flow_seed: int = 0  # sampling seed (folded into the hash)
     external_any: bool = False
     egress_capacity: int = 0  # E (rows in the egress buffer)
     ext_per_iter: int = 0  # worst-case egress appends per iteration
@@ -364,6 +384,15 @@ class LaneParams:
         if self.cross_capacity < 0:
             raise ValueError(
                 f"cross_capacity={self.cross_capacity} must be >= 0"
+            )
+        if self.flowtrace and self.stream_tiered:
+            # flowtrace instruments the [N] untiered path only; engines
+            # drop the tier (an equivalent, faster execution strategy)
+            # when tracing so event streams stay bit-identical
+            raise ValueError("flowtrace requires stream_tiered=False")
+        if self.flowtrace and self.flow_capacity <= 0:
+            raise ValueError(
+                f"flowtrace requires flow_capacity > 0 (got {self.flow_capacity})"
             )
 
 
@@ -783,6 +812,10 @@ class _SlotEmit(NamedTuple):
     rec_seq: jnp.ndarray
     rec_size: jnp.ndarray
     rec_outcome: jnp.ndarray
+    # flowtrace channel: dict of per-slot lifecycle observations
+    # (obs/flowtrace.py event sources; () unless p.flowtrace).  Dicts are
+    # pytrees, so scan stacking handles the bundle like any other leaf.
+    ft: Any = ()
 
 
 def _process_slot(
@@ -1243,7 +1276,9 @@ def _process_slot(
             g_ldl[cl_sl], g_nloss[cl_sl], s.min_used_lat,
             st_send[cl_sl].astype(i32), zero_c, zero_c,
         )
-        st_burst_c = jax.tree.map(lambda a: a[:, cl_sl], tuple(st_burst))
+        # the burst chain consumes the first five columns; the sixth
+        # (retransmit marker) is a flowtrace-only channel read below
+        st_burst_c = jax.tree.map(lambda a: a[:, cl_sl], tuple(st_burst[:5]))
         first_cols = jax.tree.map(lambda a: a[0], st_burst_c)
         rest_cols = jax.tree.map(lambda a: a[1:], st_burst_c)
         carry, out0 = bstep_body(carry0, first_cols, True)
@@ -1366,6 +1401,42 @@ def _process_slot(
     # lanes never take this generic timer re-arm, so their local_seq is
     # consumed only through the gathered counters)
 
+    # ---- flowtrace channel (obs/flowtrace.py): raw lifecycle observations
+    # for this slot, reduced to events post-scan (_build_iter).  Stamps
+    # follow the oracle laws exactly: send/loss at stimulus t, TB wait at
+    # bucket departure, queue-enter/delivery/codel at arrival time.
+    if p.flowtrace:
+        ft = {
+            # generic [N] sends (lane -> dst)
+            "sd_valid": do_send, "sd_dst": dst, "sd_seq": snd_seq,
+            "sd_size": out_size, "sd_thi": thi, "sd_tlo": tlo,
+            "sd_dhi": dep_hi, "sd_dlo": dep_lo, "sd_lost": lost,
+            "sd_ahi": arr_hi, "sd_alo": arr_lo,
+            # generic [N] packet arrivals (src -> lane)
+            "ar_valid": is_pkt, "ar_src": src, "ar_seq": seq,
+            "ar_size": size, "ar_thi": thi, "ar_tlo": tlo,
+            "ar_dhi": td_hi, "ar_dlo": td_lo, "ar_drop": codel_drop,
+        }
+        if sp:
+            ft.update({
+                # stream slot-0 control sends [2S] (endpoint -> peer)
+                "ss_valid": st_send, "ss_retx": sem.send_retx & st_send,
+                "ss_seq": se_seq, "ss_size": se_size,
+                "ss_thi": ethi, "ss_tlo": etlo,
+                "ss_dhi": se_dep_hi, "ss_dlo": se_dep_lo,
+                "ss_lost": se_lost, "ss_ahi": se_thi, "ss_alo": se_tlo,
+                # stream burst data segments [B, S] (client -> server)
+                "bs_valid": bo_valid | blost_all,
+                "bs_retx": st_burst[5][:, cl_sl],
+                "bs_seq": bo_auxl, "bs_size": bo_size,
+                "bs_thi": jnp.broadcast_to(cthi[None, :], bo_valid.shape),
+                "bs_tlo": jnp.broadcast_to(ctlo[None, :], bo_valid.shape),
+                "bs_dhi": bdep_hi_all, "bs_dlo": bdep_lo_all,
+                "bs_lost": blost_all, "bs_ahi": bo_thi, "bs_alo": bo_tlo,
+            })
+    else:
+        ft = ()
+
     # ---- log record (≤1 per slot: packet outcome, or send loss) ----------
     rec_valid = pk_rec_valid | lost
     if p.log_capacity:
@@ -1394,6 +1465,7 @@ def _process_slot(
         bpc_valid, bpc_time, bpc_seq, bpc_size,
         pc_valid, pc_time, pc_dst, pc_seq, pc_size,
         rec_valid, rec_time, rec_src, rec_dst, rec_seq, rec_size, rec_outcome,
+        ft,
     )
     return s, emit
 
@@ -1732,6 +1804,26 @@ def _merge_append(p: LaneParams, tb: LaneTables, s: LaneState,
         s = s._replace(nb_shed=s.nb_shed + lost_pre)
     if sp:
         s = s._replace(q_phi=mphi[:, :c], q_plo=mplo[:, :c])
+    if p.flowtrace:
+        # queue-overflow drops for sampled flows, from the merge tail's
+        # pair times directly (no int64 re-split).  PACKET rows only: the
+        # oracle's heap is unbounded, so these are dead in parity runs
+        # (strict mode raises on any shed).  Cross-block sheds (lost_pre)
+        # lose entry identity in the window gather and stay count-only —
+        # the netobs nb_shed counter covers them (CAUSE_CROSS_SHED is
+        # reserved for the oracle-side accounting).
+        fq_kind, fq_src = unpack_aux_hi(mh[:, c:])
+        fq_rows = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32)[:, None], tail_mask.shape
+        )
+        fq_valid = (
+            tail_mask & (fq_kind == PACKET)
+            & _flow_sampled(p, fq_src, fq_rows)
+        )
+        s = _append_flow(p, s, _flow_group(
+            fq_valid, mthi[:, c:], mtlo[:, c:], ftr.FT_DROP, fq_src,
+            fq_rows, ml[:, c:], ms[:, c:], ftr.CAUSE_QUEUE,
+        ))
 
     # overflow log records from the merge tail (pre-gather losses surface
     # only in n_queue; both paths raise in strict mode).  Only materialized
@@ -1863,6 +1955,19 @@ def _merge_stream_rows(p: LaneParams, tb: LaneTables, s: LaneState,
             tail_mask.sum(axis=1, dtype=jnp.int32)
         ),
     )
+    if p.flowtrace:
+        # queue-overflow drops at the stream lanes (same law as the main
+        # merge tail in _merge_append — PACKET rows only, sampled flows)
+        fq_kind, fq_src = unpack_aux_hi(mh[:, c:])
+        fq_rows = jnp.broadcast_to(el[:, None], tail_mask.shape)
+        fq_valid = (
+            tail_mask & (fq_kind == PACKET)
+            & _flow_sampled(p, fq_src, fq_rows)
+        )
+        s = _append_flow(p, s, _flow_group(
+            fq_valid, mthi[:, c:], mtlo[:, c:], ftr.FT_DROP, fq_src,
+            fq_rows, ml[:, c:], ms[:, c:], ftr.CAUSE_QUEUE,
+        ))
     if p.log_capacity == 0:
         return s, None
     t_tail = t_join(mthi[:, c:], mtlo[:, c:])
@@ -1910,6 +2015,139 @@ def _append_log(p: LaneParams, s: LaneState, recs) -> LaneState:
         log_count=s.log_count + n_valid,
         log_lost=s.log_lost + (n_valid - n_kept),
     )
+
+
+def flow_hash_lane(src, dst, seed: int):
+    """Device twin of ``obs.flowtrace.flow_hash`` (fid = 0): the same u32
+    mix + murmur3 fmix32, on ``jnp.uint32`` lanes — bit-identical to the
+    Python ints for any int32 host indices, so device and oracle sample
+    the same flows with no coordination."""
+    u32 = jnp.uint32
+    h = (
+        src.astype(u32) * u32(2654435761)
+        + dst.astype(u32) * u32(2246822519)
+        + u32((seed * 668265263) & 0xFFFFFFFF)
+    )
+    h = h ^ (h >> 16)
+    h = h * u32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * u32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _flow_sampled(p: LaneParams, src, dst):
+    """[shape-of-src] bool: the (src, dst) flow records flowtrace events
+    (the static all-pass / none fast paths trace zero hash ops)."""
+    if p.flow_all:
+        return jnp.ones(jnp.broadcast_shapes(src.shape, dst.shape),
+                        dtype=bool)
+    if p.flow_thresh == 0:
+        return jnp.zeros(jnp.broadcast_shapes(src.shape, dst.shape),
+                         dtype=bool)
+    return flow_hash_lane(src, dst, p.flow_seed) < jnp.uint32(p.flow_thresh)
+
+
+def _append_flow(p: LaneParams, s: LaneState, rows) -> LaneState:
+    """Append sampled lifecycle events to the flowtrace ring — the
+    ``_append_log`` law on ``[FL, FT_COLS]`` int32 rows: contiguous
+    cumsum positions, never wrap, overflow counts into ``fl_lost``.
+    ``rows`` is a dict of flat int32/bool columns (valid, t_hi, t_lo,
+    kind, src, dst, seq, size, aux); the window stamp broadcasts from
+    the state's current pair."""
+    if not p.flowtrace:
+        return s
+    i32 = jnp.int32
+    valid = rows["valid"]
+    m = valid.shape[0]
+    offs = jnp.cumsum(valid.astype(i32)) - 1
+    pos = s.fl_count + offs
+    ok = valid & (pos < p.flow_capacity)
+    idx = jnp.where(ok, pos, p.flow_capacity)
+    we_hi = jnp.broadcast_to(s.now_we_hi, (m,)).astype(i32)
+    we_lo = jnp.broadcast_to(s.now_we_lo, (m,)).astype(i32)
+    row = jnp.stack(
+        [
+            rows["t_hi"].astype(i32),
+            rows["t_lo"].astype(i32),
+            we_hi,
+            we_lo,
+            rows["kind"].astype(i32),
+            rows["src"].astype(i32),
+            rows["dst"].astype(i32),
+            rows["seq"].astype(i32),
+            rows["size"].astype(i32),
+            rows["aux"].astype(i32),
+        ],
+        axis=1,
+    )
+    fl_buf = s.fl_buf.at[idx].set(row, mode="drop")
+    n_valid = valid.sum(dtype=i32)
+    n_kept = ok.sum(dtype=i32)
+    return s._replace(
+        fl_buf=fl_buf,
+        fl_count=s.fl_count + n_valid,
+        fl_lost=s.fl_lost + (n_valid - n_kept),
+    )
+
+
+def _flow_group(valid, t_hi, t_lo, kind, src, dst, seq, size, aux):
+    """One flattened flowtrace event group (scalar kind/aux broadcast)."""
+    shape = valid.shape
+    i32 = jnp.int32
+
+    def col(v):
+        a = jnp.asarray(v, dtype=i32)
+        return jnp.broadcast_to(a, shape).reshape(-1)
+
+    return {
+        "valid": valid.reshape(-1),
+        "t_hi": col(t_hi), "t_lo": col(t_lo),
+        "kind": col(kind), "src": col(src), "dst": col(dst),
+        "seq": col(seq), "size": col(size), "aux": col(aux),
+    }
+
+
+def _concat_flow_groups(groups):
+    return {
+        k: jnp.concatenate([g[k] for g in groups]) for k in groups[0]
+    }
+
+
+def _ft_dead(p: LaneParams):
+    """Zeros flowtrace channel matching the live ``ft`` dict built by
+    ``_process_slot`` (lax.cond branches must return identical pytrees)."""
+    if not p.flowtrace:
+        return ()
+    n = p.n_lanes
+    nb = jnp.zeros(n, dtype=bool)
+    z32 = jnp.zeros(n, dtype=jnp.int32)
+    ft = {
+        "sd_valid": nb, "sd_dst": z32, "sd_seq": z32, "sd_size": z32,
+        "sd_thi": z32, "sd_tlo": z32, "sd_dhi": z32, "sd_dlo": z32,
+        "sd_lost": nb, "sd_ahi": z32, "sd_alo": z32,
+        "ar_valid": nb, "ar_src": z32, "ar_seq": z32, "ar_size": z32,
+        "ar_thi": z32, "ar_tlo": z32, "ar_dhi": z32, "ar_dlo": z32,
+        "ar_drop": nb,
+    }
+    if p.stream_present:
+        from ..net import ltcp as _ltcp
+
+        s2 = 2 * len(p.stream_clients)
+        eb = jnp.zeros(s2, dtype=bool)
+        ei = jnp.zeros(s2, dtype=jnp.int32)
+        bshape = (_ltcp.PUMP_BURST, s2 // 2)
+        bb = jnp.zeros(bshape, dtype=bool)
+        bi = jnp.zeros(bshape, dtype=jnp.int32)
+        ft.update({
+            "ss_valid": eb, "ss_retx": eb, "ss_seq": ei, "ss_size": ei,
+            "ss_thi": ei, "ss_tlo": ei, "ss_dhi": ei, "ss_dlo": ei,
+            "ss_lost": eb, "ss_ahi": ei, "ss_alo": ei,
+            "bs_valid": bb, "bs_retx": bb, "bs_seq": bi, "bs_size": bi,
+            "bs_thi": bi, "bs_tlo": bi, "bs_dhi": bi, "bs_dlo": bi,
+            "bs_lost": bb, "bs_ahi": bi, "bs_alo": bi,
+        })
+    return ft
 
 
 def _append_egress(p: LaneParams, s: LaneState, valid, delivered,
@@ -2270,7 +2508,9 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
             up_ldl[cl_sl], up_nloss[cl_sl], mul,
             st_send[cl_sl].astype(i32), zero_cc, zero_cc,
         )
-        st_burst_c = jax.tree.map(lambda a: a[:, cl_sl], tuple(st_burst))
+        # first five burst columns only (the sixth is the flowtrace
+        # retransmit marker; flowtrace forbids the tier — see LaneParams)
+        st_burst_c = jax.tree.map(lambda a: a[:, cl_sl], tuple(st_burst[:5]))
         first_cols = jax.tree.map(lambda a: a[0], st_burst_c)
         rest_cols = jax.tree.map(lambda a: a[1:], st_burst_c)
         carry, out0 = bstep(carry0, first_cols, True)
@@ -2745,6 +2985,7 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                     *se, *sa, *bo, *srec, *brec, *spc, *bpc,
                     *pc,
                     nb, z64, z64, z64, z64, z64, z64,
+                    _ft_dead(p_lane),
                 )
 
             return lax.cond(jnp.any(slot_cols["act"]), live, dead, st)
@@ -2883,6 +3124,130 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
                 "outcome": jnp.full(
                     (kk * bb * s_flows,), DROP_LOSS, dtype=jnp.int64),
             })
+        if p.flowtrace:
+            # reduce the per-slot flowtrace observations to lifecycle
+            # events and append once (obs/flowtrace.py stamp laws: send /
+            # loss at stimulus t, TB wait at bucket departure, queue-enter
+            # at arrival, delivery / codel at the dn departure)
+            ftc = emits.ft
+            lanes_i = jnp.arange(p.n_lanes, dtype=jnp.int32)
+            kk = ftc["sd_valid"].shape[0]
+            lanes_k = jnp.broadcast_to(lanes_i[None, :], (kk, p.n_lanes))
+            sd_smp = _flow_sampled(p, lanes_k, ftc["sd_dst"])
+            ar_smp = _flow_sampled(p, ftc["ar_src"], lanes_k)
+            sd_wait = (
+                (ftc["sd_dhi"] != ftc["sd_thi"])
+                | (ftc["sd_dlo"] != ftc["sd_tlo"])
+            )
+            ar_wait = (
+                (ftc["ar_dhi"] != ftc["ar_thi"])
+                | (ftc["ar_dlo"] != ftc["ar_tlo"])
+            )
+            groups = [
+                # generic sends (lane -> dst): SEND at stimulus t, UP-side
+                # TB wait at departure (lost sends charge the bucket too),
+                # loss drop at stimulus t, queue-enter at arrival
+                _flow_group(
+                    ftc["sd_valid"] & sd_smp, ftc["sd_thi"], ftc["sd_tlo"],
+                    ftr.FT_SEND, lanes_k, ftc["sd_dst"], ftc["sd_seq"],
+                    ftc["sd_size"], 0),
+                _flow_group(
+                    ftc["sd_valid"] & sd_wait & sd_smp,
+                    ftc["sd_dhi"], ftc["sd_dlo"], ftr.FT_TB_WAIT, lanes_k,
+                    ftc["sd_dst"], ftc["sd_seq"], ftc["sd_size"],
+                    ftr.TB_UP),
+                _flow_group(
+                    ftc["sd_lost"] & sd_smp, ftc["sd_thi"], ftc["sd_tlo"],
+                    ftr.FT_DROP, lanes_k, ftc["sd_dst"], ftc["sd_seq"],
+                    ftc["sd_size"], ftr.CAUSE_LOSS),
+                _flow_group(
+                    ftc["sd_valid"] & ~ftc["sd_lost"] & sd_smp,
+                    ftc["sd_ahi"], ftc["sd_alo"], ftr.FT_QUEUE_ENTER,
+                    lanes_k, ftc["sd_dst"], ftc["sd_seq"], ftc["sd_size"],
+                    0),
+                # packet arrivals (src -> lane): DN-side TB wait, codel
+                # drop or delivery — all at the dn bucket departure
+                _flow_group(
+                    ftc["ar_valid"] & ar_wait & ar_smp,
+                    ftc["ar_dhi"], ftc["ar_dlo"], ftr.FT_TB_WAIT,
+                    ftc["ar_src"], lanes_k, ftc["ar_seq"], ftc["ar_size"],
+                    ftr.TB_DN),
+                _flow_group(
+                    ftc["ar_valid"] & ftc["ar_drop"] & ar_smp,
+                    ftc["ar_dhi"], ftc["ar_dlo"], ftr.FT_DROP,
+                    ftc["ar_src"], lanes_k, ftc["ar_seq"], ftc["ar_size"],
+                    ftr.CAUSE_CODEL),
+                _flow_group(
+                    ftc["ar_valid"] & ~ftc["ar_drop"] & ar_smp,
+                    ftc["ar_dhi"], ftc["ar_dlo"], ftr.FT_DELIVERY,
+                    ftc["ar_src"], lanes_k, ftc["ar_seq"], ftc["ar_size"],
+                    0),
+            ]
+            if p_lane.stream_present:
+                kk2, s2 = ftc["ss_valid"].shape
+                s_f = s2 // 2
+                el_k = jnp.broadcast_to(
+                    tb.flow_lanes[None, :], (kk2, s2))
+                pe_k = jnp.broadcast_to(
+                    tb.flow_peers[None, :], (kk2, s2))
+                ss_smp = _flow_sampled(p, el_k, pe_k)
+                ss_kind = jnp.where(
+                    ftc["ss_retx"], ftr.FT_RETRANSMIT, ftr.FT_SEND)
+                ss_wait = (
+                    (ftc["ss_dhi"] != ftc["ss_thi"])
+                    | (ftc["ss_dlo"] != ftc["ss_tlo"])
+                )
+                bs_shape = ftc["bs_valid"].shape
+                el_b = jnp.broadcast_to(
+                    tb.flow_lanes[:s_f][None, None, :], bs_shape)
+                pe_b = jnp.broadcast_to(
+                    tb.flow_peers[:s_f][None, None, :], bs_shape)
+                bs_smp = _flow_sampled(p, el_b, pe_b)
+                bs_kind = jnp.where(
+                    ftc["bs_retx"], ftr.FT_RETRANSMIT, ftr.FT_SEND)
+                bs_wait = (
+                    (ftc["bs_dhi"] != ftc["bs_thi"])
+                    | (ftc["bs_dlo"] != ftc["bs_tlo"])
+                )
+                groups += [
+                    # stream slot-0 control sends (endpoint -> peer)
+                    _flow_group(
+                        ftc["ss_valid"] & ss_smp, ftc["ss_thi"],
+                        ftc["ss_tlo"], ss_kind, el_k, pe_k, ftc["ss_seq"],
+                        ftc["ss_size"], 0),
+                    _flow_group(
+                        ftc["ss_valid"] & ss_wait & ss_smp,
+                        ftc["ss_dhi"], ftc["ss_dlo"], ftr.FT_TB_WAIT,
+                        el_k, pe_k, ftc["ss_seq"], ftc["ss_size"],
+                        ftr.TB_UP),
+                    _flow_group(
+                        ftc["ss_lost"] & ss_smp, ftc["ss_thi"],
+                        ftc["ss_tlo"], ftr.FT_DROP, el_k, pe_k,
+                        ftc["ss_seq"], ftc["ss_size"], ftr.CAUSE_LOSS),
+                    _flow_group(
+                        ftc["ss_valid"] & ~ftc["ss_lost"] & ss_smp,
+                        ftc["ss_ahi"], ftc["ss_alo"], ftr.FT_QUEUE_ENTER,
+                        el_k, pe_k, ftc["ss_seq"], ftc["ss_size"], 0),
+                    # burst data segments (client -> server)
+                    _flow_group(
+                        ftc["bs_valid"] & bs_smp, ftc["bs_thi"],
+                        ftc["bs_tlo"], bs_kind, el_b, pe_b, ftc["bs_seq"],
+                        ftc["bs_size"], 0),
+                    _flow_group(
+                        ftc["bs_valid"] & bs_wait & bs_smp,
+                        ftc["bs_dhi"], ftc["bs_dlo"], ftr.FT_TB_WAIT,
+                        el_b, pe_b, ftc["bs_seq"], ftc["bs_size"],
+                        ftr.TB_UP),
+                    _flow_group(
+                        ftc["bs_lost"] & bs_smp, ftc["bs_thi"],
+                        ftc["bs_tlo"], ftr.FT_DROP, el_b, pe_b,
+                        ftc["bs_seq"], ftc["bs_size"], ftr.CAUSE_LOSS),
+                    _flow_group(
+                        ftc["bs_valid"] & ~ftc["bs_lost"] & bs_smp,
+                        ftc["bs_ahi"], ftc["bs_alo"], ftr.FT_QUEUE_ENTER,
+                        el_b, pe_b, ftc["bs_seq"], ftc["bs_size"], 0),
+                ]
+            s = _append_flow(p, s, _concat_flow_groups(groups))
         return s._replace(iters=s.iters + 1)
 
     return iter_body
@@ -2972,6 +3337,9 @@ _EG_SCALARS = ("egress_count", "egress_lost", "egress_min_hi",
 # scalar vector, and the [B] histogram is its own carry leaf
 _NB_N_FIELDS = ("nb_txb", "nb_rxb", "nb_thr", "nb_shed")
 _NB_SCALARS = ("nb_win",)
+# flowtrace extension (present only when LaneParams.flowtrace): the ring
+# cursor/lost ride the scalar vector, the [FL, F] ring is its own leaf
+_FL_SCALARS = ("fl_count", "fl_lost")
 
 
 def pack_state(s: LaneState):
@@ -2988,25 +3356,29 @@ def pack_state(s: LaneState):
         + [getattr(s, f) for f in nb_fields]
     )
     has_eg = not isinstance(s.egress, tuple)
+    has_fl = not isinstance(s.fl_buf, tuple)
     sc_fields = (
         _SCALAR_FIELDS
         + (_EG_SCALARS if has_eg else ())
         + (_NB_SCALARS if has_nb else ())
+        + (_FL_SCALARS if has_fl else ())
     )
     sc = jnp.stack(
         [jnp.asarray(getattr(s, f), dtype=jnp.int32) for f in sc_fields]
     )
-    return (q, c32, sc, s.log, s.stream, s.egress, s.nb_hist)
+    return (q, c32, sc, s.log, s.stream, s.egress, s.nb_hist, s.fl_buf)
 
 
 def unpack_state(carry) -> LaneState:
-    q, c32, sc, log, stream, egress, nb_hist = carry
+    q, c32, sc, log, stream, egress, nb_hist, fl_buf = carry
     has_pay = q.shape[0] == 7
     # extras beyond the base scalar vector disambiguate which optional
-    # blocks are live: egress adds 4 scalars, netobs adds 1
+    # blocks are live: egress adds 4 scalars, netobs adds 1, flowtrace
+    # adds 2 — every combination lands on a distinct count in 0..7
     extra = sc.shape[0] - len(_SCALAR_FIELDS)
     has_eg = extra >= 4
-    has_nb = extra in (1, 5)
+    has_nb = extra in (1, 3, 5, 7)
+    has_fl = extra in (2, 3, 6, 7)
     kw = {f: c32[i] for i, f in enumerate(_I32_N_FIELDS)}
     n_base = len(_I32_N_FIELDS) + 1  # + cd_dropping
     if has_nb:
@@ -3017,6 +3389,7 @@ def unpack_state(carry) -> LaneState:
         _SCALAR_FIELDS
         + (_EG_SCALARS if has_eg else ())
         + (_NB_SCALARS if has_nb else ())
+        + (_FL_SCALARS if has_fl else ())
     )
     kw.update({f: sc[i] for i, f in enumerate(sc_fields)})
     return LaneState(
@@ -3024,7 +3397,7 @@ def unpack_state(carry) -> LaneState:
         q_phi=q[5] if has_pay else (), q_plo=q[6] if has_pay else (),
         stream=stream,
         cd_dropping=c32[len(_I32_N_FIELDS)].astype(bool),
-        log=log, egress=egress, nb_hist=nb_hist, **kw,
+        log=log, egress=egress, nb_hist=nb_hist, fl_buf=fl_buf, **kw,
     )
 
 

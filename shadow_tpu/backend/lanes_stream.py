@@ -190,6 +190,7 @@ class StreamEmit(NamedTuple):
     send_seq: jnp.ndarray
     send_ack: jnp.ndarray
     send_size: jnp.ndarray  # wire size
+    send_retx: jnp.ndarray  # the send is a retransmission (flowtrace)
     rto_valid: jnp.ndarray  # arm an RTO LOCAL
     rto_thi: jnp.ndarray  # pair: RTO event time
     rto_tlo: jnp.ndarray
@@ -417,6 +418,7 @@ def _emit_unit(f: FlowCols, unit, m, retransmit, em):
         send_seq=jnp.where(m, unit, em.send_seq),
         send_ack=jnp.where(m, f.rcv_nxt, em.send_ack),
         send_size=jnp.where(m, send_size, em.send_size),
+        send_retx=jnp.where(m, retransmit, em.send_retx),
     )
     return f, em
 
@@ -431,6 +433,7 @@ def _empty_emit(n: int) -> StreamEmit:
         send_seq=z32,
         send_ack=z32,
         send_size=z32,
+        send_retx=zb,
         rto_valid=zb,
         rto_thi=z32,
         rto_tlo=z32,
@@ -461,9 +464,12 @@ def pump_epilogue_vec(f: FlowCols, nh, nl, m, em):
     transmit up to PUMP_BURST window-permitted units.  Runs ONCE per
     stimulus, after the handler's primary effects.  Returns
     ``(f, em, burst)`` where ``burst`` is a ``(valid, flags, seq, ack,
-    size)`` tuple of stacked [PUMP_BURST, N] arrays whose validity is a
-    PREFIX along axis 0 (emissions stop when the window exhausts) — the
-    engine's send-sequence ranking relies on that.
+    size, retx)`` tuple of stacked [PUMP_BURST, N] arrays whose validity
+    is a PREFIX along axis 0 (emissions stop when the window exhausts) —
+    the engine's send-sequence ranking relies on that.  ``retx`` marks
+    the retransmit prefix (units below the entry ``max_sent``) for the
+    flowtrace plane; when flowtrace is off nothing consumes it and XLA
+    folds the comparison away.
 
     CLOSED FORM — not a loop.  The scalar law's per-unit loop is exactly
     derivable because nothing the gate depends on changes mid-burst
@@ -530,7 +536,8 @@ def pump_epilogue_vec(f: FlowCols, nh, nl, m, em):
     f, rv, rth, rtl = _restart_rto(f, nh, nl, m & sent_any, em.rto_valid,
                                    em.rto_thi, em.rto_tlo)
     em = em._replace(rto_valid=rv, rto_thi=rth, rto_tlo=rtl)
-    return f, em, (valid, flags, units, acks, sizes)
+    retx = ks < n_re[None, :]  # retransmit prefix (flowtrace channel)
+    return f, em, (valid, flags, units, acks, sizes, retx)
 
 
 # --------------------------------------------------------------------------

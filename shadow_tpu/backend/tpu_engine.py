@@ -25,6 +25,7 @@ from ..models.tcpflow import StreamClient, StreamServer
 from ..models.tgen import Ping, TgenClient, TgenMesh, TgenServer
 from ..net import codel as codel_mod
 from ..net.token_bucket import bucket_params
+from ..obs import flowtrace as ftr
 from . import lanes
 from . import lanes_stream as lstr_mod
 from .cpu_engine import LogRecord, SimResult
@@ -57,6 +58,7 @@ class TpuEngine:
         inject_batch: Optional[int] = None,
         world=None,
         netobs: Optional[bool] = None,
+        flowtrace: Optional[bool] = None,
     ) -> None:
         """``external``: optional [N] bool mask — marked hosts are
         EXTERNAL (hybrid backend, backend/hybrid.py): their apps run on
@@ -76,6 +78,12 @@ class TpuEngine:
         # populated by collect() when netobs is on: the device-side
         # telemetry snapshot (obs/netobs.py array schema)
         self._netobs_data = None
+        if flowtrace is None:
+            flowtrace = cfg.experimental.flowtrace
+        self._flowtrace_on = bool(flowtrace)
+        # populated by collect() when flowtrace is on: decoded device
+        # ring events + ring-overflow loss count (obs/flowtrace.py)
+        self._flowtrace_data = None
         if inject_batch is None:
             inject_batch = cfg.experimental.tpu_inject_batch
         n = len(cfg.hosts)
@@ -303,10 +311,14 @@ class TpuEngine:
         # [2S]-row tier (docs/tpu-backend.md).  Hybrid (external) runs
         # keep the older split-exchange path: host injections land in
         # [N] rows, which the tier would orphan for stream lanes.
+        # flowtrace instruments the [N] untiered path only: tracing a run
+        # drops the tier (equivalent execution strategy, bit-identical
+        # events, slower — fine for untimed evidence runs)
         tiered = bool(
             one_to_one
             and cfg.experimental.tpu_stream_tiered
             and not ext_mask.any()
+            and not self._flowtrace_on
         )
         self._tiered = tiered
 
@@ -339,6 +351,7 @@ class TpuEngine:
         # records through their compacted channels at departure, and both
         # backends synthesize stream bodies from sizes alone
 
+        ft_thresh, ft_all = ftr.sample_thresh(cfg.experimental.flowtrace_sample)
         self.params = lanes.LaneParams(
             n_lanes=n,
             capacity=capacity,
@@ -376,6 +389,14 @@ class TpuEngine:
             stream_pops=cfg.experimental.tpu_stream_events_per_round,
             stream_capacity=cfg.experimental.tpu_stream_queue_capacity,
             netobs=self._netobs_on,
+            flowtrace=self._flowtrace_on,
+            flow_capacity=(
+                cfg.experimental.flowtrace_capacity
+                if self._flowtrace_on else 0
+            ),
+            flow_thresh=ft_thresh,
+            flow_all=ft_all,
+            flow_seed=cfg.general.seed,
             external_any=bool(ext_mask.any()),
             # worst case: every external lane pops a full slot row of
             # packets in one iteration; the egress buffer keeps at least
@@ -793,6 +814,12 @@ class TpuEngine:
                 if p.netobs else ()
             ),
             nb_win=jnp.int32(0) if p.netobs else (),
+            fl_buf=(
+                jnp.zeros((p.flow_capacity, ftr.FT_COLS), dtype=i32)
+                if p.flowtrace else ()
+            ),
+            fl_count=jnp.int32(0) if p.flowtrace else (),
+            fl_lost=jnp.int32(0) if p.flowtrace else (),
         )
 
     # -- running -----------------------------------------------------------
@@ -1275,6 +1302,8 @@ class TpuEngine:
 
         if self.params.netobs:
             self._netobs_data = self._netobs_collect(s, tv)
+        if self.params.flowtrace:
+            self._flowtrace_data = self._flowtrace_collect(s)
 
         return SimResult(
             sim_time_ns=self.params.stop_time,
@@ -1368,3 +1397,39 @@ class TpuEngine:
         names = [h.hostname for h in self.cfg.hosts]
         return nom.snapshot_lines(snap["arrays"], snap["window_hist"],
                                   names, host)
+
+    # -- flowtrace plane (obs/flowtrace.py) --------------------------------
+
+    def _flowtrace_collect(self, s: lanes.LaneState) -> dict:
+        """Decode the device flow ring into event tuples.  The ring never
+        wraps, so the kept rows are the contiguous prefix; overflow only
+        bumps ``fl_lost``.  Piggybacks the collect readback — no extra
+        device sync."""
+        kept = min(int(s.fl_count), self.params.flow_capacity)
+        rows = np.asarray(s.fl_buf)[:kept]
+        return {
+            "raw": ftr.rows_to_events(rows),
+            "ring_lost": int(s.fl_lost),
+        }
+
+    def flowtrace_snapshot(self):
+        """Decoded flow events of the last collected run (None when
+        flowtrace is off or no run has completed)."""
+        return self._flowtrace_data
+
+    def flowtrace_lines(self, host: Optional[str] = None) -> list[str]:
+        """Run-control ``flows`` answer from the LIVE device ring (step
+        driver; snapshot-epoch fetch like netobs_lines)."""
+        if not self.params.flowtrace:
+            return ["flowtrace is not enabled (set experimental.flowtrace)"]
+        state = getattr(self, "_live_state", None)
+        if state is None:
+            return ["no live device state yet (step driver only)"]
+        snap = self._flowtrace_collect(state)
+        events, lost = ftr.canonical_events(
+            snap["raw"], self.params.flow_capacity
+        )
+        names = [h.hostname for h in self.cfg.hosts]
+        return ftr.snapshot_lines(
+            events, lost + snap["ring_lost"], names, host=host
+        )

@@ -120,6 +120,18 @@ class ExperimentalOptions:
     # off); the CPU oracle accumulates the identical counters so the
     # parity suite can diff them per host
     netobs: bool = False
+    # per-flow packet-lifecycle tracing (obs/flowtrace.py): lifecycle
+    # events (send / tb-wait / queue-enter / drop+cause / retransmit /
+    # delivery) for deterministically-sampled flows, exported as
+    # FLOWS_<backend>-seed<N>.json with a burst attribution report.
+    # Device-side the events land in a bounded ring inside the lane
+    # kernels (drained only at snapshot epochs / end-of-run — zero new
+    # host<->device transfers; LaneParams.flowtrace compiles the plane
+    # away when off); the CPU oracle emits the identical stream so the
+    # parity suite can diff them event-for-event
+    flowtrace: bool = False
+    flowtrace_capacity: int = 65536  # device ring rows; never wraps
+    flowtrace_sample: float = 1.0  # fraction of flows traced (seeded hash)
     # --- TPU-native extensions -------------------------------------------
     network_backend: str = "cpu"  # "cpu" | "tpu"
     tpu_lane_queue_capacity: int = 64  # per-host in-flight packet slots
@@ -479,6 +491,10 @@ class ConfigOptions:
             raise ConfigError("experimental.worker_restart_max must be >= 0")
         if self.experimental.dispatch_retry_max < 0:
             raise ConfigError("experimental.dispatch_retry_max must be >= 0")
+        if self.experimental.flowtrace_capacity < 1:
+            raise ConfigError("experimental.flowtrace_capacity must be >= 1")
+        if not 0.0 <= self.experimental.flowtrace_sample <= 1.0:
+            raise ConfigError("experimental.flowtrace_sample must be in [0, 1]")
         if self.experimental.interface_qdisc not in ("fifo", "round-robin"):
             raise ConfigError(
                 "experimental.interface_qdisc must be fifo|round-robin, "

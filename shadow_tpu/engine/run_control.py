@@ -27,6 +27,9 @@ Observability extensions (shadow_tpu/obs/, docs/observability.md):
 - ``netstats [host]``  print the simulated-network telemetry snapshot
   (per-host counters, drop causes, burst-window histogram — the netobs
   plane of obs/netobs.py); with a hostname, that host's counter row too
+- ``flows [host]``   print the per-flow packet-lifecycle snapshot (the
+  flowtrace plane of obs/flowtrace.py: event totals, per-kind counts,
+  ranked flow pairs); with a hostname, only that host's flow pairs
 - ``turns``          print the device-turn ledger snapshot (turn-cause
   counts, fusable-run percentiles, k-fusion headroom, and the REALIZED
   fusion stats — fused dispatches, windows covered, turns saved,
@@ -130,6 +133,9 @@ class RunControl:
         # netobs seam: `netstats [host]` answers from the engine's live
         # network-telemetry counters (obs/netobs.py)
         self._netobs_sink: Optional[Callable[[Optional[str]], list[str]]] = None
+        # flowtrace seam: `flows [host]` answers from the engine's live
+        # packet-lifecycle event stream (obs/flowtrace.py)
+        self._flows_sink: Optional[Callable[[Optional[str]], list[str]]] = None
         # checkpoint seam (engine/checkpoint.py): the `checkpoint` verb
         # requests a write at the current boundary through this callback
         self._checkpoint_sink: Optional[Callable[[], str]] = None
@@ -157,6 +163,13 @@ class RunControl:
         """Register the engine's network-telemetry snapshot callback:
         ``sink(host_or_None)`` returns the ``netstats`` answer lines."""
         self._netobs_sink = sink
+
+    def set_flows_sink(
+        self, sink: Callable[[Optional[str]], list[str]]
+    ) -> None:
+        """Register the engine's flow-trace snapshot callback:
+        ``sink(host_or_None)`` returns the ``flows`` answer lines."""
+        self._flows_sink = sink
 
     def set_checkpoint_sink(self, sink: Callable[[], str]) -> None:
         """Register the facade's checkpoint-request callback: ``sink()``
@@ -245,8 +258,8 @@ class RunControl:
             f"[run-control] paused at window boundary: sim-time "
             f"{stime.fmt(window_end)} (next event {stime.fmt(next_event_time)}); "
             "commands: c / cN / n / s / s:<pid> / r / rN / stats / "
-            "netstats [host] / turns / trace ... / fault ... / failover / "
-            "checkpoint / resume <ckpt>"
+            "netstats [host] / flows [host] / turns / trace ... / "
+            "fault ... / failover / checkpoint / resume <ckpt>"
         )
         self._print_info()
         # soft-wait: block until a resuming command arrives
@@ -336,6 +349,9 @@ class RunControl:
         if cmd == "netstats" or cmd.startswith("netstats "):
             self._cmd_netstats(cmd.split()[1:])
             return False
+        if cmd == "flows" or cmd.startswith("flows "):
+            self._cmd_flows(cmd.split()[1:])
+            return False
         if cmd == "turns":
             self._cmd_turns()
             return False
@@ -381,6 +397,11 @@ class RunControl:
             # land in the registry at run end)
             for line in self._netobs_sink(None):
                 self._print(f"[run-control]   {line}")
+        if self._flows_sink is not None:
+            # one-line flow-trace summary (full detail via `flows`)
+            lines = self._flows_sink(None)
+            if lines:
+                self._print(f"[run-control]   {lines[0]}")
 
     def _cmd_turns(self) -> None:
         """``turns``: the device-turn ledger snapshot (obs/turns.py) —
@@ -413,6 +434,21 @@ class RunControl:
         host = tokens[0] if tokens else None
         self._print("[run-control] netstats:")
         for line in self._netobs_sink(host):
+            self._print(f"[run-control]   {line}")
+
+    def _cmd_flows(self, tokens: list[str]) -> None:
+        """``flows [host]``: the per-flow packet-lifecycle snapshot
+        (obs/flowtrace.py) — event totals, per-kind counts, ranked flow
+        pairs; with a hostname, only the pairs touching that host."""
+        if self._flows_sink is None:
+            self._print(
+                "[run-control] flowtrace is not enabled on this backend "
+                "(set experimental.flowtrace)"
+            )
+            return
+        host = tokens[0] if tokens else None
+        self._print("[run-control] flows:")
+        for line in self._flows_sink(host):
             self._print(f"[run-control]   {line}")
 
     def _cmd_trace(self, tokens: list[str]) -> None:

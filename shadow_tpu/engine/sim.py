@@ -211,10 +211,10 @@ class Simulation:
         (None = everything off = zero engine overhead)."""
         exp = self.cfg.experimental
         if not (exp.obs_metrics or exp.obs_trace or exp.obs_jsonl
-                or exp.netobs or exp.obs_turns):
-            # netobs/obs_turns imply a Recorder: the NETOBS_/TURNS_*.json
-            # artifacts ride the same run-id/out-dir lifecycle as
-            # METRICS_*.json
+                or exp.netobs or exp.obs_turns or exp.flowtrace):
+            # netobs/obs_turns/flowtrace imply a Recorder: the NETOBS_/
+            # TURNS_/FLOWS_*.json artifacts ride the same run-id/out-dir
+            # lifecycle as METRICS_*.json
             return None
         from ..obs import Recorder
 
@@ -304,6 +304,7 @@ class Simulation:
             if sync is not None:
                 extra["hybrid_sync"] = dict(sync)
             self._write_netobs(extra)
+            self._write_flows(extra)
             fin = self.obs.finalize(extra=extra)
             for k in ("metrics_path", "trace_path", "turns_path"):
                 if k in fin:
@@ -350,6 +351,55 @@ class Simulation:
             "drops_by_cause": report["drops_by_cause"],
             "drop_total": report["drop_total"],
             "windows": report["window_hist"]["windows"],
+        }
+
+    def _write_flows(self, extra: dict) -> None:
+        """Write the FLOWS_<run_id>.json lifecycle artifact through the
+        Recorder lifecycle (docs/observability.md): canonical event
+        stream, per-flow breakdowns, burst attribution — plus Chrome-
+        trace flow arrows when span tracing is on, and the
+        ``flow_events_lost`` counter in the metrics registry."""
+        cfg = self.cfg
+        snap_fn = getattr(self.engine, "flowtrace_snapshot", None)
+        if not cfg.experimental.flowtrace or snap_fn is None:
+            return
+        snap = snap_fn()
+        if snap is None:
+            return
+        from ..obs import flowtrace as ftr
+
+        cap = cfg.experimental.flowtrace_capacity
+        events, trunc = ftr.canonical_events(snap["raw"], cap)
+        lost = trunc + snap.get("ring_lost", 0)
+        thresh, all_pass = ftr.sample_thresh(
+            cfg.experimental.flowtrace_sample
+        )
+        names = [h.hostname for h in cfg.hosts]
+        report = ftr.build_report(
+            self.obs.run_id,
+            cfg.experimental.network_backend,
+            cfg.general.seed,
+            names,
+            events,
+            lost,
+            thresh,
+            all_pass,
+            cap,
+        )
+        if self.obs.out_dir is not None:
+            path = ftr.write_report(
+                self.obs.out_dir / f"FLOWS_{self.obs.run_id}.json", report
+            )
+            log.info("obs artifact: %s", path)
+        m = self.obs.metrics
+        m.count("flow_events", len(events))
+        m.count("flow_events_lost", lost)
+        if self.obs.tracer is not None:
+            ftr.render_flows(self.obs.tracer, events, names)
+        extra["flows"] = {
+            "num_events": report["num_events"],
+            "num_flows": report["num_flows"],
+            "events_lost": report["events_lost"],
         }
 
     def _make_on_window(self, describe_source, runahead, t0: float,
@@ -606,6 +656,9 @@ class Simulation:
             if engine.netobs is not None:
                 # the `netstats [host]` verb answers from live counters
                 self.run_control.set_netobs_sink(engine.netobs_lines)
+            if engine.flowtrace is not None:
+                # the `flows [host]` verb answers from live events
+                self.run_control.set_flows_sink(engine.flowtrace_lines)
         if self.cfg.experimental.perf_logging:
             engine.perf_log = PerfLog()
         engine.obs = self.obs
@@ -706,9 +759,11 @@ class Simulation:
         )
         engine = self.engine = TpuEngine(
             self.cfg,
-            # netobs is single-device only for now: the window histogram
-            # and counter flush live in the unsharded collect path
+            # netobs/flowtrace are single-device only for now: the window
+            # histogram, counter flush and event-ring drain live in the
+            # unsharded collect path
             netobs=False if multi_mesh else None,
+            flowtrace=False if multi_mesh else None,
         )
         engine.obs = self.obs
         if multi_mesh:
@@ -733,12 +788,13 @@ class Simulation:
                 or self.cfg.experimental.perf_logging
                 or self.obs is not None
                 or self.cfg.experimental.netobs
+                or self.cfg.experimental.flowtrace
             ):
                 log.warning(
-                    "run-control / perf-logging / obs spans / netobs are "
-                    "not supported on the sharded-mesh driver (fused "
-                    "on-device loop); running without them — drop "
-                    "tpu_mesh_shape to use them"
+                    "run-control / perf-logging / obs spans / netobs / "
+                    "flowtrace are not supported on the sharded-mesh "
+                    "driver (fused on-device loop); running without them "
+                    "— drop tpu_mesh_shape to use them"
                 )
 
             mesh = parallel.make_mesh(mesh_shape[0])
@@ -790,6 +846,9 @@ class Simulation:
                 # `netstats` reads the live device counters at a paused
                 # boundary (a snapshot epoch, not a new per-window sync)
                 self.run_control.set_netobs_sink(engine.netobs_lines)
+            if exp.flowtrace:
+                # `flows` drains the live device event ring the same way
+                self.run_control.set_flows_sink(engine.flowtrace_lines)
         if exp.perf_logging:
             engine.perf_log = PerfLog()
         if resume is not None:

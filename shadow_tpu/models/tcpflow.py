@@ -46,8 +46,9 @@ class _FlowDriver:
 
     def _apply(self, api, fs: ltcp.FlowState, em: ltcp.Emit, peer: int,
                client: int, conn: int):
-        for flags, seq, ack, size in em.sends:
-            api.send(peer, size, payload=StreamSeg(client, conn, flags, seq, ack))
+        for (flags, seq, ack, size), rx in zip(em.sends, em.retx):
+            api.send(peer, size, payload=StreamSeg(client, conn, flags, seq, ack),
+                     retx=rx)
         if em.arm_pump:
             api.schedule_at(api.now, self._pump_cb(fs, peer, client, conn))
         if em.arm_rto is not None:
@@ -57,6 +58,9 @@ class _FlowDriver:
             # timeouts — a dead path); surfaced in sim-stats
             # packet_outcomes as "retry_drop" (engine/sim.py)
             api.count("stream_retry_drops")
+            ft = getattr(api, "ft_giveup", None)
+            if ft is not None:
+                ft(peer)
         return em
 
     def _pump_cb(self, fs, peer, client, conn):

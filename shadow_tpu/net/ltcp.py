@@ -175,6 +175,9 @@ class Emit:
     epilogue), plus pump/RTO local-event arms."""
 
     sends: list = dataclasses.field(default_factory=list)  # (flags, seq, ack, size)
+    # parallel to ``sends``: True for retransmitted units (flowtrace's
+    # FT_RETRANSMIT send-stage marker; pure ACKs are always False)
+    retx: list = dataclasses.field(default_factory=list)
     arm_pump: bool = False  # queue a pump event at the current time
     arm_rto: Optional[int] = None  # queue an RTO event at this time
     completed: bool = False  # flow reached DONE on this stimulus
@@ -323,6 +326,7 @@ def _emit_unit(fs: FlowState, unit: int, em: Emit, retransmit: bool) -> None:
     em.sends.append(
         (seg_flags(fs, unit), unit, fs.rcv_nxt, seg_wire_size(fs, unit))
     )
+    em.retx.append(retransmit)
     fs.tx_segs += 1
     if retransmit:
         fs.retransmits += 1
@@ -457,6 +461,7 @@ def _on_segment_inner(
         # dup FIN from a peer that missed our final ACK: re-ACK it
         if fs.role == SENDER and flags & F_FIN:
             em.sends.append((F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES))
+            em.retx.append(False)
         return em
 
     # -- passive open -------------------------------------------------------
@@ -533,6 +538,7 @@ def _on_segment_inner(
             # FIN) is acked — by this segment or earlier
             fs.rcv_nxt = 2
             em.sends.append((F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES))
+            em.retx.append(False)
             fs.state = DONE
             fs.rto_deadline = NEVER
             em.completed = True
@@ -550,6 +556,7 @@ def _on_segment_inner(
                 fs.rx_bytes += size - HDR_BYTES
             # ACK everything (in-order advance or duplicate for OOO)
             em.sends.append((F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES))
+            em.retx.append(False)
         elif flags & F_FIN:
             if seq == fs.rcv_nxt:
                 # client's FIN in order: consume it, answer with our FIN+ACK
@@ -563,6 +570,7 @@ def _on_segment_inner(
                 _restart_rto(fs, now, em)
             else:
                 em.sends.append((F_ACK, fs.snd_nxt, fs.rcv_nxt, HDR_BYTES))
+                em.retx.append(False)
     elif fs.state == LAST_ACK:
         if fs.snd_una >= 2:
             # the final ACK arrived (processed above): teardown complete
