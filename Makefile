@@ -7,7 +7,8 @@
 
 .PHONY: test gate native smoke-faults smoke-examples lint-determinism \
 	bench-hybrid obs-smoke netobs-smoke flows-smoke turns-smoke \
-	fusion-smoke checkpoint-smoke chaos-smoke bench-report check-fixtures
+	fusion-smoke checkpoint-smoke chaos-smoke sweep-smoke bench-report \
+	check-fixtures
 
 test: native
 	python -m pytest tests/ -q
@@ -29,6 +30,7 @@ gate: native check-fixtures lint-determinism
 	$(MAKE) fusion-smoke
 	$(MAKE) checkpoint-smoke
 	$(MAKE) chaos-smoke
+	$(MAKE) sweep-smoke
 
 # Runtime fixture dirs (hermdir/, shadow.data/, pytest caches) are
 # .gitignore'd; a force-add or an ignore regression would commit
@@ -124,6 +126,13 @@ checkpoint-smoke:
 # oracle (also byte-identical) — docs/robustness.md "supervision model".
 chaos-smoke:
 	JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
+# Fleet-sweep smoke for the gate: a 4-variant seed x loss grid on the
+# flagship mesh batched through ONE compiled vmapped kernel, asserting
+# per-scenario bit-identity vs serial reference runs, a single XLA
+# trace, and nonzero cross-scenario drop variance (docs/sweep.md).
+sweep-smoke:
+	JAX_PLATFORMS=cpu python scripts/sweep_smoke.py
 
 # Regenerate docs/bench-trajectory.md from the BENCH_r0N.json artifacts.
 bench-report:

@@ -32,7 +32,13 @@ keys record:
   sim-s/wall-s so regressions are visible per tier;
 - ``cpu_sim_s_per_wall_s`` / ``speedup_vs_cpu_backend``: the OTHER side
   of the north-star ratio — the same workload timed on the CPU
-  thread-per-host path (shorter sim; the rate is steady-state).
+  thread-per-host path (shorter sim; the rate is steady-state);
+- ``scenarios_per_hour`` / ``sweep_compile_amortization``: the FLEET
+  throughput plane (shadow_tpu/sweep/, docs/sweep.md) — an S-scenario
+  seed grid batched through ONE compiled vmapped kernel, reported as
+  whole-scenario completions per hour, with the amortization ratio
+  (S x one serial from-scratch wall, compile included, over the batch
+  wall) showing what the single compile buys.
 
 Env knobs (for local runs; the driver uses the defaults):
   SHADOW_TPU_BENCH_HOSTS         lanes in the mesh    (default 10000)
@@ -52,6 +58,10 @@ Env knobs (for local runs; the driver uses the defaults):
   SHADOW_TPU_BENCH_FLOWS         1 = run the untimed flowtrace evidence
                                  pass on the mixed mesh (default 1)
   SHADOW_TPU_BENCH_FLOWS_SAMPLE  flowtrace sampling fraction (default 0.02)
+  SHADOW_TPU_BENCH_SWEEP         1 = run the fleet-sweep batch (default 1)
+  SHADOW_TPU_BENCH_SWEEP_SIZE    scenarios per sweep batch (default 8)
+  SHADOW_TPU_BENCH_SWEEP_HOSTS   lanes per sweep scenario (default 1000)
+  SHADOW_TPU_BENCH_SWEEP_SIM_SECONDS  sweep simulated duration (default 5)
 """
 
 import json
@@ -102,6 +112,12 @@ NETOBS = os.environ.get("SHADOW_TPU_BENCH_NETOBS", "1") == "1"
 # untiered stream path, an equivalent but slower execution)
 FLOWS = os.environ.get("SHADOW_TPU_BENCH_FLOWS", "1") == "1"
 FLOWS_SAMPLE = float(os.environ.get("SHADOW_TPU_BENCH_FLOWS_SAMPLE", "0.02"))
+SWEEP = os.environ.get("SHADOW_TPU_BENCH_SWEEP", "1") == "1"
+SWEEP_SIZE = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_SIZE", "8"))
+SWEEP_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_HOSTS", "1000"))
+SWEEP_SIM_SECONDS = int(os.environ.get(
+    "SHADOW_TPU_BENCH_SWEEP_SIM_SECONDS", "5"
+))
 
 
 # the tunneled runtime caches EXECUTIONS across processes keyed on
@@ -363,6 +379,44 @@ def _hybrid_rate():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _sweep_rate(salt0):
+    """The fleet-throughput keys (shadow_tpu/sweep/): an S-scenario seed
+    grid batched through ONE compiled vmapped kernel vs one serial
+    from-scratch run of the same scenario.  Both walls include their own
+    single compile, so ``sweep_compile_amortization`` = S x serial /
+    batch is the honest whole-campaign speedup (compile amortized across
+    the fleet + device-parallel execution), and ``scenarios_per_hour``
+    is the headline fleet rate the batch sustains."""
+    from shadow_tpu.sweep import SweepEngine, SweepSpec, expand_variants
+
+    cfg = flagship_mesh_config(
+        SWEEP_HOSTS, sim_seconds=SWEEP_SIM_SECONDS, queue_capacity=16,
+        pops_per_round=2,
+    )
+    cfg.experimental.tpu_cross_capacity = 8
+    variants = expand_variants(
+        cfg, SweepSpec.seed_grid(cfg.general.seed, SWEEP_SIZE)
+    )
+    sweep = SweepEngine(variants, log_capacity=0)
+    results = sweep.run(cache_salt=salt0)
+    batch_wall = results[0].wall_seconds
+    serial = TpuEngine(variants[0].cfg, log_capacity=0).run(
+        mode="device", cache_salt=salt0 + SWEEP_SIZE + 1
+    )
+    return {
+        "scenarios_per_hour": round(SWEEP_SIZE * 3600.0 / batch_wall, 1),
+        "sweep_size": SWEEP_SIZE,
+        "sweep_hosts": SWEEP_HOSTS,
+        "sweep_sim_seconds": SWEEP_SIM_SECONDS,
+        "sweep_batch_wall_s": round(batch_wall, 3),
+        "sweep_serial_wall_s": round(serial.wall_seconds, 3),
+        "sweep_traces": sweep.traces,
+        "sweep_compile_amortization": round(
+            SWEEP_SIZE * serial.wall_seconds / batch_wall, 2
+        ),
+    }
+
+
 def main() -> None:
     if HYBRID_ONLY:
         # make bench-hybrid: the hybrid scenario alone, one JSON line
@@ -466,6 +520,10 @@ def main() -> None:
         configs["managed_relay_chains_large_hybrid"] = h[
             "hybrid_sim_s_per_wall_s"
         ]
+
+    # the FLEET throughput plane: S whole scenarios per compiled kernel
+    if SWEEP:
+        out.update(_sweep_rate(_SALT + 700))
 
     out["configs"] = configs
 

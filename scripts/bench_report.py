@@ -100,6 +100,18 @@ KEY_ORDER = [
     "mixed_flow_attribution.num_events",
     "mixed_flow_attribution.num_flows",
     "mixed_flow_attribution.events_lost",
+    # fleet-sweep throughput (shadow_tpu/sweep/, docs/sweep.md): an
+    # S-scenario seed grid through ONE compiled vmapped kernel —
+    # whole-scenario completions per hour plus the compile-amortization
+    # ratio (S x serial-with-compile wall over the batch wall)
+    "scenarios_per_hour",
+    "sweep_compile_amortization",
+    "sweep_size",
+    "sweep_hosts",
+    "sweep_sim_seconds",
+    "sweep_batch_wall_s",
+    "sweep_serial_wall_s",
+    "sweep_traces",
 ]
 
 KEY_LABEL = {

@@ -38,8 +38,10 @@ from typing import Iterable, Optional
 
 from .findings import Finding
 
-ORDERING_SENSITIVE = ("engine", "backend", "net", "faults", "core", "obs")
-STEP_PATH_DIRS = ("engine", "backend", "obs")
+ORDERING_SENSITIVE = (
+    "engine", "backend", "net", "faults", "core", "obs", "sweep",
+)
+STEP_PATH_DIRS = ("engine", "backend", "obs", "sweep")
 STEP_NAME_RE = re.compile(
     r"(step|iter|round|window|advance|tick|pop|drive|body)"
 )

@@ -458,6 +458,14 @@ class LaneTables(NamedTuple):
     # [N] bool: lane is a stream endpoint (tiered: its [N] queue row is
     # dead and cross traffic to it diverts into the tier block)
     lane_stream: Any = ()
+    # sweep backend (shadow_tpu/sweep): the master seed as a pair of
+    # uint32 SCALARS carried as traced table leaves, so a vmapped batch
+    # gives every scenario its own seed under one compile.  () on the
+    # serial path, where the static LaneParams.seed is baked in instead;
+    # the threefry key inputs are identical either way (core.rng
+    # _split_seed semantics), so the two forms are bit-identical.
+    seed_lo: Any = ()
+    seed_hi: Any = ()
 
 
 # --------------------------------------------------------------------------
@@ -661,16 +669,36 @@ def codel_offer_vec(state, td_hi, td_lo, sojourn, active, codel_div):
     return state, drop
 
 
-def rand_u32_lane(seed: int, stream, counter32):
+def rand_u32_lane(seed, stream, counter32):
     """threefry draw with an int32 counter (c1 = 0): bit-identical to
-    core.rng.rand_u32 for counters < 2**32, with no int64 in the path."""
-    s_lo, s_hi = rng_mod._split_seed(seed)
+    core.rng.rand_u32 for counters < 2**32, with no int64 in the path.
+
+    ``seed`` is either a Python int (static — split here, compiled into
+    the kernel) or a ``(lo, hi)`` pair of uint32 scalars (traced — the
+    sweep path threads per-scenario seeds through LaneTables so one
+    trace serves every seed).  Both forms feed threefry the same key
+    words, so they are bit-identical."""
     u32 = jnp.uint32
-    k0 = u32(s_lo)
-    k1 = (jnp.asarray(stream, dtype=u32) ^ u32(s_hi)).astype(u32)
+    if isinstance(seed, tuple):
+        s_lo, s_hi = seed
+    else:
+        s_lo, s_hi = rng_mod._split_seed(seed)
+    k0 = jnp.asarray(s_lo, dtype=u32)
+    k1 = (
+        jnp.asarray(stream, dtype=u32) ^ jnp.asarray(s_hi, dtype=u32)
+    ).astype(u32)
     c0 = counter32.astype(u32)
     c1 = jnp.zeros_like(c0)
     return rng_mod.threefry2x32(k0, k1, c0, c1, jnp)[0]
+
+
+def _seed_keys(p: "LaneParams", tb: "LaneTables"):
+    """The seed argument for rand_u32_lane under this trace: the traced
+    per-scenario (lo, hi) pair from the tables when the sweep path
+    populated it, else the static LaneParams seed."""
+    if not isinstance(tb.seed_lo, tuple):
+        return (tb.seed_lo, tb.seed_hi)
+    return p.seed
 
 
 # --------------------------------------------------------------------------
@@ -1031,7 +1059,9 @@ def _process_slot(
     # only when phold lanes exist — the threefry is ~50 ops per slot)
     if M_PHOLD in mp:
         draw = rand_u32_lane(
-            p.seed, (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.APP_STREAM)), s.app_draws
+            _seed_keys(p, tb),
+            (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.APP_STREAM)),
+            s.app_draws,
         )
         r = rng_mod.u32_below(draw, max(n - 1, 1), xp=jnp).astype(i32)
         phold_dst = jnp.where(n == 1, lanes, (lanes + 1 + r) % n)
@@ -1092,7 +1122,8 @@ def _process_slot(
     lat = tb.lat[my_node, dst_node]  # int32
     if p.has_loss:
         u = rand_u32_lane(
-            p.seed, (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
+            _seed_keys(p, tb),
+            (lanes.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
             snd_seq,
         )
         bs_hi, bs_lo = p.bootstrap_end >> 31, p.bootstrap_end & MASK31
@@ -1173,7 +1204,7 @@ def _process_slot(
             bs_hi2, bs_lo2 = p.bootstrap_end >> 31, p.bootstrap_end & MASK31
             e_past_bs = pair_ge(ethi, etlo, bs_hi2, bs_lo2)
             eu = rand_u32_lane(
-                p.seed,
+                _seed_keys(p, tb),
                 (el.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
                 se_seq,
             )
@@ -1245,7 +1276,7 @@ def _process_slot(
             bseq = se_seq[cl_sl] + sent_before
             if p.has_loss:
                 bu = rand_u32_lane(
-                    p.seed,
+                    _seed_keys(p, tb),
                     (cl_lanes_u32 | jnp.uint32(rng_mod.LOSS_STREAM)),
                     bseq,
                 )
@@ -2417,7 +2448,7 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
         if p.has_loss:
             e_past_bs = pair_ge(sh, sl, bs_hi, bs_lo)
             eu = rand_u32_lane(
-                p.seed,
+                _seed_keys(p, tb),
                 (el.astype(jnp.uint32) | jnp.uint32(rng_mod.LOSS_STREAM)),
                 se_seq,
             )
@@ -2476,7 +2507,7 @@ def _stream_tier_iter(p: LaneParams, tb: LaneTables, s: LaneState,
             bseq = se_seq[cl_sl] + sent_before
             if p.has_loss:
                 bu = rand_u32_lane(
-                    p.seed,
+                    _seed_keys(p, tb),
                     (cl_lanes_u32 | jnp.uint32(rng_mod.LOSS_STREAM)),
                     bseq,
                 )
@@ -3401,7 +3432,7 @@ def unpack_state(carry) -> LaneState:
     )
 
 
-def _build_full_run(p: LaneParams, tb: LaneTables):
+def _build_full_run(p: LaneParams, tb: LaneTables, dynamic_stop=None):
     """Raw (un-jitted) full-simulation run, entirely on-device.
 
     ONE flat ``lax.while_loop`` whose body both advances the window (only
@@ -3409,7 +3440,12 @@ def _build_full_run(p: LaneParams, tb: LaneTables):
     of the nested per-round form, so arrival bumps and event logs stay
     bit-identical) and pops/processes/merges one iteration of events, over
     the PACKED carry (see pack_state).  Shared by the single-device and
-    sharded drivers."""
+    sharded drivers.
+
+    ``dynamic_stop`` is an optional traced ``(stop_hi, stop_lo)`` int32
+    pair that replaces the static ``p.stop_time`` split — the sweep path
+    threads per-scenario (and per-fault-segment) stop times through it
+    so one trace serves every segment bound."""
     iter_fn = _build_iter(p, tb, pure_dataflow=True)
 
     # steps per while-loop trip (p.unroll, experimental.tpu_round_unroll):
@@ -3418,7 +3454,10 @@ def _build_full_run(p: LaneParams, tb: LaneTables):
     # saturated window admits no pops), so no per-step guard is needed.
     unroll = max(int(p.unroll), 1)
 
-    stop_hi, stop_lo = p.stop_time >> 31, p.stop_time & MASK31
+    if dynamic_stop is None:
+        stop_hi, stop_lo = p.stop_time >> 31, p.stop_time & MASK31
+    else:
+        stop_hi, stop_lo = dynamic_stop
 
     def full_run(s: LaneState) -> LaneState:
         def cond(carry):
@@ -3465,6 +3504,38 @@ def make_run_fn(p: LaneParams, tb: LaneTables):
     """Jitted full-simulation run — the bench hot path (one device call per
     simulation)."""
     return jax.jit(_build_full_run(p, tb))
+
+
+def make_sweep_fn(p: LaneParams):
+    """Jitted VMAPPED full-simulation run over a leading scenario axis
+    (shadow_tpu/sweep): S whole simulations as one compiled kernel.
+
+    The per-scenario arguments are all TRACED — the whole LaneTables
+    pytree (per-scenario latency/loss/rate tables and the seed_lo/
+    seed_hi leaves), the (stop_hi, stop_lo) pair, and the LaneState —
+    so one XLA compile serves every seed, fault segment, and stop bound
+    whose array shapes match (the sweep variant compiler enforces that
+    congruence).  Under vmap the while_loop batching rule runs the body
+    while ANY scenario's cond holds and per-element selects the old
+    carry where it does not: finished scenarios are preserved exactly
+    (including iters), which is what makes the batched run bit-identical
+    per scenario to S serial runs — a per-scenario done mask, not a
+    global barrier.
+
+    The returned wrapper counts traces in ``.traces`` — the compile
+    probe the one-compile acceptance assertion reads."""
+
+    def run_one(tb: LaneTables, stop_hi, stop_lo, s: LaneState):
+        wrapper.traces += 1
+        return _build_full_run(p, tb, dynamic_stop=(stop_hi, stop_lo))(s)
+
+    jitted = jax.jit(jax.vmap(run_one))
+
+    def wrapper(tb, stop_hi, stop_lo, s):
+        return jitted(tb, stop_hi, stop_lo, s)
+
+    wrapper.traces = 0
+    return wrapper
 
 
 # --------------------------------------------------------------------------

@@ -645,6 +645,30 @@ class TpuEngine:
             )
         return lanes.make_hybrid_fn(self.params, self.tables), inject_fn
 
+    # -- sweep kernel (shadow_tpu/sweep drives this) -----------------------
+
+    def make_sweep_fn(self):
+        """The sweep backend's jitted vmapped entry point, built against
+        this engine's STATIC params (:func:`lanes.make_sweep_fn`): the
+        per-scenario tables, stop bounds, and lane states are traced
+        arguments, so one compile serves every congruent variant.  The
+        returned wrapper's ``.traces`` attribute is the compile probe."""
+        return lanes.make_sweep_fn(self.params)
+
+    def sweep_tables(self, snap=None) -> lanes.LaneTables:
+        """This engine's device tables as ONE SCENARIO ROW of a sweep
+        batch: the traced ``seed_lo``/``seed_hi`` leaves are populated
+        from the config seed (core.rng ``_split_seed`` semantics — the
+        exact key words the static path compiles in), and ``snap`` (a
+        faults Snapshot) re-gathers the epoch's latency/loss tables."""
+        from ..core import rng as _rng
+
+        tb = self.tables if snap is None else self._segment_tables(snap)
+        s_lo, s_hi = _rng._split_seed(self.params.seed)
+        return tb._replace(
+            seed_lo=jnp.uint32(s_lo), seed_hi=jnp.uint32(s_hi)
+        )
+
     # -- state construction ------------------------------------------------
 
     def initial_state(self) -> lanes.LaneState:
@@ -1070,7 +1094,10 @@ class TpuEngine:
 
         ov = self._fault_overlay
         stop = self.params.stop_time
-        bounds = [t for t in ov.epoch_times() if 0 < t < stop] + [stop]
+        # segment_plan owns the boundary law (and the padded no-op rows
+        # the sweep path batches over — _fault_pad lets the padded-parity
+        # test drive them through this serial loop too)
+        plan = ov.segment_plan(stop, pad_to=getattr(self, "_fault_pad", 0))
         resumed = resume_state is not None
         state = resume_state if resumed else self.initial_state()
         self._iters_salt = 0
@@ -1078,20 +1105,21 @@ class TpuEngine:
         if fns is None:
             fns = self._seg_fns = {}
         t0 = wall_time.perf_counter()
-        seg_start = 0
         turns = self.obs.turns if self.obs is not None else None
         seg_rounds = int(np.asarray(state.rounds)) if resumed else 0
         first_live = True
-        for seg_end in bounds:
+        for seg_start, seg_end, snap in plan:
             if resumed and seg_end <= resume_epoch:
-                seg_start = seg_end  # the checkpoint already covers it
-                continue
-            if seg_start > 0 and not disarm_stalls and ov.stall_at(seg_start):
+                continue  # the checkpoint already covers it
+            if (
+                0 < seg_start < seg_end
+                and not disarm_stalls
+                and ov.stall_at(seg_start)
+            ):
                 raise BackendStallError(
                     f"injected backend stall at {seg_start} ns "
                     "(fault schedule backend_stall event)"
                 )
-            snap = ov.snapshot_at(seg_start) if seg_start > 0 else None
             tb = self.tables if snap is None else self._segment_tables(snap)
             p = _dc.replace(self.params, stop_time=seg_end)
             key = (seg_start, seg_end, mode)
@@ -1124,7 +1152,6 @@ class TpuEngine:
                 state = self._drive_steps(
                     fn, state, on_window, p, first_cause=swap_cause,
                 )
-            seg_start = seg_end
         wall = wall_time.perf_counter() - t0
         return self.collect(state, wall)
 

@@ -199,6 +199,14 @@ class ExperimentalOptions:
     # device checkpoint, exponential backoff) before the failure
     # escalates to the watchdog/failover boundary
     dispatch_retry_max: int = 2
+    # --- fleet sweeps (shadow_tpu/sweep/, docs/sweep.md) -----------------
+    # batch S scenario instances into ONE vmapped lane kernel.  With no
+    # sweep_spec, sweep_size > 1 runs the seed grid general.seed ..
+    # general.seed + sweep_size - 1; 0/1 = sweeps off (serial run)
+    sweep_size: int = 0
+    # path to a sweep-spec YAML (seeds / faults / overrides axes —
+    # docs/sweep.md schema); overrides sweep_size when set
+    sweep_spec: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -493,6 +501,15 @@ class ConfigOptions:
             raise ConfigError("experimental.dispatch_retry_max must be >= 0")
         if self.experimental.flowtrace_capacity < 1:
             raise ConfigError("experimental.flowtrace_capacity must be >= 1")
+        if self.experimental.sweep_size < 0:
+            raise ConfigError("experimental.sweep_size must be >= 0")
+        if (
+            self.experimental.sweep_spec is not None
+            and not str(self.experimental.sweep_spec).strip()
+        ):
+            raise ConfigError(
+                "experimental.sweep_spec must be a spec file path (or unset)"
+            )
         if not 0.0 <= self.experimental.flowtrace_sample <= 1.0:
             raise ConfigError("experimental.flowtrace_sample must be in [0, 1]")
         if self.experimental.interface_qdisc not in ("fifo", "round-robin"):

@@ -18,6 +18,7 @@ def flagship_mesh_config(
     stream_pairs: int = 0,
     stream_bytes: int = 50_000_000,
     backend: str = "tpu",
+    seed: int = 1,
 ) -> ConfigOptions:
     """The tgen all-to-all mesh over a single switch (BASELINE config #4):
     every host sends a ``size``-byte datagram every ``interval`` to a
@@ -63,6 +64,7 @@ def flagship_mesh_config(
         f"""
 general:
   stop_time: {sim_seconds} s
+  seed: {seed}
 network:
   graph:
     type: gml
@@ -85,7 +87,8 @@ hosts:
 
 
 def transfer_pair_config(
-    size_bytes: int = 50_000_000, sim_seconds: int = 60, backend: str = "tpu"
+    size_bytes: int = 50_000_000, sim_seconds: int = 60,
+    backend: str = "tpu", seed: int = 1,
 ) -> ConfigOptions:
     """BASELINE config #1: a 2-host client->server transfer over one link
     (the reference's examples/docs/basic-file-transfer shape), as a
@@ -93,6 +96,7 @@ def transfer_pair_config(
     return ConfigOptions.from_yaml(f"""
 general:
   stop_time: {sim_seconds} s
+  seed: {seed}
 network:
   graph:
     type: gml
@@ -125,6 +129,7 @@ def udp_star_config(
     interval: str = "10ms",
     size: int = 1428,
     backend: str = "tpu",
+    seed: int = 1,
 ) -> ConfigOptions:
     """BASELINE config #2: a UDP-only tgen star — n-1 clients send fixed
     datagrams to one server host (single switch, no TCP state).  The
@@ -134,6 +139,7 @@ def udp_star_config(
     return ConfigOptions.from_yaml(f"""
 general:
   stop_time: {sim_seconds} s
+  seed: {seed}
 network:
   graph:
     type: gml
@@ -160,7 +166,8 @@ hosts:
 
 
 def mixed_flagship_config(
-    n_hosts: int, sim_seconds: int = 5, backend: str = "tpu"
+    n_hosts: int, sim_seconds: int = 5, backend: str = "tpu",
+    seed: int = 1,
 ) -> ConfigOptions:
     """The MIXED TCP/UDP mesh at its north-star tuning (the bench's and
     the probe/HLO scripts' single source of truth): 1 stream pair per 100
@@ -175,7 +182,7 @@ def mixed_flagship_config(
     cfg = flagship_mesh_config(
         n_hosts, sim_seconds=sim_seconds, queue_capacity=16,
         pops_per_round=2, stream_pairs=max(n_hosts // 100, 1),
-        stream_bytes=2_000_000, backend=backend,
+        stream_bytes=2_000_000, backend=backend, seed=seed,
     )
     # one-to-one pairing puts stream arrivals on the split exchange, so
     # the main cross block only carries the mesh's permutation spray

@@ -180,6 +180,7 @@ def managed_relay_chains_gate(
     hybrid_workers: int = 2,
     sim_seconds: int = 8,
     backend: str = "tpu",
+    seed: int = 42,
 ) -> ConfigOptions:
     """The SHADOW_TPU_SCALE-gated small sibling of
     :func:`managed_relay_chains_large`: the same shape at 16 managed
@@ -194,6 +195,7 @@ def managed_relay_chains_gate(
         sim_seconds=sim_seconds,
         rounds=3,
         size=1024,
+        seed=seed,
         backend=backend,
         hybrid_workers=hybrid_workers,
     )

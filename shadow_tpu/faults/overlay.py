@@ -283,6 +283,37 @@ class FaultOverlay:
             return True
         return any(bool(np.any(s.loss_threshold > 0)) for s in self._snapshots)
 
+    def segment_plan(
+        self, stop_time: int, pad_to: int = 0
+    ) -> list[tuple[int, int, Optional[Snapshot]]]:
+        """The run's epoch segmentation as ``(seg_start, seg_end,
+        snapshot)`` rows: segment boundaries at every epoch time inside
+        ``(0, stop_time)``, each row carrying the snapshot whose tables
+        govern it (None = base tables).
+
+        ``pad_to`` appends NO-OP rows — zero-length ``(stop_time,
+        stop_time, last_snapshot)`` segments — until the plan has that
+        many rows.  This is the documented padded-epoch representation
+        (docs/sweep.md): schedules of different lengths batch into one
+        static shape without retracing.  Padding is bit-safe ONLY in
+        this trailing zero-length form: at ``seg_start == seg_end ==
+        stop_time`` every queue min is already >= the stop bound, so the
+        run loop admits no pops and no window advances — whereas a
+        mid-run zero-length segment would still clamp a window at its
+        boundary and shift the netobs window sequence."""
+        stop = int(stop_time)
+        bounds = [t for t in self.epoch_times() if 0 < t < stop] + [stop]
+        plan: list[tuple[int, int, Optional[Snapshot]]] = []
+        seg_start = 0
+        for seg_end in bounds:
+            snap = self.snapshot_at(seg_start) if seg_start > 0 else None
+            plan.append((seg_start, seg_end, snap))
+            seg_start = seg_end
+        last = plan[-1][2]
+        while len(plan) < pad_to:
+            plan.append((stop, stop, last))
+        return plan
+
     def add_event(self, ev: FaultEvent) -> None:
         """Dynamic (console) injection: validate, insert, recompute."""
         self._validate(ev)

@@ -132,8 +132,18 @@ class Recorder:
             except Exception:  # profiler unavailable: annotations are
                 self._annotate = None  # best-effort pass-through only
         self.finalized: Optional[dict] = None
+        # queued JSON artifacts (name -> payload), written at finalize —
+        # the subsystem-report seam (sweep/report.py's SWEEP_* files ride
+        # the same lifecycle as METRICS_*/TURNS_*)
+        self.artifacts: dict = {}
 
     # -- span API ----------------------------------------------------------
+
+    def add_artifact(self, name: str, payload: dict) -> None:
+        """Queue a JSON artifact for finalize: written into ``out_dir``
+        as ``<name>.json`` (deterministically serialized — sorted keys,
+        fixed separators) alongside the METRICS report."""
+        self.artifacts[name] = payload
 
     def phase(self, phase: str, name: Optional[str] = None, **args):
         return _PhaseSpan(self, phase, name, args)
@@ -247,6 +257,21 @@ class Recorder:
                     extra=report_extra,
                 )
             )
+            if self.artifacts:
+                import json as _json
+
+                paths = []
+                for aname in sorted(self.artifacts):
+                    p = self.out_dir / f"{aname}.json"
+                    p.write_text(
+                        _json.dumps(
+                            self.artifacts[aname], sort_keys=True,
+                            indent=2, separators=(",", ": "),
+                        )
+                        + "\n"
+                    )
+                    paths.append(str(p))
+                out["artifact_paths"] = paths
         out["report"] = self.metrics.report(extra=report_extra)
         self.metrics.close()
         self.finalized = out

@@ -1,0 +1,35 @@
+"""Fleet sweeps: S independent scenario instances as ONE vmapped lane
+kernel (ROADMAP item 4).
+
+``LaneState`` is a pytree of [N]-leading arrays, so a leading scenario
+axis composes with ``jax.vmap`` for free: the variant compiler
+(:mod:`variants`) expands a base scenario + a sweep spec into S
+shape-congruent configs, the batched driver (:mod:`engine`) stacks
+their lane states and runs them through one compiled kernel
+(``lanes.make_sweep_fn``), and the aggregator (:mod:`report`) turns the
+per-scenario results into the ``SWEEP_<name>-S<k>.json`` artifact with
+cross-scenario percentiles and outlier flags.
+
+The correctness law (docs/sweep.md, tests/test_sweep.py): an S-batched
+run is bit-identical per scenario to S serial runs, under one XLA
+compile for all S.
+"""
+
+from .engine import SweepEngine
+from .report import build_report, write_report
+from .variants import (
+    SweepCongruenceError,
+    SweepSpec,
+    SweepVariant,
+    expand_variants,
+)
+
+__all__ = [
+    "SweepCongruenceError",
+    "SweepEngine",
+    "SweepSpec",
+    "SweepVariant",
+    "build_report",
+    "expand_variants",
+    "write_report",
+]

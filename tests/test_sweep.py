@@ -1,0 +1,263 @@
+"""Fleet-sweep subsystem tests (shadow_tpu/sweep/): the batched
+S-scenario kernel vs S serial runs, bit-identical per scenario.
+
+The sweep correctness law (docs/sweep.md): stacking S congruent
+scenarios on a leading vmap axis and running them through ONE compiled
+kernel must reproduce every scenario's serial trajectory exactly —
+state, counters, event log, netobs telemetry — with exactly one XLA
+trace serving the whole fleet.
+"""
+
+import dataclasses
+import inspect
+
+import pytest
+
+from shadow_tpu.backend.cpu_engine import CpuEngine
+from shadow_tpu.backend.tpu_engine import TpuEngine
+from shadow_tpu.config import presets, scenarios
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.config.presets import flagship_mesh_config
+from shadow_tpu.sweep import (
+    SweepCongruenceError,
+    SweepEngine,
+    SweepSpec,
+    build_report,
+    expand_variants,
+    write_report,
+)
+from shadow_tpu.sweep.variants import check_congruence
+
+pytestmark = pytest.mark.sweep
+
+LOSS_EVENT = {
+    "at": "200 ms", "kind": "loss", "source": 0, "target": 0, "loss": 0.1,
+}
+
+
+def _mesh(seed: int = 42, n: int = 8) -> ConfigOptions:
+    return flagship_mesh_config(n, sim_seconds=1, backend="tpu", seed=seed)
+
+
+def _assert_results_equal(batched, serial, label):
+    assert int(batched.rounds) == int(serial.rounds), label
+    keys = sorted(set(batched.counters) | set(serial.counters))
+    for k in keys:
+        assert int(batched.counters.get(k, 0)) == int(
+            serial.counters.get(k, 0)
+        ), f"{label}: counter {k}"
+    assert batched.log_tuples() == serial.log_tuples(), f"{label}: log"
+
+
+# -- batched vs serial bit-identity ---------------------------------------
+
+
+@pytest.mark.parametrize("size", [1, 2, 4])
+def test_seed_grid_matches_serial(size):
+    """S in {1, 2, 4} seed grids: every scenario of the batched run is
+    bit-identical to its serial device-mode run, under ONE trace."""
+    spec = SweepSpec.seed_grid(42, size)
+    variants = expand_variants(_mesh(), spec)
+    sweep = SweepEngine(variants)
+    results = sweep.run()
+    assert sweep.traces == 1
+    for v, r in zip(variants, results):
+        ref = TpuEngine(v.cfg).run(mode="device")
+        _assert_results_equal(r, ref, v.label)
+
+
+def test_fault_grid_matches_serial_with_netobs():
+    """seed x fault grid with the netobs telemetry plane on: counters,
+    window histograms, and every netobs array bit-identical to serial
+    faulted runs."""
+    import numpy as np
+
+    base = _mesh()
+    base.experimental.netobs = True
+    spec = SweepSpec(seeds=[42, 43], faults=[[], [LOSS_EVENT]])
+    variants = expand_variants(base, spec)
+    sweep = SweepEngine(variants)
+    results = sweep.run()
+    assert sweep.traces == 1
+    for v, r in zip(variants, results):
+        eng = TpuEngine(v.cfg)
+        ref = eng.run(mode="device")
+        _assert_results_equal(r, ref, v.label)
+        got = sweep.engines[v.index]._netobs_data
+        want = eng._netobs_data
+        assert got is not None and want is not None
+        assert list(got["window_hist"]) == list(want["window_hist"]), v.label
+        for k in sorted(want["arrays"]):
+            assert np.array_equal(
+                np.asarray(got["arrays"][k]), np.asarray(want["arrays"][k])
+            ), f"{v.label}: netobs array {k}"
+    # the lossy axis must actually diverge the fleet
+    drops = [int(r.counters.get("lane_drop_loss", 0)) for r in results]
+    assert any(d > 0 for d in drops) and any(d == 0 for d in drops)
+
+
+@pytest.mark.parametrize("size", [1, 2, 4])
+def test_cpu_backend_arm_matches_serial(size):
+    """backend='cpu' runs the scalar oracle serially behind the same
+    API: every sweep result equals a fresh CpuEngine run, S in
+    {1, 2, 4}."""
+    spec = SweepSpec.seed_grid(42, size)
+    cpu_sweep = SweepEngine(expand_variants(_mesh(n=6), spec), backend="cpu")
+    cpu_results = cpu_sweep.run()
+    assert cpu_sweep.traces == 0  # no batched kernel on the oracle arm
+    for v, r in zip(cpu_sweep.variants, cpu_results):
+        ref = CpuEngine(v.cfg).run()
+        _assert_results_equal(r, ref, v.label)
+
+
+def test_cross_backend_parity():
+    """On a parity-safe config the tpu sweep's logs and counters match
+    the cpu arm exactly (the cross-backend leg of the correctness law)."""
+    base = _mesh(n=6)
+    spec = SweepSpec.seed_grid(42, 2)
+    cpu_sweep = SweepEngine(expand_variants(base, spec), backend="cpu")
+    cpu_results = cpu_sweep.run()
+    tpu_sweep = SweepEngine(expand_variants(base, spec))
+    tpu_results = tpu_sweep.run()
+    assert tpu_sweep.traces == 1
+    for v, c, t in zip(cpu_sweep.variants, cpu_results, tpu_results):
+        # backend-local counters (lane_*) differ by catalog; the parity
+        # law binds the event log and the shared counter keys
+        assert t.log_tuples() == c.log_tuples(), f"cross-backend {v.label}"
+        for k in sorted(set(t.counters) & set(c.counters)):
+            assert int(t.counters[k]) == int(c.counters[k]), (
+                f"cross-backend {v.label}: counter {k}"
+            )
+
+
+# -- congruence rejection -------------------------------------------------
+
+
+def test_latency_override_rejected():
+    """Config-level latency changes move the static runahead — the
+    override axis must reject them with guidance toward the fault axis."""
+    spec = SweepSpec(
+        overrides=[{}, {"experimental.runahead": "20 ms"}],
+    )
+    variants = expand_variants(_mesh(), spec)
+    with pytest.raises(SweepCongruenceError, match="fault axis"):
+        SweepEngine(variants)
+
+
+def test_backend_stall_rejected():
+    spec = SweepSpec(
+        faults=[[{"at": "200 ms", "kind": "backend_stall"}]],
+    )
+    with pytest.raises(SweepCongruenceError, match="backend_stall"):
+        expand_variants(_mesh(), spec)
+
+
+def test_flowtrace_seed_grid_rejected():
+    base = _mesh()
+    base.experimental.flowtrace = True
+    variants = expand_variants(base, SweepSpec.seed_grid(42, 2))
+    with pytest.raises(SweepCongruenceError, match="flowtrace"):
+        SweepEngine(variants)
+
+
+def test_differing_topology_rejected():
+    with pytest.raises(SweepCongruenceError, match="not shape-congruent"):
+        check_congruence([TpuEngine(_mesh(n=8)), TpuEngine(_mesh(n=12))])
+
+
+def test_unknown_spec_keys_rejected():
+    with pytest.raises(SweepCongruenceError, match="unknown"):
+        SweepSpec.from_dict({"seeds": [1], "bogus": 3})
+
+
+# -- padded fault epochs (satellite: pad-to-static) -----------------------
+
+
+def test_padded_fault_plan_matches_unpadded():
+    """Trailing zero-length pad rows in the segment plan are bit-inert:
+    a serial faulted run forced through a padded plan (_fault_pad) is
+    identical to the unpadded run (the padded-epoch representation that
+    lets unequal-depth schedules share one batch)."""
+    cfg = _mesh()
+    cfg.faults.events = [dict(LOSS_EVENT)]
+    ref = TpuEngine(cfg).run(mode="device")
+    eng = TpuEngine(cfg)
+    eng._fault_pad = 4
+    padded = eng.run(mode="device")
+    _assert_results_equal(padded, ref, "padded-vs-unpadded")
+
+
+def test_segment_plan_padding_shape():
+    cfg = _mesh()
+    cfg.faults.events = [dict(LOSS_EVENT)]
+    eng = TpuEngine(cfg)
+    ov = eng._fault_overlay
+    stop = cfg.general.stop_time
+    plan = ov.segment_plan(stop, pad_to=5)
+    assert len(plan) == 5
+    # real segments tile [0, stop); pad rows are zero-length at stop
+    assert plan[0][0] == 0 and plan[-1] == (stop, stop, plan[1][2])
+    for seg_start, seg_end, _ in plan[2:]:
+        assert seg_start == seg_end == stop
+
+
+# -- report aggregation ---------------------------------------------------
+
+
+def test_report_byte_identical_and_stats(tmp_path):
+    spec = SweepSpec(name="rpt", seeds=[42, 43], faults=[[], [LOSS_EVENT]])
+    variants = expand_variants(_mesh(), spec)
+    sweep = SweepEngine(variants)
+    results = sweep.run()
+    rep = build_report(sweep, results, name="rpt")
+    assert rep["size"] == 4 and len(rep["scenarios"]) == 4
+    cross = rep["cross"]["lane_drop_loss"]
+    assert cross["max"] > cross["min"]  # the loss axis diverges
+    assert set(cross) == {"p50", "p90", "p99", "min", "max", "outliers"}
+    for row in rep["scenarios"]:
+        assert row["drops"]["loss"] == row["counters"].get(
+            "lane_drop_loss", 0
+        )
+    p1 = write_report(rep, tmp_path / "a")
+    p2 = write_report(
+        build_report(sweep, results, name="rpt"), tmp_path / "b"
+    )
+    assert p1.name == "SWEEP_rpt-S4.json"
+    assert p1.read_bytes() == p2.read_bytes()
+
+
+def test_outlier_flags():
+    from shadow_tpu.sweep.report import _cross_stats
+
+    st = _cross_stats([100, 100, 100, 250])
+    assert st["outliers"] == [3]
+    assert _cross_stats([5, 5, 5, 5])["outliers"] == []
+
+
+# -- seed threading audit (satellite: explicit seed kwargs) ----------------
+
+
+SEED_FACTORIES = [
+    (presets.flagship_mesh_config, {"n_hosts": 4}),
+    (presets.transfer_pair_config, {}),
+    (presets.udp_star_config, {"n_hosts": 4}),
+    (presets.mixed_flagship_config, {"n_hosts": 6}),
+    (scenarios.managed_chain_config, {"data_dir": "/tmp/x"}),
+    (scenarios.managed_relay_chains_large, {"data_dir": "/tmp/x"}),
+    (scenarios.managed_relay_chains_gate, {"data_dir": "/tmp/x"}),
+]
+
+
+@pytest.mark.parametrize(
+    "factory,kwargs", SEED_FACTORIES, ids=lambda f: getattr(f, "__name__", "")
+)
+def test_scenario_factories_thread_seed(factory, kwargs):
+    """Every scenario/preset factory accepts an explicit ``seed`` kwarg
+    and threads it into ``general.seed`` — the contract the sweep seed
+    axis builds on (a factory that pins its own seed would silently
+    collapse a seed grid into S copies of one scenario)."""
+    sig = inspect.signature(factory)
+    assert "seed" in sig.parameters, factory.__name__
+    assert sig.parameters["seed"].default is not inspect.Parameter.empty
+    cfg = factory(seed=777, **kwargs)
+    assert cfg.general.seed == 777, factory.__name__
