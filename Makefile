@@ -7,8 +7,8 @@
 
 .PHONY: test gate native smoke-faults smoke-examples lint-determinism \
 	bench-hybrid obs-smoke netobs-smoke flows-smoke turns-smoke \
-	fusion-smoke checkpoint-smoke chaos-smoke sweep-smoke bench-report \
-	check-fixtures
+	fusion-smoke checkpoint-smoke chaos-smoke sweep-smoke \
+	multichip-smoke bench-report check-fixtures
 
 test: native
 	python -m pytest tests/ -q
@@ -31,6 +31,7 @@ gate: native check-fixtures lint-determinism
 	$(MAKE) checkpoint-smoke
 	$(MAKE) chaos-smoke
 	$(MAKE) sweep-smoke
+	$(MAKE) multichip-smoke
 
 # Runtime fixture dirs (hermdir/, shadow.data/, pytest caches) are
 # .gitignore'd; a force-add or an ignore regression would commit
@@ -133,6 +134,15 @@ chaos-smoke:
 # trace, and nonzero cross-scenario drop variance (docs/sweep.md).
 sweep-smoke:
 	JAX_PLATFORMS=cpu python scripts/sweep_smoke.py
+
+# Multi-chip smoke for the gate: 8 forced virtual CPU devices, phold
+# facade bit-identity at 1/2/4/8 devices with netobs on, nonzero
+# per-device work on every shard, mixed-mesh (stream tier) bit-identity
+# at 8 devices, hybrid sync_stats transfer counts unchanged under a
+# 2-device mesh, and the columnar 100k-host startup bound
+# (docs/multichip.md).
+multichip-smoke: native
+	JAX_PLATFORMS=cpu python scripts/multichip_smoke.py
 
 # Regenerate docs/bench-trajectory.md from the BENCH_r0N.json artifacts.
 bench-report:

@@ -38,7 +38,15 @@ keys record:
   seed grid batched through ONE compiled vmapped kernel, reported as
   whole-scenario completions per hour, with the amortization ratio
   (S x one serial from-scratch wall, compile included, over the batch
-  wall) showing what the single compile buys.
+  wall) showing what the single compile buys;
+- ``multichip_*``: the SHARDED lane plane (shadow_tpu/parallel/,
+  docs/multichip.md) — the columnar 100k-host tgen mesh with its
+  per-lane arrays sharded over every available device
+  (``Mesh(("hosts",))``), vs the same scenario on one device.
+  ``multichip_scaling_efficiency`` = rate(D) / (D x rate(1)) is the
+  honest strong-scaling number; on forced virtual CPU devices it is
+  expected well below 1 (one physical socket), on a real pod slice it
+  is the headline.
 
 Env knobs (for local runs; the driver uses the defaults):
   SHADOW_TPU_BENCH_HOSTS         lanes in the mesh    (default 10000)
@@ -62,6 +70,13 @@ Env knobs (for local runs; the driver uses the defaults):
   SHADOW_TPU_BENCH_SWEEP_SIZE    scenarios per sweep batch (default 8)
   SHADOW_TPU_BENCH_SWEEP_HOSTS   lanes per sweep scenario (default 1000)
   SHADOW_TPU_BENCH_SWEEP_SIM_SECONDS  sweep simulated duration (default 5)
+  SHADOW_TPU_BENCH_MULTICHIP     1 = run the sharded-plane scaling point
+                                 (default 1)
+  SHADOW_TPU_BENCH_MULTICHIP_ONLY  1 = run ONLY the sharded-plane point
+                                 (default 0)
+  SHADOW_TPU_BENCH_MULTICHIP_HOSTS  columnar mesh lanes (default 100000)
+  SHADOW_TPU_BENCH_MULTICHIP_SIM_SECONDS  sharded-run duration (default 2)
+  SHADOW_TPU_BENCH_MULTICHIP_DEVICES  mesh size (default 0 = all devices)
 """
 
 import json
@@ -117,6 +132,19 @@ SWEEP_SIZE = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_SIZE", "8"))
 SWEEP_HOSTS = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_HOSTS", "1000"))
 SWEEP_SIM_SECONDS = int(os.environ.get(
     "SHADOW_TPU_BENCH_SWEEP_SIM_SECONDS", "5"
+))
+MULTICHIP = os.environ.get("SHADOW_TPU_BENCH_MULTICHIP", "1") == "1"
+MULTICHIP_ONLY = os.environ.get(
+    "SHADOW_TPU_BENCH_MULTICHIP_ONLY", "0"
+) == "1"
+MULTICHIP_HOSTS = int(os.environ.get(
+    "SHADOW_TPU_BENCH_MULTICHIP_HOSTS", "100000"
+))
+MULTICHIP_SIM_SECONDS = int(os.environ.get(
+    "SHADOW_TPU_BENCH_MULTICHIP_SIM_SECONDS", "2"
+))
+MULTICHIP_DEVICES = int(os.environ.get(
+    "SHADOW_TPU_BENCH_MULTICHIP_DEVICES", "0"
 ))
 
 
@@ -417,7 +445,79 @@ def _sweep_rate(salt0):
     }
 
 
+def _multichip_rate(salt0):
+    """The sharded-lane-plane scaling point (shadow_tpu/parallel/): the
+    columnar 100k-host tgen mesh with its per-lane arrays sharded over
+    every available device vs the identical scenario on ONE device.
+    Both sides are salted best-of-2 device runs with their own compile
+    excluded (precompile=True), so the ratio is steady-state execution.
+    ``multichip_scaling_efficiency`` = rate(D) / (D x rate(1)) — the
+    strong-scaling efficiency of the collective event exchange.  On
+    forced virtual CPU devices (one physical socket) this is expected
+    well below 1; the keys exist so a real pod run drops straight into
+    the same trajectory."""
+    import jax
+
+    from shadow_tpu import parallel
+    from shadow_tpu.config.columnar import columnar_mesh_config
+
+    def _cfg():
+        cfg = columnar_mesh_config(
+            MULTICHIP_HOSTS, sim_seconds=MULTICHIP_SIM_SECONDS,
+            queue_capacity=16, pops_per_round=2,
+        )
+        # round-robin spray is a permutation (see _pure_cfg)
+        cfg.experimental.tpu_cross_capacity = 8
+        return cfg
+
+    t0 = time.perf_counter()
+    eng = TpuEngine(_cfg(), log_capacity=0)
+    eng.initial_state()
+    build_s = time.perf_counter() - t0
+
+    n_dev = parallel.negotiate_devices(
+        MULTICHIP_DEVICES or None, MULTICHIP_HOSTS,
+        available=jax.device_count(),
+    )
+    base = _best_device_rate(_cfg(), salt0, repeats=2)
+    rate1 = base.sim_seconds_per_wall_second
+    if n_dev > 1:
+        meshed = TpuEngine(_cfg(), log_capacity=0)
+        meshed.attach_mesh(parallel.make_mesh(n_dev))
+        best = meshed.run(
+            mode="device", precompile=True, cache_salt=salt0 + 50
+        )
+        r = meshed.run(mode="device", cache_salt=salt0 + 51)
+        rate_n = max(
+            best.sim_seconds_per_wall_second,
+            r.sim_seconds_per_wall_second,
+        )
+    else:
+        rate_n = rate1
+    return {
+        "multichip_devices": n_dev,
+        "multichip_hosts": MULTICHIP_HOSTS,
+        "multichip_sim_seconds": MULTICHIP_SIM_SECONDS,
+        "multichip_build_s": round(build_s, 3),
+        "multichip_sim_s_per_wall_s": round(rate_n, 4),
+        "multichip_1dev_sim_s_per_wall_s": round(rate1, 4),
+        "multichip_scaling_efficiency": round(
+            rate_n / (n_dev * rate1), 4
+        ) if rate1 > 0 else 0.0,
+    }
+
+
 def main() -> None:
+    if MULTICHIP_ONLY:
+        # the sharded-plane scaling point alone, one JSON line — the
+        # CPU-container analog of HYBRID_ONLY (no device-tier headline
+        # re-recorded from a box without the real accelerator)
+        out = {"metric": "multichip_sim_s_per_wall_s", "unit": "sim_s/wall_s"}
+        out.update(_multichip_rate(_SALT + 800))
+        out["value"] = out["multichip_sim_s_per_wall_s"]
+        out["vs_baseline"] = round(out["value"] / REFERENCE_SPEEDUP, 4)
+        print(json.dumps(out))
+        return
     if HYBRID_ONLY:
         # make bench-hybrid: the hybrid scenario alone, one JSON line
         out = {"metric": "hybrid_sim_s_per_wall_s", "unit": "sim_s/wall_s"}
@@ -524,6 +624,15 @@ def main() -> None:
     # the FLEET throughput plane: S whole scenarios per compiled kernel
     if SWEEP:
         out.update(_sweep_rate(_SALT + 700))
+
+    # the SHARDED lane plane: the columnar 100k-host mesh over every
+    # available device vs one device (docs/multichip.md)
+    if MULTICHIP:
+        mc = _multichip_rate(_SALT + 800)
+        out.update(mc)
+        configs["columnar_mesh_100k_sharded"] = mc[
+            "multichip_sim_s_per_wall_s"
+        ]
 
     out["configs"] = configs
 

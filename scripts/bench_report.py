@@ -112,6 +112,18 @@ KEY_ORDER = [
     "sweep_batch_wall_s",
     "sweep_serial_wall_s",
     "sweep_traces",
+    # multi-chip sharded lane plane (shadow_tpu/parallel/,
+    # docs/multichip.md): the columnar 100k-host mesh sharded over the
+    # host axis — the sharded rate, the 1-device reference, and the
+    # strong-scaling efficiency rate(D) / (D x rate(1))
+    "multichip_sim_s_per_wall_s",
+    "multichip_1dev_sim_s_per_wall_s",
+    "multichip_scaling_efficiency",
+    "multichip_devices",
+    "multichip_hosts",
+    "multichip_sim_seconds",
+    "multichip_build_s",
+    "configs.columnar_mesh_100k_sharded",
 ]
 
 KEY_LABEL = {

@@ -395,6 +395,15 @@ class HybridEngine(_HostSideHybrid):
             cfg, log_capacity=log_capacity, external=self.external_mask,
             world=self.world,
         )
+        # multi-chip data plane (parallel/mesh.py): a negotiated mesh
+        # shards the lane axis; the window loops then compile the hybrid
+        # kernels under it — ≤2 transfers per turn and the sync_stats
+        # byte accounting are unchanged (tests/test_multichip.py)
+        from .. import parallel
+
+        n_dev = parallel.negotiate_from_config(cfg, len(cfg.hosts))
+        if n_dev > 1:
+            self.device.attach_mesh(parallel.make_mesh(n_dev))
         # parked payloads for in-flight packets, keyed (src_host, seq) —
         # popped when the device egresses the delivery
         self._parked: dict = {}
@@ -1316,11 +1325,9 @@ class HybridEngine(_HostSideHybrid):
         if self._fuse_on:
             return self._window_loop_fused(run_round, on_window)
         dev = self.device
-        state = dev.initial_state()
+        state = dev.place_state(dev.initial_state())
         hybrid_fn, inject_fn = dev.make_hybrid_fns()
-        dev_next = min(
-            (t for (_lane, t, *_rest) in dev._init_events), default=NEVER
-        )
+        dev_next = dev.first_event_time()
         turns = self.obs.turns if self.obs is not None else None
         while True:
             host_next = self.next_event_time()
@@ -1371,13 +1378,11 @@ class HybridEngine(_HostSideHybrid):
         change (tests/test_hybrid_fusion.py pins bit-parity with the CPU
         oracle and the unfused engine)."""
         dev = self.device
-        state = dev.initial_state()
+        state = dev.place_state(dev.initial_state())
         fused_fn, inject_fn = dev.make_hybrid_fns(
             self._fuse_k, self._ext_slots
         )
-        dev_next = min(
-            (t for (_lane, t, *_rest) in dev._init_events), default=NEVER
-        )
+        dev_next = dev.first_event_time()
         turns = self.obs.turns if self.obs is not None else None
         while True:
             host_next = self.next_event_time()

@@ -706,15 +706,50 @@ def _seed_keys(p: "LaneParams", tb: "LaneTables"):
 # --------------------------------------------------------------------------
 
 
-def scan_or_unroll(step, carry, xs, length: int):
+# Set (via _force_unroll) while a sharded kernel is being traced:
+# GSPMD cannot partition lax.scan's stacked-output update when the
+# stacked axis is lane-sharded and x64 indices are live (the partitioner
+# emits an s64-vs-s32 offset compare the HLO verifier rejects), so the
+# multi-chip build must take the Python-loop form even on XLA:CPU — but
+# ONLY at the call sites whose stacked outputs carry the lane axis
+# (spmd_unroll=True below): the stream-tier walks stack per-flow [S]
+# rows, which replicate under the mesh and partition fine as scans, and
+# unrolling their heavy bodies made the sharded mixed-kernel compile
+# pathological (tens of GB of XLA working set).
+_SPMD_UNROLL = False
+
+
+class _force_unroll:
+    """Context manager forcing scan_or_unroll into its Python-loop form.
+
+    The sharded drivers (parallel/mesh.py) wrap their jitted entry points
+    with this: jit traces lazily on first call, so the flag must be live
+    around the CALL, not around jax.jit."""
+
+    def __enter__(self):
+        global _SPMD_UNROLL
+        self._old = _SPMD_UNROLL
+        _SPMD_UNROLL = True
+
+    def __exit__(self, *exc):
+        global _SPMD_UNROLL
+        _SPMD_UNROLL = self._old
+
+
+def scan_or_unroll(step, carry, xs, length: int, spmd_unroll: bool = False):
     """``lax.scan`` on XLA:CPU (whose per-op thunk dispatch makes unrolled
     bodies pathological) — but a plain Python loop with ONE final stack on
     the accelerator: scan materializes its stacked outputs via a
     dynamic-update-slice per step even when fully unrolled, and each DUS
     ends an XLA fusion, fragmenting the loop into one kernel launch per
     step (measured: the mixed-mesh iteration ballooned to ~300 fusions).
-    The Python-loop form leaves pure elementwise chains that fuse."""
-    if jax.default_backend() == "cpu":
+    The Python-loop form leaves pure elementwise chains that fuse — and
+    for lane-axis stacked outputs is the only form GSPMD partitions
+    (``spmd_unroll=True`` marks those sites; see _SPMD_UNROLL above);
+    both forms run the same integer ops in the same order, so they are
+    bit-identical.
+    """
+    if jax.default_backend() == "cpu" and not (_SPMD_UNROLL and spmd_unroll):
         return lax.scan(step, carry, xs, length=length)
     outs = []
     for j in range(length):
@@ -3027,7 +3062,9 @@ def _build_iter(p: LaneParams, tb: LaneTables, pure_dataflow: bool = False):
         # per step); on CPU the rolled scan keeps the HLO small — K
         # duplicated slot bodies under XLA:CPU's per-op thunk dispatch
         # made tiny parity runs hundreds of times slower.
-        s, emits = scan_or_unroll(scan_body, s, slots, k)
+        # spmd_unroll: emits stack [K, N] on the lane axis — the one walk
+        # the sharded build must take in loop form
+        s, emits = scan_or_unroll(scan_body, s, slots, k, spmd_unroll=True)
 
         if tiered:
             # unconditional merge (the tier needs the diverted cross rows

@@ -139,7 +139,19 @@ class TpuEngine:
             else:
                 model[hid] = lanes.M_TGEN_SERVER
 
-        for hid, hopt in enumerate(cfg.hosts):
+        # COLUMNAR configs (config/columnar.py): the scenario factory has
+        # already built the per-lane model/param columns and the initial
+        # event table as numpy arrays — skip the per-host Python loop
+        # entirely (the 100k-host startup path, ROADMAP item 5)
+        spec = getattr(cfg, "columnar", None)
+        if spec is not None and ext_mask.any():
+            raise LaneCompatError(
+                "columnar configs are lane-only: the hybrid backend "
+                "executes per-host process objects host-side; build the "
+                "config without the columnar spec"
+            )
+        host_iter = () if spec is not None else enumerate(cfg.hosts)
+        for hid, hopt in host_iter:
             # pcap: sends emit PCAP_TX records into the device log, and
             # collect() reconstructs per-host capture files byte-identical
             # to the CPU backend's (synthetic payloads either way)
@@ -274,15 +286,31 @@ class TpuEngine:
                     cfg, self.graph, self.routing
                 )
 
+        if spec is not None:
+            (
+                model, p_size, p_interval, p_peer, p_count, p_stride,
+                recv_mult, local_seq0,
+            ) = spec.model_columns(n)
+            init_cols = spec.event_columns()
+        else:
+            ev = (
+                np.asarray(init_events, dtype=np.int64).reshape(-1, 6)
+            )
+            init_cols = tuple(ev[:, j] for j in range(6))
+        # (lane, t, kind, src, seq, size) int64 columns — the columnar
+        # initial-event table, consumed vectorized by initial_state()
+        self._init_cols = init_cols
+
         capacity = cfg.experimental.tpu_lane_queue_capacity
         if cfg.experimental.tpu_cross_capacity < 0:
             raise LaneCompatError(
                 f"tpu_cross_capacity={cfg.experimental.tpu_cross_capacity} "
                 "must be >= 0 (0 = queue capacity)"
             )
-        max_init = max(
-            (sum(1 for e in init_events if e[0] == hid) for hid in range(n)),
-            default=0,
+        ev_lane = init_cols[0]
+        max_init = (
+            int(np.bincount(ev_lane, minlength=max(n, 1)).max())
+            if ev_lane.size else 0
         )
         if capacity < max_init + 8:
             raise LaneCompatError(
@@ -361,7 +389,7 @@ class TpuEngine:
             stop_time=cfg.general.stop_time,
             bootstrap_end=cfg.general.bootstrap_end_time,
             runahead=runahead,
-            models_present=tuple(sorted(set(int(x) for x in model))),
+            models_present=tuple(int(x) for x in np.unique(model)),
             # fault epochs may introduce loss later in the run: the loss
             # draw must be compiled in from the start (the counter-based
             # RNG keys on send seq, so drawing on loss-free segments
@@ -572,7 +600,6 @@ class TpuEngine:
                 jnp.asarray(np.isin(np.arange(n), el_np)) if tiered else ()
             ),
         )
-        self._init_events = init_events
         self._local_seq0 = local_seq0
         self._el_np = el_np  # [2S] endpoint lanes (tiered routing/collect)
         self._peer_np = peer_np  # [2S] peer lanes (fault-epoch flow tables)
@@ -583,6 +610,11 @@ class TpuEngine:
         self._dn_params = dn  # [N, 2] (rate, burst) — tier init needs bursts
         self._up_params = up
         self._interval = lanes.DEFAULT_INTERVAL_NS
+        # multi-chip plane (parallel/mesh.py): attach_mesh shards the
+        # lane axis over a device mesh; None = single-device placement
+        self._mesh = None
+        self._run_fn = None
+        self._compiled = None
         # [window-agg] telemetry sink (step mode only; set by the facade)
         self.perf_log = None
         # obs Recorder (shadow_tpu/obs/): device_turn spans per round in
@@ -591,6 +623,43 @@ class TpuEngine:
 
     def _resolve(self, hostname: str, n: int) -> int:
         return self.dns.resolve(hostname)
+
+    # -- multi-chip plane (parallel/mesh.py) -------------------------------
+
+    def attach_mesh(self, mesh) -> None:
+        """Shard this engine's data plane over ``mesh``: subsequent
+        ``run()`` / ``make_hybrid_fns()`` compiles split the lane axis
+        across the mesh devices under the parallel/mesh.py sharding law
+        (bit-identical results at any mesh shape).  Cached programs are
+        invalidated — they were compiled for the previous placement."""
+        if mesh is not None and self.params.n_lanes % mesh.devices.size:
+            raise LaneCompatError(
+                f"n_lanes={self.params.n_lanes} not divisible by mesh "
+                f"size {mesh.devices.size} (negotiate_devices picks a "
+                "dividing count)"
+            )
+        self._mesh = mesh
+        self._run_fn = None
+        self._compiled = None
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def place_state(self, state: lanes.LaneState) -> lanes.LaneState:
+        """Commit ``state`` to this engine's placement: sharded over the
+        attached mesh, or unchanged when single-device."""
+        if self._mesh is None:
+            return state
+        from .. import parallel
+
+        return parallel.shard_state(state, self._mesh)
+
+    def first_event_time(self) -> int:
+        """Earliest initial-event epoch (NEVER when none) — the hybrid
+        window loop's starting device bound."""
+        t = self._init_cols[1]
+        return int(t.min()) if t.size else NEVER
 
     def _next_event_np(self, state) -> int:
         """Host-side earliest-event readback (step-mode telemetry):
@@ -634,7 +703,19 @@ class TpuEngine:
         the k-window fused variant (:func:`lanes.make_hybrid_fused_fn`,
         docs/hybrid.md "k-window fusion law") whose dispatch covers up to
         ``fuse_k`` participating windows against a host-peeked
-        ``ext_slots``-wide event-time schedule."""
+        ``ext_slots``-wide event-time schedule.
+
+        With a mesh attached the same entry points compile SHARDED
+        (parallel.make_sharded_hybrid_fns): lane state split on the host
+        axis, the injection/egress boundary replicated — same transfer
+        counts, same bits."""
+        if self._mesh is not None:
+            from .. import parallel
+
+            return parallel.make_sharded_hybrid_fns(
+                self.params, self.tables, self._mesh,
+                fuse_k=fuse_k, ext_slots=ext_slots,
+            )
         inject_fn = lanes.make_inject_fn(self.params, self.tables)
         if fuse_k >= 2:
             return (
@@ -688,26 +769,49 @@ class TpuEngine:
             tq_auxl = np.zeros((s2, c2), dtype=np.int32)
             tq_size = np.zeros((s2, c2), dtype=np.int32)
             tfill = np.zeros(s2, dtype=np.int64)
-        for lane, t, kind, src, seq, size in self._init_events:
-            row = self._ep_of_lane.get(lane)
-            if row is not None:
-                i = tfill[row]
-                tq_time[row, i] = t
-                tq_auxh[row, i] = (kind << lanes.AUX_KIND_SHIFT) | (
+        ev_lane, ev_t, ev_kind, ev_src, ev_seq, ev_size = self._init_cols
+        if self._ep_of_lane:
+            # tiered: stream endpoints' events route to tier rows — a
+            # handful of compacted flows, the per-event loop is fine
+            for lane, t, kind, src, seq, size in zip(
+                ev_lane.tolist(), ev_t.tolist(), ev_kind.tolist(),
+                ev_src.tolist(), ev_seq.tolist(), ev_size.tolist(),
+            ):
+                row = self._ep_of_lane.get(lane)
+                if row is not None:
+                    i = tfill[row]
+                    tq_time[row, i] = t
+                    tq_auxh[row, i] = (kind << lanes.AUX_KIND_SHIFT) | (
+                        src << lanes.AUX_SRC_SHIFT
+                    )
+                    tq_auxl[row, i] = seq
+                    tq_size[row, i] = size
+                    tfill[row] += 1
+                    continue
+                i = fill[lane]
+                q_time[lane, i] = t
+                q_auxh[lane, i] = (kind << lanes.AUX_KIND_SHIFT) | (
                     src << lanes.AUX_SRC_SHIFT
                 )
-                tq_auxl[row, i] = seq
-                tq_size[row, i] = size
-                tfill[row] += 1
-                continue
-            i = fill[lane]
-            q_time[lane, i] = t
-            q_auxh[lane, i] = (kind << lanes.AUX_KIND_SHIFT) | (
-                src << lanes.AUX_SRC_SHIFT
+                q_auxl[lane, i] = seq
+                q_size[lane, i] = size
+                fill[lane] += 1
+        elif ev_lane.size:
+            # vectorized fill (the 100k-host startup path): stable-sort
+            # events by lane and slot each into its per-lane cumcount
+            # position — same per-lane event sets as the scalar loop, and
+            # the per-row lexsort below normalizes slot order either way
+            order = np.argsort(ev_lane, kind="stable")
+            l_s = ev_lane[order]
+            counts = np.bincount(l_s, minlength=n)
+            starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            pos = np.arange(l_s.size) - np.repeat(starts, counts)
+            q_time[l_s, pos] = ev_t[order]
+            q_auxh[l_s, pos] = (ev_kind[order] << lanes.AUX_KIND_SHIFT) | (
+                ev_src[order] << lanes.AUX_SRC_SHIFT
             )
-            q_auxl[lane, i] = seq
-            q_size[lane, i] = size
-            fill[lane] += 1
+            q_auxl[l_s, pos] = ev_seq[order]
+            q_size[l_s, pos] = ev_size[order]
         # the round kernel keeps queue rows sorted by the 4-word key as an
         # invariant; establish it here (aux_lo before aux_hi: np.lexsort
         # takes the PRIMARY key last)
@@ -904,12 +1008,24 @@ class TpuEngine:
             # a 5-sim-s mixed run "completed" in 2 ms)
             self._iters_salt = int(cache_salt) & 0xFFFFF
             state = state._replace(iters=jnp.int32(self._iters_salt))
+        # with a mesh attached, commit the state to its sharded placement
+        # and compile the driver under the mesh (parallel/mesh.py)
+        state = self.place_state(state)
         if mode == "device":
             # cache the program: repeat runs (bench best-of-N) must not
             # retrace/recompile
             run_fn = getattr(self, "_run_fn", None)
             if run_fn is None:
-                run_fn = self._run_fn = lanes.make_run_fn(self.params, self.tables)
+                if self._mesh is not None:
+                    from .. import parallel
+
+                    run_fn = self._run_fn = parallel.make_sharded_run_fn(
+                        self.params, self.tables, self._mesh
+                    )
+                else:
+                    run_fn = self._run_fn = lanes.make_run_fn(
+                        self.params, self.tables
+                    )
             if precompile and getattr(self, "_compiled", None) is None:
                 # AOT-compile so the timed run is the steady-state program
                 self._compiled = run_fn.lower(state).compile()
@@ -926,7 +1042,14 @@ class TpuEngine:
                     state = jax.block_until_ready(run_fn(state))
             wall = wall_time.perf_counter() - t0
         else:
-            round_fn = lanes.make_round_fn(self.params, self.tables)
+            if self._mesh is not None:
+                from .. import parallel
+
+                round_fn = parallel.make_sharded_round_fn(
+                    self.params, self.tables, self._mesh
+                )
+            else:
+                round_fn = lanes.make_round_fn(self.params, self.tables)
             t0 = wall_time.perf_counter()
             state = self._drive_steps(round_fn, state, on_window, self.params)
             wall = wall_time.perf_counter() - t0

@@ -142,6 +142,15 @@ class ExperimentalOptions:
     # is counted and strict mode raises, exactly like queue overflow
     tpu_cross_capacity: int = 0
     tpu_mesh_shape: Optional[tuple[int, ...]] = None  # None = all devices
+    # multi-chip sharded lane plane (shadow_tpu/parallel/,
+    # docs/multichip.md): shard the per-host lane state over up to this
+    # many devices on a 1-D ``Mesh(("hosts",))``.  0 = off
+    # (single-device); the actual count is NEGOTIATED down to the largest
+    # value that divides the host count and does not exceed the available
+    # devices (transparent fallback — never an error).  Results are
+    # bit-identical at any mesh shape.  A 1-D ``tpu_mesh_shape`` tuple is
+    # the older alias for the same request.
+    mesh_devices: int = 0
     # TIERED stream backend (one-to-one stream configs): stream endpoints
     # run on a dedicated [2S]-row tier with their own queue block and pop
     # rate, keeping the [N]-wide machinery stream-free (docs/tpu-backend.md)
@@ -277,6 +286,11 @@ class ConfigOptions:
     )
     faults: FaultOptions = dataclasses.field(default_factory=FaultOptions)
     hosts: list[HostOptions] = dataclasses.field(default_factory=list)
+    # columnar table spec (config/columnar.py ColumnarSpec), set by the
+    # columnar factories only — never parsed from YAML.  When present,
+    # TpuEngine adopts the per-lane tables/initial events wholesale and
+    # skips its per-host model walk (the 100k-host startup path).
+    columnar: Optional[Any] = None
 
     # -- parsing ----------------------------------------------------------
 
@@ -503,6 +517,10 @@ class ConfigOptions:
             raise ConfigError("experimental.flowtrace_capacity must be >= 1")
         if self.experimental.sweep_size < 0:
             raise ConfigError("experimental.sweep_size must be >= 0")
+        if self.experimental.mesh_devices < 0:
+            raise ConfigError(
+                "experimental.mesh_devices must be >= 0 (0 = single-device)"
+            )
         if (
             self.experimental.sweep_spec is not None
             and not str(self.experimental.sweep_spec).strip()

@@ -714,11 +714,6 @@ class Simulation:
                     "backend; running without it"
                 )
                 self.run_control = None
-            if self.cfg.experimental.tpu_mesh_shape is not None:
-                log.warning(
-                    "tpu_mesh_shape is not supported on the hybrid tpu "
-                    "backend; running single-device"
-                )
             if self._resume_path is not None:
                 raise CheckpointError(
                     "the hybrid tpu backend does not support resume: "
@@ -752,17 +747,21 @@ class Simulation:
             )
             return engine.run(on_window=on_window)
 
-        mesh_shape = self.cfg.experimental.tpu_mesh_shape
-        multi_mesh = (
-            mesh_shape is not None and len(mesh_shape) == 1
-            and mesh_shape[0] > 1
-        )
+        from .. import parallel
+
+        # multi-chip sharded lane plane (parallel/mesh.py,
+        # docs/multichip.md): a negotiated device mesh attaches to the
+        # SAME engine/driver stack — fused free-run and step driver both
+        # compile under it, netobs included (the per-host counter block
+        # shards with its lanes, the window histogram shard-then-reduces)
+        # — with bit-identical results at any mesh shape.  Only faults,
+        # resume, and flowtrace stay single-device.
+        n_mesh = parallel.negotiate_from_config(self.cfg, len(self.cfg.hosts))
+        multi_mesh = n_mesh > 1
         engine = self.engine = TpuEngine(
             self.cfg,
-            # netobs/flowtrace are single-device only for now: the window
-            # histogram, counter flush and event-ring drain live in the
-            # unsharded collect path
-            netobs=False if multi_mesh else None,
+            # flowtrace stays single-device for now: the device event
+            # ring drains through the unsharded snapshot path
             flowtrace=False if multi_mesh else None,
         )
         engine.obs = self.obs
@@ -770,39 +769,22 @@ class Simulation:
             if self.cfg.faults.events:
                 raise LaneCompatError(
                     "fault schedules are not supported on the sharded-mesh "
-                    "driver (fused on-device loop); drop tpu_mesh_shape or "
-                    "use the cpu backend"
+                    "driver; drop experimental.mesh_devices/tpu_mesh_shape "
+                    "or use the cpu backend"
                 )
             if self._resume_path is not None:
                 raise CheckpointError(
                     "checkpoint resume is not supported on the sharded-"
-                    "mesh driver (fused on-device loop); drop "
+                    "mesh driver; drop experimental.mesh_devices/"
                     "tpu_mesh_shape to resume"
                 )
-            import jax
-
-            from .. import parallel
-
-            if (
-                self.run_control is not None
-                or self.cfg.experimental.perf_logging
-                or self.obs is not None
-                or self.cfg.experimental.netobs
-                or self.cfg.experimental.flowtrace
-            ):
+            if self.cfg.experimental.flowtrace:
                 log.warning(
-                    "run-control / perf-logging / obs spans / netobs / "
-                    "flowtrace are not supported on the sharded-mesh "
-                    "driver (fused on-device loop); running without them "
-                    "— drop tpu_mesh_shape to use them"
+                    "flowtrace is not supported on the sharded-mesh "
+                    "driver; running without it — drop "
+                    "experimental.mesh_devices to trace flows"
                 )
-
-            mesh = parallel.make_mesh(mesh_shape[0])
-            state = parallel.shard_state(engine.initial_state(), mesh)
-            run_fn = parallel.make_sharded_run_fn(engine.params, engine.tables, mesh)
-            t0 = wall_time.perf_counter()
-            final = jax.block_until_ready(run_fn(state))
-            return engine.collect(final, wall_time.perf_counter() - t0)
+            engine.attach_mesh(parallel.make_mesh(n_mesh))
         # run-control / perf logging / checkpointing / resume force the
         # step-wise driver (one device call per round, pausable, with
         # host-visible lane state at every boundary); otherwise the

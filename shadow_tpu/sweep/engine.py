@@ -26,6 +26,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .. import parallel
+from ..backend import lanes
 from ..backend.cpu_engine import CpuEngine, SimResult
 from ..backend.tpu_engine import TpuEngine
 from .variants import SweepVariant, check_congruence
@@ -133,8 +135,24 @@ class SweepEngine:
         if self._fn is None:
             self._fn = engines[0].make_sweep_fn()
         fn = self._fn
+        # sweep x mesh composition (docs/multichip.md): when the config
+        # asks for a mesh, shard the STACKED scenario axis — whole
+        # scenarios per device — instead of the (small) per-scenario host
+        # axis.  Every batched argument leads with [S], so committing the
+        # inputs to one NamedSharding is the entire change: the shardings
+        # propagate through the same jitted vmapped kernel, keeping the
+        # one-compile law (tests/test_sweep.py asserts traces == 1).
+        smesh = None
+        n_dev = parallel.negotiate_from_config(
+            engines[0].cfg, len(engines)
+        )
+        if n_dev > 1:
+            smesh = parallel.make_mesh(n_dev, axis=parallel.SCENARIO_AXIS)
+            ssh = parallel.scenario_sharding(smesh)
         t0 = wall_time.perf_counter()
         state_b = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        if smesh is not None:
+            state_b = jax.device_put(state_b, ssh)
         for seg in range(depth):
             tbs = [
                 eng.sweep_tables(plans[i][seg][2])
@@ -146,7 +164,14 @@ class SweepEngine:
             stop_lo = jnp.asarray(
                 [t & ((1 << 31) - 1) for t in ends], dtype=jnp.int32
             )
-            state_b = fn(tb_b, stop_hi, stop_lo, state_b)
+            if smesh is not None:
+                tb_b, stop_hi, stop_lo = jax.device_put(
+                    (tb_b, stop_hi, stop_lo), ssh
+                )
+                with lanes._force_unroll():
+                    state_b = fn(tb_b, stop_hi, stop_lo, state_b)
+            else:
+                state_b = fn(tb_b, stop_hi, stop_lo, state_b)
         state_b = jax.block_until_ready(state_b)
         wall = wall_time.perf_counter() - t0
         results = []

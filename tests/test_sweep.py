@@ -130,6 +130,35 @@ def test_cross_backend_parity():
             )
 
 
+def test_scenario_axis_sharded_matches_serial():
+    """Sweep x mesh composition (docs/multichip.md): with
+    ``experimental.mesh_devices`` set, the batch shards WHOLE scenarios
+    across devices (the scenario axis, not the 8-host lane axis) — one
+    trace, and every scenario still bit-identical to its serial
+    single-device run."""
+    base = _mesh()
+    base.experimental.mesh_devices = 4
+    spec = SweepSpec.seed_grid(42, 4)
+    variants = expand_variants(base, spec)
+    sweep = SweepEngine(variants)
+    results = sweep.run()
+    assert sweep.traces == 1
+    for v, r in zip(variants, results):
+        ref = TpuEngine(v.cfg).run(mode="device")
+        _assert_results_equal(r, ref, v.label)
+
+
+def test_scenario_axis_fallback_when_indivisible():
+    """S=3 does not divide mesh_devices=2: the negotiation steps down to
+    a single device and the sweep still runs (transparent fallback)."""
+    base = _mesh()
+    base.experimental.mesh_devices = 2
+    variants = expand_variants(base, SweepSpec.seed_grid(42, 3))
+    sweep = SweepEngine(variants)
+    results = sweep.run()
+    assert sweep.traces == 1 and len(results) == 3
+
+
 # -- congruence rejection -------------------------------------------------
 
 
